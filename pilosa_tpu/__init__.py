@@ -1,0 +1,31 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch rebuild of the capabilities of Pilosa (reference: pubgo/pilosa,
+a Go distributed bitmap index) designed JAX/XLA-first:
+
+- roaring container algebra  -> dense uint32 bit-blocks in HBM + fused XLA/Pallas kernels
+  (reference: roaring/roaring.go)
+- fragment/view/field/index/holder storage tree -> host-authoritative sparse row store
+  with device-resident dense caches (reference: fragment.go, view.go, field.go,
+  index.go, holder.go)
+- per-shard mapReduce executor -> batched per-shard device execution, `shard_map`/
+  NamedSharding over a `jax.sharding.Mesh` with psum / bitwise-or collectives on ICI
+  (reference: executor.go:2460-2613)
+- HTTP + gossip cluster plane -> host HTTP control plane over a static device mesh
+  (reference: cluster.go, gossip/, broadcast.go)
+
+Layout:
+    ops/       device bitmap engine (bitwise algebra, popcount, BSI ladder, top-k)
+    core/      storage hierarchy (fragment, view, field, index, holder, caches, WAL)
+    pql/       PQL parser + AST (port of the pql/pql.peg grammar semantics)
+    exec/      query executor (call dispatch, per-shard map, reduce)
+    parallel/  mesh placement, sharded stores, collective reductions
+    cluster/   multi-node placement (partition/jump hash), membership, anti-entropy
+    server/    HTTP server + API + internal client
+    cli/       command-line interface (server/import/export/inspect/check/config)
+    utils/     logging, stats, tracing, misc
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXPONENT  # noqa: F401

@@ -1,0 +1,116 @@
+"""Naive set-model bitmap — the differential-testing oracle.
+
+Reference: /root/reference/roaring/naive.go (a deliberately simple uint64-slice
+bitmap used by the go-fuzz differential harness, roaring/fuzzer.go:37). Every
+device kernel and storage layer in this package is tested against this model.
+
+Semantics are plain set algebra over uint64 positions. Nothing here is
+performance-relevant; clarity wins.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List
+
+
+class NaiveBitmap:
+    """A bitmap over 64-bit positions backed by a Python set."""
+
+    __slots__ = ("_bits",)
+
+    def __init__(self, positions: Iterable[int] = ()):
+        self._bits = set(positions)
+        for p in self._bits:
+            if p < 0:
+                raise ValueError(f"negative position {p}")
+
+    # -- mutation ---------------------------------------------------------
+
+    def add(self, *positions: int) -> bool:
+        """Add positions; returns True if anything changed."""
+        for p in positions:
+            if p < 0:
+                raise ValueError(f"negative position {p}")
+        before = len(self._bits)
+        self._bits.update(positions)
+        return len(self._bits) != before
+
+    def remove(self, *positions: int) -> bool:
+        before = len(self._bits)
+        self._bits.difference_update(positions)
+        return len(self._bits) != before
+
+    # -- queries ----------------------------------------------------------
+
+    def contains(self, p: int) -> bool:
+        return p in self._bits
+
+    def count(self) -> int:
+        return len(self._bits)
+
+    def count_range(self, start: int, stop: int) -> int:
+        return sum(1 for p in self._bits if start <= p < stop)
+
+    def slice(self) -> List[int]:
+        return sorted(self._bits)
+
+    def slice_range(self, start: int, stop: int) -> List[int]:
+        return sorted(p for p in self._bits if start <= p < stop)
+
+    def max(self) -> int:
+        return max(self._bits) if self._bits else 0
+
+    def min(self) -> int:
+        return min(self._bits) if self._bits else 0
+
+    def any(self) -> bool:
+        return bool(self._bits)
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(sorted(self._bits))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, NaiveBitmap) and self._bits == other._bits
+
+    def __repr__(self) -> str:
+        return f"NaiveBitmap({sorted(self._bits)[:16]}{'...' if len(self._bits) > 16 else ''})"
+
+    # -- set algebra -------------------------------------------------------
+
+    def intersect(self, other: "NaiveBitmap") -> "NaiveBitmap":
+        return NaiveBitmap(self._bits & other._bits)
+
+    def union(self, *others: "NaiveBitmap") -> "NaiveBitmap":
+        out = set(self._bits)
+        for o in others:
+            out |= o._bits
+        return NaiveBitmap(out)
+
+    def difference(self, *others: "NaiveBitmap") -> "NaiveBitmap":
+        out = set(self._bits)
+        for o in others:
+            out -= o._bits
+        return NaiveBitmap(out)
+
+    def xor(self, other: "NaiveBitmap") -> "NaiveBitmap":
+        return NaiveBitmap(self._bits ^ other._bits)
+
+    def intersection_count(self, other: "NaiveBitmap") -> int:
+        return len(self._bits & other._bits)
+
+    def shift(self, n: int = 1) -> "NaiveBitmap":
+        """Shift all positions up by n (reference: roaring shift, roaring.go:4579)."""
+        return NaiveBitmap(p + n for p in self._bits if p + n >= 0)
+
+    def flip(self, start: int, stop: int) -> "NaiveBitmap":
+        """Flip bits in [start, stop] inclusive (reference flip semantics)."""
+        out = set(self._bits)
+        for p in range(start, stop + 1):
+            out.symmetric_difference_update({p})
+        return NaiveBitmap(out)
+
+    def offset_range(self, offset: int, start: int, end: int) -> "NaiveBitmap":
+        """Positions in [start, end) rebased to offset (reference:
+        roaring.go OffsetRange — used to lift a fragment row into the global
+        column space)."""
+        return NaiveBitmap(p - start + offset for p in self._bits if start <= p < end)

@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's analog is the
+in-process multi-node cluster harness, /root/reference/test/pilosa.go:390
+MustRunCluster). Real-TPU behavior is exercised by bench.py and the driver's
+compile checks, not by the unit suite.
+
+Env must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,169 @@
+"""Differential tests: device bitmap engine vs the naive set-model oracle.
+
+Mirrors the reference's fuzz/differential strategy (roaring/fuzzer.go:37
+FuzzRoaringOps against roaring/naive.go)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.naive import NaiveBitmap
+from pilosa_tpu.ops import bitmap as ob
+
+N_BITS = 1 << 16  # small shard width for tests; ops are width-polymorphic
+N_WORDS = N_BITS // 32
+
+
+def rand_positions(rng, n, lo=0, hi=N_BITS):
+    return np.unique(rng.integers(lo, hi, size=n))
+
+
+def pack(positions):
+    return ob.pack_positions(positions, N_BITS)
+
+
+class TestPacking:
+    def test_roundtrip(self, rng):
+        pos = rand_positions(rng, 1000)
+        words = pack(pos)
+        assert np.array_equal(ob.unpack_positions(words), pos.astype(np.uint64))
+
+    def test_empty(self):
+        words = pack([])
+        assert words.shape == (N_WORDS,)
+        assert ob.unpack_positions(words).size == 0
+
+    def test_boundaries(self):
+        for p in [0, 31, 32, 33, 63, 64, N_BITS - 1]:
+            words = pack([p])
+            assert list(ob.unpack_positions(words)) == [p]
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            pack([N_BITS])
+
+
+class TestAlgebra:
+    def setup_method(self, method):
+        rng = np.random.default_rng(7)
+        self.pa = rand_positions(rng, 2000)
+        self.pb = rand_positions(rng, 3000)
+        self.na = NaiveBitmap(self.pa.tolist())
+        self.nb = NaiveBitmap(self.pb.tolist())
+        self.wa = pack(self.pa)
+        self.wb = pack(self.pb)
+
+    def check(self, device_words, naive):
+        got = ob.unpack_positions(np.asarray(device_words))
+        assert got.tolist() == naive.slice()
+
+    def test_and(self):
+        self.check(ob.b_and(self.wa, self.wb), self.na.intersect(self.nb))
+
+    def test_or(self):
+        self.check(ob.b_or(self.wa, self.wb), self.na.union(self.nb))
+
+    def test_xor(self):
+        self.check(ob.b_xor(self.wa, self.wb), self.na.xor(self.nb))
+
+    def test_andnot(self):
+        self.check(ob.b_andnot(self.wa, self.wb), self.na.difference(self.nb))
+
+    def test_not_bounded_by_exists(self):
+        exists = self.wb  # treat b as the existence row
+        self.check(ob.b_not(self.wa, exists), self.nb.difference(self.na))
+
+    def test_popcount(self):
+        assert int(ob.popcount(self.wa)) == self.na.count()
+
+    def test_count_and_fused(self):
+        assert int(ob.count_and(self.wa, self.wb)) == self.na.intersection_count(self.nb)
+
+    def test_count_andnot(self):
+        assert int(ob.count_andnot(self.wa, self.wb)) == self.na.difference(self.nb).count()
+
+    def test_union_reduce(self):
+        rng = np.random.default_rng(3)
+        stacks, naives = [], []
+        for _ in range(5):
+            p = rand_positions(rng, 500)
+            stacks.append(pack(p))
+            naives.append(NaiveBitmap(p.tolist()))
+        out = ob.union_reduce(np.stack(stacks))
+        self.check(out, naives[0].union(*naives[1:]))
+
+    def test_intersect_reduce(self):
+        rng = np.random.default_rng(4)
+        base = rand_positions(rng, 30000)
+        stacks = [pack(base)]
+        naive = NaiveBitmap(base.tolist())
+        for _ in range(3):
+            p = rand_positions(rng, 30000)
+            stacks.append(pack(p))
+            naive = naive.intersect(NaiveBitmap(p.tolist()))
+        self.check(ob.intersect_reduce(np.stack(stacks)), naive)
+
+    def test_xor_reduce(self):
+        out = ob.xor_reduce(np.stack([self.wa, self.wb, self.wa]))
+        self.check(out, self.na.xor(self.nb).xor(self.na))
+
+    def test_popcount_rows_batched(self):
+        stack = np.stack([self.wa, self.wb])
+        counts = np.asarray(ob.popcount_rows(stack))
+        assert counts.tolist() == [self.na.count(), self.nb.count()]
+
+
+class TestRangeAndShift:
+    def test_range_mask(self):
+        for start, stop in [(0, 0), (0, 1), (5, 37), (0, N_BITS), (100, 100), (31, 33), (64, 96)]:
+            mask = np.asarray(ob.range_mask_words(start, stop, N_BITS))
+            expect = NaiveBitmap(range(start, stop))
+            assert ob.unpack_positions(mask).tolist() == expect.slice()
+
+    def test_count_range(self, rng):
+        pos = rand_positions(rng, 5000)
+        naive = NaiveBitmap(pos.tolist())
+        words = pack(pos)
+        for start, stop in [(0, N_BITS), (100, 1000), (0, 1), (N_BITS - 10, N_BITS)]:
+            assert int(ob.count_range(words, start, stop)) == naive.count_range(start, stop)
+
+    @pytest.mark.parametrize("n", [1, 5, 32, 33, 64, 100])
+    def test_shift_with_overflow(self, rng, n):
+        pos = rand_positions(rng, 3000)
+        naive = NaiveBitmap(pos.tolist())
+        words = pack(pos)
+        shifted, overflow = ob.shift_bits(words, n)
+        shifted_naive = naive.shift(n)
+        in_shard = NaiveBitmap([p for p in shifted_naive.slice() if p < N_BITS])
+        carried = NaiveBitmap([p - N_BITS for p in shifted_naive.slice() if p >= N_BITS])
+        assert ob.unpack_positions(np.asarray(shifted)).tolist() == in_shard.slice()
+        assert ob.unpack_positions(np.asarray(overflow)).tolist() == carried.slice()
+
+    def test_shift_zero(self, rng):
+        pos = rand_positions(rng, 100)
+        words = pack(pos)
+        shifted, overflow = ob.shift_bits(words, 0)
+        assert np.array_equal(np.asarray(shifted), words)
+        assert int(ob.popcount(np.asarray(overflow))) == 0
+
+
+class TestNaiveModel:
+    """Validate the oracle itself (reference: roaring/naive_test.go)."""
+
+    def test_basic(self):
+        b = NaiveBitmap()
+        assert b.add(1, 5, 100)
+        assert not b.add(1)
+        assert b.contains(5)
+        assert not b.contains(6)
+        assert b.count() == 3
+        assert b.remove(5)
+        assert not b.remove(5)
+        assert b.slice() == [1, 100]
+
+    def test_flip(self):
+        b = NaiveBitmap([1, 3])
+        assert b.flip(1, 4).slice() == [2, 4]
+
+    def test_offset_range(self):
+        b = NaiveBitmap([10, 20, 300])
+        assert b.offset_range(1000, 0, 256).slice() == [1010, 1020]
