@@ -1,0 +1,351 @@
+"""Field: typed container of views.
+
+Reference: /root/reference/field.go — types set / int(BSI) / time / mutex /
+bool (field.go:56-62); options persisted as metadata (field.go:522-587);
+BSI group with Min/Max/Base/BitDepth (field.go:1562); time-quantum view
+expansion on SetBit (field.go:927, time.go:91)."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from dataclasses import asdict, dataclass, field as dc_field
+from datetime import datetime
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from pilosa_tpu.core import timeq
+from pilosa_tpu.core.view import VIEW_BSI_PREFIX, VIEW_STANDARD, View
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+FIELD_TYPE_SET = "set"
+FIELD_TYPE_INT = "int"
+FIELD_TYPE_TIME = "time"
+FIELD_TYPE_MUTEX = "mutex"
+FIELD_TYPE_BOOL = "bool"
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+
+DEFAULT_CACHE_SIZE = 50_000  # reference: field.go:48
+
+FALSE_ROW_ID = 0  # reference: falseRowID/trueRowID, fragment.go:86-87
+TRUE_ROW_ID = 1
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
+
+
+def validate_name(name: str) -> None:
+    """Reference name rules (pilosa.go validateName)."""
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid name {name!r}")
+
+
+def bit_depth_of(uvalue: int) -> int:
+    """Bits needed for a magnitude (>=1) (reference: bitDepth, fragment.go)."""
+    return max(1, int(uvalue).bit_length())
+
+
+def bsi_base(min_v: int, max_v: int) -> int:
+    """Default base (reference: field.go:1552 bsiBase)."""
+    if min_v > 0:
+        return min_v
+    if max_v < 0:
+        return max_v
+    return 0
+
+
+@dataclass
+class FieldOptions:
+    type: str = FIELD_TYPE_SET
+    cache_type: str = CACHE_TYPE_RANKED
+    cache_size: int = DEFAULT_CACHE_SIZE
+    min: int = 0
+    max: int = 0
+    base: int = 0
+    bit_depth: int = 0
+    time_quantum: str = ""
+    keys: bool = False
+    no_standard_view: bool = False
+
+
+class Field:
+    def __init__(self, path: Optional[str], index: str, name: str, options: FieldOptions):
+        # Leading-underscore names are reserved for internal fields
+        # (`_exists`), created only by the index itself; user-facing creation
+        # paths validate separately (reference: CreateField validation).
+        if not name.startswith("_"):
+            validate_name(name)
+        self.path = path
+        self.index = index
+        self.name = name
+        self.options = options
+        self._mu = threading.RLock()
+        self.views: Dict[str, View] = {}
+        # shards this node knows exist cluster-wide (field.go:88
+        # remoteAvailableShards); local shards are derived from fragments.
+        self.remote_available_shards: Set[int] = set()
+
+        if options.type == FIELD_TYPE_INT:
+            if options.min == 0 and options.max == 0:
+                options.max = 2**31 - 1  # mirror of reference default range
+            options.base = bsi_base(options.min, options.max)
+            if options.bit_depth == 0:
+                required = max(
+                    bit_depth_of(abs(options.min - options.base)),
+                    bit_depth_of(abs(options.max - options.base)),
+                )
+                options.bit_depth = required
+        if options.type == FIELD_TYPE_TIME:
+            timeq.validate_quantum(options.time_quantum)
+
+    # ------------------------------------------------------------------
+    # lifecycle / persistence
+    # ------------------------------------------------------------------
+
+    @property
+    def meta_path(self) -> Optional[str]:
+        return None if self.path is None else os.path.join(self.path, ".meta.json")
+
+    def open(self) -> "Field":
+        if self.path is not None:
+            os.makedirs(self.path, exist_ok=True)
+            if os.path.exists(self.meta_path):
+                self.load_meta()
+            else:
+                self.save_meta()
+            views_dir = os.path.join(self.path, "views")
+            if os.path.isdir(views_dir):
+                for vname in sorted(os.listdir(views_dir)):
+                    self._view_create(vname)
+        return self
+
+    def close(self) -> None:
+        with self._mu:
+            for v in self.views.values():
+                v.close()
+
+    def save_meta(self) -> None:
+        if self.path is None:
+            return
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(asdict(self.options), f)
+        os.replace(tmp, self.meta_path)
+
+    def load_meta(self) -> None:
+        with open(self.meta_path) as f:
+            data = json.load(f)
+        self.options = FieldOptions(**data)
+
+    # ------------------------------------------------------------------
+    # views
+    # ------------------------------------------------------------------
+
+    def _view_path(self, name: str) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, "views", name)
+
+    def _view_create(self, name: str) -> View:
+        with self._mu:
+            v = self.views.get(name)
+            if v is None:
+                is_mutex = self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL)
+                v = View(
+                    name, self.index, self.name, self._view_path(name), mutex=is_mutex
+                ).open()
+                self.views[name] = v
+            return v
+
+    def view(self, name: str = VIEW_STANDARD) -> Optional[View]:
+        return self.views.get(name)
+
+    def bsi_view_name(self) -> str:
+        return VIEW_BSI_PREFIX + self.name
+
+    def available_shards(self) -> Set[int]:
+        """Union of local fragment shards + remote-known shards
+        (field.go:263 AvailableShards)."""
+        with self._mu:
+            shards: Set[int] = set(self.remote_available_shards)
+            for v in self.views.values():
+                shards.update(v.available_shards())
+            return shards
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+
+    def set_bit(self, row_id: int, col: int, ts: Optional[datetime] = None) -> bool:
+        """Set a bit in the standard view (+ time-quantum views when
+        timestamped; field.go:927 SetBit)."""
+        changed = False
+        if not self.options.no_standard_view:
+            changed |= self._view_create(VIEW_STANDARD).set_bit(row_id, col)
+        if ts is not None:
+            if self.options.type != FIELD_TYPE_TIME:
+                raise ValueError(f"field {self.name} is not a time field")
+            for vname in timeq.views_by_time(
+                VIEW_STANDARD, ts, self.options.time_quantum
+            ):
+                changed |= self._view_create(vname).set_bit(row_id, col)
+        return changed
+
+    def clear_bit(self, row_id: int, col: int) -> bool:
+        """Clear in ALL views (field.go ClearBit clears time views too)."""
+        changed = False
+        with self._mu:
+            views = list(self.views.values())
+        for v in views:
+            if v.name.startswith(VIEW_BSI_PREFIX):
+                continue
+            changed |= v.clear_bit(row_id, col)
+        return changed
+
+    def set_value(self, col: int, value: int) -> bool:
+        """BSI write with auto bit-depth growth (field.go:1075 SetValue)."""
+        if self.options.type != FIELD_TYPE_INT:
+            raise ValueError(f"field {self.name} is not an int field")
+        if value < self.options.min:
+            raise ValueError(f"value {value} below field minimum {self.options.min}")
+        if value > self.options.max:
+            raise ValueError(f"value {value} above field maximum {self.options.max}")
+        base_value = value - self.options.base
+        required = bit_depth_of(abs(base_value))
+        if required > self.options.bit_depth:
+            with self._mu:
+                self.options.bit_depth = required
+                self.save_meta()
+        v = self._view_create(self.bsi_view_name())
+        return v.set_value(col, self.options.bit_depth, base_value)
+
+    def clear_value(self, col: int) -> bool:
+        v = self.view(self.bsi_view_name())
+        if v is None:
+            return False
+        val, exists = v.value(col, self.options.bit_depth)
+        if not exists:
+            return False
+        return v.set_value(col, self.options.bit_depth, val, clear=True)
+
+    def import_bits(
+        self,
+        row_ids: np.ndarray,
+        cols: np.ndarray,
+        timestamps: Optional[List[Optional[datetime]]] = None,
+        clear: bool = False,
+    ) -> None:
+        """Bulk import grouped by view and shard (field.go:1204 Import)."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(cols, dtype=np.uint64)
+        shards = cols // SHARD_WIDTH
+
+        # standard view
+        if not self.options.no_standard_view:
+            std = self._view_create(VIEW_STANDARD)
+            for shard in np.unique(shards):
+                m = shards == shard
+                std.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
+
+        # time views
+        if timestamps is not None and self.options.time_quantum:
+            by_view: Dict[str, List[int]] = {}
+            for i, ts in enumerate(timestamps):
+                if ts is None:
+                    continue
+                for vname in timeq.views_by_time(
+                    VIEW_STANDARD, ts, self.options.time_quantum
+                ):
+                    by_view.setdefault(vname, []).append(i)
+            for vname, idxs in by_view.items():
+                v = self._view_create(vname)
+                idx = np.array(idxs)
+                vshards = shards[idx]
+                for shard in np.unique(vshards):
+                    m = idx[vshards == shard]
+                    v.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
+
+    def import_values(self, cols: np.ndarray, values: np.ndarray) -> None:
+        """Bulk BSI import (field.go:1285 importValue)."""
+        cols = np.asarray(cols, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.int64)
+        if len(values) and (
+            values.min() < self.options.min or values.max() > self.options.max
+        ):
+            raise ValueError("value out of field min/max range")
+        base_values = values - self.options.base
+        required = int(
+            max(bit_depth_of(int(np.abs(base_values).max())) if len(values) else 1, 1)
+        )
+        if required > self.options.bit_depth:
+            with self._mu:
+                self.options.bit_depth = required
+                self.save_meta()
+        v = self._view_create(self.bsi_view_name())
+        shards = cols // SHARD_WIDTH
+        for shard in np.unique(shards):
+            m = shards == shard
+            v.fragment(int(shard)).import_values(
+                cols[m], base_values[m], self.options.bit_depth
+            )
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def value(self, col: int) -> Tuple[int, bool]:
+        """(value, exists) for one column (field.go:1040 Value)."""
+        v = self.view(self.bsi_view_name())
+        if v is None:
+            return 0, False
+        val, exists = v.value(col, self.options.bit_depth)
+        if not exists:
+            return 0, False
+        return val + self.options.base, True
+
+    def row_positions(self, row_id: int) -> np.ndarray:
+        v = self.view(VIEW_STANDARD)
+        return v.row_positions(row_id) if v is not None else np.empty(0, np.uint64)
+
+    def bsi_group(self):
+        """The field's own BSI group descriptor (field.go bsiGroup(f.name))."""
+        o = self.options
+        return o.base, o.bit_depth, o.min, o.max
+
+    # baseValue adjustment for range predicates (field.go:1583 baseValue).
+    def base_value(self, op: str, value: int) -> Tuple[int, bool]:
+        o = self.options
+        depth_min = o.base - (1 << o.bit_depth) + 1
+        depth_max = o.base + (1 << o.bit_depth) - 1
+        if op in ("gt", "gte"):
+            if value > depth_max:
+                return 0, True
+            if value > depth_min:
+                return value - o.base, False
+            return 0, False
+        if op in ("lt", "lte"):
+            if value < depth_min:
+                return 0, True
+            if value > depth_max:
+                return depth_max - o.base, False
+            return value - o.base, False
+        if op in ("eq", "neq"):
+            if value < depth_min or value > depth_max:
+                return 0, True
+            return value - o.base, False
+        raise ValueError(f"invalid op {op}")
+
+    def base_value_between(self, lo: int, hi: int) -> Tuple[int, int, bool]:
+        o = self.options
+        depth_min = o.base - (1 << o.bit_depth) + 1
+        depth_max = o.base + (1 << o.bit_depth) - 1
+        if hi < depth_min or lo > depth_max:
+            return 0, 0, True
+        lo = max(lo, depth_min)
+        hi = min(hi, depth_max)
+        return lo - o.base, hi - o.base, False

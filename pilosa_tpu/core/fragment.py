@@ -1,0 +1,527 @@
+"""Fragment: one (index, field, view, shard) slab of bits.
+
+Reference: /root/reference/fragment.go — the unit of storage, locking,
+snapshotting and placement ("Fragment=intersection of field & shard",
+NOTES:25). This rebuild keeps the same unit but splits responsibilities
+TPU-style:
+
+- host side: sparse-or-dense RowBits per row (core/rowstore.py), WAL +
+  snapshot persistence (core/wal.py), mutex vector for mutex fields
+  (fragment.go:670), op counting with MaxOpN snapshot triggering
+  (fragment.go:84,2296).
+- device side: per-row dense uint32 blocks cached in HBM; all query math
+  (row algebra, BSI ladders, counts) happens there via ops/bitmap.py and
+  ops/bsi.py. Host bitmap math never serves a query — the host store is the
+  mutable/durable representation only.
+
+Position convention matches fragment.go:3090:
+    pos = row_id * SHARD_WIDTH + (col % SHARD_WIDTH).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from pilosa_tpu.core import wal as walmod
+from pilosa_tpu.core.rowstore import RowBits
+from pilosa_tpu.ops import bitmap as ob
+from pilosa_tpu.ops import bsi as obsi
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXPONENT
+
+# Reference: fragment.go:84 — ops between snapshots.
+DEFAULT_MAX_OP_N = 10_000
+
+# BSI plane rows (reference: fragment.go:88-96).
+BSI_EXISTS_BIT = 0
+BSI_SIGN_BIT = 1
+BSI_OFFSET_BIT = 2
+
+
+class Fragment:
+    """One shard of one view of one field.
+
+    Thread-safety: a single re-entrant lock guards host structures (the
+    reference uses fragment.mu the same way, fragment.go:100-159).
+    """
+
+    def __init__(
+        self,
+        path: Optional[str],
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        *,
+        mutex: bool = False,
+        max_op_n: int = DEFAULT_MAX_OP_N,
+    ):
+        self.path = path  # None => purely in-memory (test harness)
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.mutex = mutex
+        self.max_op_n = max_op_n
+
+        self._mu = threading.RLock()
+        self._rows: Dict[int, RowBits] = {}
+        self._dev: Dict[int, jax.Array] = {}  # device row cache
+        self._wal: Optional[walmod.WalWriter] = None
+        self._op_n = 0
+        # mutex fields: col -> owning row (reference keeps a mutex vector,
+        # fragment.go:670 handleMutex)
+        self._mutex_map: Optional[Dict[int, int]] = {} if mutex else None
+        self._open = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    @property
+    def snap_path(self) -> Optional[str]:
+        return None if self.path is None else self.path + ".snap"
+
+    @property
+    def wal_path(self) -> Optional[str]:
+        return None if self.path is None else self.path + ".wal"
+
+    def open(self) -> "Fragment":
+        with self._mu:
+            if self._open:
+                return self
+            if self.path is not None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                if os.path.exists(self.snap_path):
+                    _, n_bits, rows = walmod.read_snapshot(self.snap_path)
+                    if n_bits != SHARD_WIDTH:
+                        raise ValueError(
+                            f"{self.snap_path}: snapshot width {n_bits} != "
+                            f"configured SHARD_WIDTH {SHARD_WIDTH}"
+                        )
+                    self._rows = rows
+                for op, positions in walmod.replay_wal(self.wal_path):
+                    self._apply_positions(
+                        positions if op == walmod.OP_SET else np.empty(0, np.uint64),
+                        positions if op == walmod.OP_CLEAR else np.empty(0, np.uint64),
+                    )
+                    self._op_n += len(positions)
+                self._wal = walmod.WalWriter(self.wal_path)
+            if self._mutex_map is not None:
+                self._rebuild_mutex_map()
+            self._open = True
+            return self
+
+    def close(self) -> None:
+        with self._mu:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+            self._dev.clear()
+            self._open = False
+
+    def _rebuild_mutex_map(self) -> None:
+        self._mutex_map = {}
+        for row_id, rb in self._rows.items():
+            for p in rb.to_positions():
+                self._mutex_map[int(p)] = row_id
+
+    # ------------------------------------------------------------------
+    # reads (host metadata; bit math lives on device)
+    # ------------------------------------------------------------------
+
+    def row_ids(self) -> List[int]:
+        with self._mu:
+            return sorted(self._rows)
+
+    def has_row(self, row_id: int) -> bool:
+        return row_id in self._rows
+
+    def max_row_id(self) -> Optional[int]:
+        with self._mu:
+            return max(self._rows) if self._rows else None
+
+    def min_row_id(self) -> Optional[int]:
+        with self._mu:
+            return min(self._rows) if self._rows else None
+
+    def row_words(self, row_id: int) -> np.ndarray:
+        """Host dense words for one row (zeros if absent)."""
+        with self._mu:
+            rb = self._rows.get(row_id)
+            return rb.to_words() if rb is not None else ob.empty_row()
+
+    def row_positions(self, row_id: int) -> np.ndarray:
+        with self._mu:
+            rb = self._rows.get(row_id)
+            return rb.to_positions() if rb is not None else np.empty(0, np.uint32)
+
+    def row_device(self, row_id: int) -> jax.Array:
+        """Device-resident dense row; cached until the row mutates."""
+        with self._mu:
+            arr = self._dev.get(row_id)
+            if arr is None:
+                arr = jax.device_put(self.row_words(row_id))
+                self._dev[row_id] = arr
+            return arr
+
+    def rows_device(self, row_ids: Iterable[int]) -> jax.Array:
+        """Stacked [k, W] device matrix for the given rows."""
+        import jax.numpy as jnp
+
+        return jnp.stack([self.row_device(r) for r in row_ids])
+
+    def contains(self, row_id: int, col: int) -> bool:
+        with self._mu:
+            rb = self._rows.get(row_id)
+            return rb is not None and rb.contains(col % SHARD_WIDTH)
+
+    def row_count(self, row_id: int) -> int:
+        """Cardinality of one row (host metadata; used by caches/imports)."""
+        with self._mu:
+            rb = self._rows.get(row_id)
+            return rb.count() if rb is not None else 0
+
+    # ------------------------------------------------------------------
+    # writes — everything funnels through import_positions
+    # ------------------------------------------------------------------
+
+    def set_bit(self, row_id: int, col: int) -> bool:
+        """Set one bit; col is the in-shard position OR an absolute column
+        belonging to this shard. Returns True if it changed.
+        (reference: fragment.go:647 setBit)"""
+        pos = self._pos(row_id, col)
+        if self._mutex_map is not None:
+            return self._set_bit_mutex(row_id, col % SHARD_WIDTH)
+        changed, _ = self.import_positions(np.array([pos], np.uint64), None)
+        return changed > 0
+
+    def clear_bit(self, row_id: int, col: int) -> bool:
+        pos = self._pos(row_id, col)
+        _, cleared = self.import_positions(None, np.array([pos], np.uint64))
+        return cleared > 0
+
+    def _set_bit_mutex(self, row_id: int, in_shard: int) -> bool:
+        with self._mu:
+            existing = self._mutex_map.get(in_shard)
+            if existing == row_id:
+                return False
+            to_clear = None
+            if existing is not None:
+                to_clear = np.array([existing * SHARD_WIDTH + in_shard], np.uint64)
+            to_set = np.array([row_id * SHARD_WIDTH + in_shard], np.uint64)
+            changed, _ = self.import_positions(to_set, to_clear)
+            self._mutex_map[in_shard] = row_id
+            return changed > 0
+
+    def import_positions(
+        self, to_set: Optional[np.ndarray], to_clear: Optional[np.ndarray]
+    ) -> Tuple[int, int]:
+        """Batched bit mutation by fragment position; the single write path
+        (reference: fragment.go:2053 importPositions). Returns
+        (n_set_changed, n_clear_changed)."""
+        with self._mu:
+            n_set = n_clear = 0
+            if to_set is not None and len(to_set):
+                self._wal_append(walmod.OP_SET, to_set)
+            if to_clear is not None and len(to_clear):
+                self._wal_append(walmod.OP_CLEAR, to_clear)
+            n_set, n_clear = self._apply_positions(
+                to_set if to_set is not None else np.empty(0, np.uint64),
+                to_clear if to_clear is not None else np.empty(0, np.uint64),
+            )
+            self._op_n += n_set + n_clear
+            if self._op_n > self.max_op_n:
+                self.snapshot()
+            return n_set, n_clear
+
+    def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
+        n_set = n_clear = 0
+        if len(to_set):
+            rows = (to_set // SHARD_WIDTH).astype(np.int64)
+            cols = (to_set % SHARD_WIDTH).astype(np.uint32)
+            for row_id in np.unique(rows):
+                rb = self._rows.get(int(row_id))
+                if rb is None:
+                    rb = self._rows[int(row_id)] = RowBits(SHARD_WIDTH)
+                n_set += rb.add(cols[rows == row_id])
+                self._dev.pop(int(row_id), None)
+        if len(to_clear):
+            rows = (to_clear // SHARD_WIDTH).astype(np.int64)
+            cols = (to_clear % SHARD_WIDTH).astype(np.uint32)
+            for row_id in np.unique(rows):
+                rb = self._rows.get(int(row_id))
+                if rb is None:
+                    continue
+                n_clear += rb.discard(cols[rows == row_id])
+                self._dev.pop(int(row_id), None)
+        return n_set, n_clear
+
+    def _wal_append(self, op: int, positions: np.ndarray) -> None:
+        if self._wal is not None:
+            self._wal.append(op, positions)
+
+    def _pos(self, row_id: int, col: int) -> int:
+        if col >= SHARD_WIDTH:
+            min_col = self.shard * SHARD_WIDTH
+            if not min_col <= col < min_col + SHARD_WIDTH:
+                raise ValueError(f"column {col} out of bounds for shard {self.shard}")
+        return row_id * SHARD_WIDTH + (col % SHARD_WIDTH)
+
+    def bulk_import(self, row_ids: np.ndarray, cols: np.ndarray, clear: bool = False) -> int:
+        """Batched standard import (reference: fragment.go:1997 bulkImport /
+        :2011 bulkImportStandard). cols may be absolute or in-shard."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(cols, dtype=np.uint64) % SHARD_WIDTH
+        positions = row_ids * SHARD_WIDTH + cols
+        if self._mutex_map is not None and not clear:
+            return self._bulk_import_mutex(row_ids, cols)
+        if clear:
+            _, n = self.import_positions(None, positions)
+        else:
+            n, _ = self.import_positions(positions, None)
+        return n
+
+    def _bulk_import_mutex(self, row_ids: np.ndarray, cols: np.ndarray) -> int:
+        """Mutex import: last write per column wins
+        (reference: fragment.go:2106 bulkImportMutex)."""
+        with self._mu:
+            # keep last occurrence per column
+            _, last_idx = np.unique(cols[::-1], return_index=True)
+            idx = len(cols) - 1 - last_idx
+            to_set = []
+            to_clear = []
+            for i in idx:
+                col, row = int(cols[i]), int(row_ids[i])
+                existing = self._mutex_map.get(col)
+                if existing == row:
+                    continue
+                if existing is not None:
+                    to_clear.append(existing * SHARD_WIDTH + col)
+                to_set.append(row * SHARD_WIDTH + col)
+                self._mutex_map[col] = row
+            n, _ = self.import_positions(
+                np.array(to_set, np.uint64) if to_set else None,
+                np.array(to_clear, np.uint64) if to_clear else None,
+            )
+            return n
+
+    # ------------------------------------------------------------------
+    # BSI (int fields) — reference: fragment.go:932-1110, ladders in ops/bsi
+    # ------------------------------------------------------------------
+
+    def set_value(self, col: int, bit_depth: int, value: int, clear: bool = False) -> bool:
+        """Sign+magnitude write (reference: fragment.go:936 positionsForValue)."""
+        in_shard = col % SHARD_WIDTH
+        uvalue = abs(value)
+        to_set: List[int] = []
+        to_clear: List[int] = []
+        (to_clear if clear else to_set).append(BSI_EXISTS_BIT * SHARD_WIDTH + in_shard)
+        (to_clear if (value >= 0 or clear) else to_set).append(
+            BSI_SIGN_BIT * SHARD_WIDTH + in_shard
+        )
+        for i in range(bit_depth):
+            p = (BSI_OFFSET_BIT + i) * SHARD_WIDTH + in_shard
+            (to_set if (uvalue >> i) & 1 and not clear else to_clear).append(p)
+        n_set, n_clear = self.import_positions(
+            np.array(to_set, np.uint64), np.array(to_clear, np.uint64)
+        )
+        return (n_set + n_clear) > 0
+
+    def import_values(self, cols: np.ndarray, values: np.ndarray, bit_depth: int) -> None:
+        """Columnar BSI import: transpose columns×values into per-plane row
+        sets (reference: fragment.go:2205 importValue)."""
+        cols = np.asarray(cols, dtype=np.uint64) % SHARD_WIDTH
+        values = np.asarray(values, dtype=np.int64)
+        # last write per column wins
+        _, last_idx = np.unique(cols[::-1], return_index=True)
+        idx = len(cols) - 1 - last_idx
+        cols, values = cols[idx], values[idx]
+        mags = np.abs(values).astype(np.uint64)
+        to_set = [BSI_EXISTS_BIT * SHARD_WIDTH + cols]
+        to_clear = []
+        neg = values < 0
+        to_set.append(BSI_SIGN_BIT * SHARD_WIDTH + cols[neg])
+        to_clear.append(BSI_SIGN_BIT * SHARD_WIDTH + cols[~neg])
+        for i in range(bit_depth):
+            has = (mags >> np.uint64(i)) & np.uint64(1) != 0
+            base = (BSI_OFFSET_BIT + i) * SHARD_WIDTH
+            to_set.append(base + cols[has])
+            to_clear.append(base + cols[~has])
+        self.import_positions(np.concatenate(to_set), np.concatenate(to_clear))
+
+    def value(self, col: int, bit_depth: int) -> Tuple[int, bool]:
+        """Read one column's BSI value (host point-read;
+        reference: fragment.go:896)."""
+        with self._mu:
+            in_shard = col % SHARD_WIDTH
+            if not self.contains(BSI_EXISTS_BIT, in_shard):
+                return 0, False
+            v = 0
+            for i in range(bit_depth):
+                if self.contains(BSI_OFFSET_BIT + i, in_shard):
+                    v |= 1 << i
+            if self.contains(BSI_SIGN_BIT, in_shard):
+                v = -v
+            return v, True
+
+    def _bsi_stack(self, bit_depth: int):
+        planes = self.rows_device(range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth))
+        exists = self.row_device(BSI_EXISTS_BIT)
+        sign = self.row_device(BSI_SIGN_BIT)
+        return planes, exists, sign
+
+    _FULL_FILTER = None
+
+    @classmethod
+    def _full_filter(cls) -> jax.Array:
+        if cls._FULL_FILTER is None or cls._FULL_FILTER.shape != (SHARD_WIDTH // 32,):
+            cls._FULL_FILTER = jax.device_put(
+                np.full(SHARD_WIDTH // 32, 0xFFFFFFFF, dtype=np.uint32)
+            )
+        return cls._FULL_FILTER
+
+    def sum(self, filter_words, bit_depth: int) -> Tuple[int, int]:
+        """(sum of stored base-values, count) — device per-plane counts,
+        exact host combine (reference: fragment.go:1111)."""
+        planes, exists, sign = self._bsi_stack(bit_depth)
+        filt = filter_words if filter_words is not None else self._full_filter()
+        count, pos_counts, neg_counts = obsi.sum_counts(planes, exists, sign, filt, bit_depth)
+        pos_counts = np.asarray(pos_counts)
+        neg_counts = np.asarray(neg_counts)
+        total = sum(
+            (1 << i) * (int(pos_counts[i]) - int(neg_counts[i])) for i in range(bit_depth)
+        )
+        return total, int(count)
+
+    def min(self, filter_words, bit_depth: int) -> Tuple[int, int]:
+        """(min stored value, count attaining it) — reference: fragment.go:1146."""
+        import jax.numpy as jnp
+
+        planes, exists, sign = self._bsi_stack(bit_depth)
+        filt = filter_words if filter_words is not None else self._full_filter()
+        consider = ob.b_and(exists, filt)
+        if int(ob.popcount(consider)) == 0:
+            return 0, 0
+        negatives = ob.b_and(consider, sign)
+        if int(ob.popcount(negatives)) > 0:
+            mval, final = obsi.max_unsigned(planes, negatives, bit_depth)
+            return -int(mval), int(ob.popcount(final))
+        mval, final = obsi.min_unsigned(planes, consider, bit_depth)
+        return int(mval), int(ob.popcount(final))
+
+    def max(self, filter_words, bit_depth: int) -> Tuple[int, int]:
+        """(max stored value, count attaining it) — reference: fragment.go:1191."""
+        planes, exists, sign = self._bsi_stack(bit_depth)
+        filt = filter_words if filter_words is not None else self._full_filter()
+        consider = ob.b_and(exists, filt)
+        if int(ob.popcount(consider)) == 0:
+            return 0, 0
+        positives = ob.b_andnot(consider, sign)
+        if int(ob.popcount(positives)) == 0:
+            mval, final = obsi.min_unsigned(planes, consider, bit_depth)
+            return -int(mval), int(ob.popcount(final))
+        mval, final = obsi.max_unsigned(planes, positives, bit_depth)
+        return int(mval), int(ob.popcount(final))
+
+    def range_op(self, op: str, bit_depth: int, predicate: int) -> jax.Array:
+        """Device words of columns whose stored value satisfies `op predicate`
+        (reference: fragment.go:1273 rangeOp). op in {eq,neq,lt,lte,gt,gte}."""
+        planes, exists, sign = self._bsi_stack(bit_depth)
+        upred = np.uint32(abs(predicate))
+        if op == "eq" or op == "neq":
+            base = (
+                ob.b_and(exists, sign) if predicate < 0 else ob.b_andnot(exists, sign)
+            )
+            eq = obsi.range_eq_unsigned(base, planes, upred, bit_depth)
+            if op == "eq":
+                return eq
+            return ob.b_andnot(exists, eq)
+        # Sign decomposition. Note: the reference folds predicate -1/0 strict
+        # cases into the positive-side ladder (fragment.go:1332,1405
+        # `predicate >= -1 && !allowEquality`), which mis-handles e.g.
+        # `> -1` (drops 0 and 1) and `< -1` (includes 0 and -1). We use the
+        # exact decomposition instead:
+        #   v <  p, p <= 0: negatives with mag > |p|   (strict/eq via allow_eq)
+        #   v <  p, p  > 0: positives with mag < p, plus all negatives
+        #   v >  p, p >= 0: positives with mag > p
+        #   v >  p, p  < 0: negatives with mag < |p|, plus all positives
+        positives = ob.b_andnot(exists, sign)
+        negatives = ob.b_and(exists, sign)
+        if op in ("lt", "lte"):
+            allow_eq = op == "lte"
+            if predicate > 0 or (predicate == 0 and allow_eq):
+                pos = obsi.range_lt_unsigned(positives, planes, upred, bit_depth, allow_eq)
+                return ob.b_or(negatives, pos)
+            if predicate == 0:  # strict < 0
+                return negatives
+            return obsi.range_gt_unsigned(negatives, planes, upred, bit_depth, allow_eq)
+        if op in ("gt", "gte"):
+            allow_eq = op == "gte"
+            if predicate > 0 or (predicate == 0 and allow_eq):
+                return obsi.range_gt_unsigned(positives, planes, upred, bit_depth, allow_eq)
+            if predicate == 0:  # strict > 0
+                return obsi.range_gt_unsigned(positives, planes, upred, bit_depth, False)
+            neg = obsi.range_lt_unsigned(negatives, planes, upred, bit_depth, allow_eq)
+            return ob.b_or(positives, neg)
+        raise ValueError(f"invalid range op {op!r}")
+
+    def range_between(self, bit_depth: int, pmin: int, pmax: int) -> jax.Array:
+        """Columns with pmin <= value <= pmax (reference: fragment.go:1463)."""
+        planes, exists, sign = self._bsi_stack(bit_depth)
+        umin, umax = np.uint32(abs(pmin)), np.uint32(abs(pmax))
+        positives = ob.b_andnot(exists, sign)
+        negatives = ob.b_and(exists, sign)
+        if pmin >= 0:
+            return obsi.range_between_unsigned(positives, planes, umin, umax, bit_depth)
+        if pmax < 0:
+            return obsi.range_between_unsigned(negatives, planes, umax, umin, bit_depth)
+        pos = obsi.range_lt_unsigned(positives, planes, umax, bit_depth, True)
+        neg = obsi.range_lt_unsigned(negatives, planes, umin, bit_depth, True)
+        return ob.b_or(pos, neg)
+
+    def not_null(self) -> jax.Array:
+        return self.row_device(BSI_EXISTS_BIT)
+
+    # ------------------------------------------------------------------
+    # TopN support: batched row cardinalities on device
+    # ------------------------------------------------------------------
+
+    def row_counts(
+        self, row_ids: List[int], filter_words=None, chunk: int = 256
+    ) -> np.ndarray:
+        """Cardinality of each listed row (optionally intersected with a
+        filter), computed on device in chunks (reference: fragment.go:1570
+        top; rank cache comes later at the field layer)."""
+        import jax.numpy as jnp
+
+        out = np.empty(len(row_ids), dtype=np.uint64)
+        for i in range(0, len(row_ids), chunk):
+            ids = row_ids[i : i + chunk]
+            stack = self.rows_device(ids)
+            if filter_words is not None:
+                counts = ob.count_and_rows(stack, filter_words)
+            else:
+                counts = ob.popcount_rows(stack)
+            out[i : i + len(ids)] = np.asarray(counts, dtype=np.uint64)
+        return out
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> None:
+        """Write full snapshot and reset the WAL
+        (reference: fragment.go:2337-2395)."""
+        with self._mu:
+            if self.path is None:
+                self._op_n = 0
+                return
+            walmod.write_snapshot(self.snap_path, self.shard, SHARD_WIDTH, self._rows)
+            if self._wal is not None:
+                self._wal.truncate()
+            self._op_n = 0

@@ -1,0 +1,107 @@
+"""Holder: node-level root of the storage tree.
+
+Reference: /root/reference/holder.go — indexes map, open/close lifecycle
+(holder.go:50,137). The anti-entropy syncer/cleaner equivalents live in the
+cluster layer."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional
+
+from pilosa_tpu.core.index import Index
+
+
+class Holder:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path  # data directory; None => in-memory
+        self._mu = threading.RLock()
+        self._indexes: Dict[str, Index] = {}
+
+    def open(self) -> "Holder":
+        if self.path is not None:
+            os.makedirs(self.path, exist_ok=True)
+            for name in sorted(os.listdir(self.path)):
+                idx_dir = os.path.join(self.path, name)
+                if os.path.isdir(idx_dir) and os.path.exists(
+                    os.path.join(idx_dir, ".meta.json")
+                ):
+                    self._indexes[name] = Index(idx_dir, name).open()
+        return self
+
+    def close(self) -> None:
+        with self._mu:
+            for idx in self._indexes.values():
+                idx.close()
+            self._indexes.clear()
+
+    def _index_path(self, name: str) -> Optional[str]:
+        return None if self.path is None else os.path.join(self.path, name)
+
+    def create_index(
+        self, name: str, *, keys: bool = False, track_existence: bool = True
+    ) -> Index:
+        with self._mu:
+            if name in self._indexes:
+                raise ValueError(f"index already exists: {name}")
+            idx = Index(
+                self._index_path(name),
+                name,
+                keys=keys,
+                track_existence=track_existence,
+            ).open()
+            self._indexes[name] = idx
+            return idx
+
+    def create_index_if_not_exists(self, name: str, **kw) -> Index:
+        with self._mu:
+            if name in self._indexes:
+                return self._indexes[name]
+            return self.create_index(name, **kw)
+
+    def index(self, name: str) -> Optional[Index]:
+        return self._indexes.get(name)
+
+    def indexes(self) -> List[Index]:
+        with self._mu:
+            return [self._indexes[n] for n in sorted(self._indexes)]
+
+    def delete_index(self, name: str) -> None:
+        with self._mu:
+            idx = self._indexes.pop(name, None)
+            if idx is None:
+                raise KeyError(f"index not found: {name}")
+            idx.close()
+            if idx.path is not None:
+                shutil.rmtree(idx.path, ignore_errors=True)
+
+    def schema(self) -> List[dict]:
+        """Schema description (reference: holder Schema / http /schema)."""
+        out = []
+        for idx in self.indexes():
+            fields = []
+            for f in idx.fields():
+                o = f.options
+                fields.append(
+                    {
+                        "name": f.name,
+                        "options": {
+                            "type": o.type,
+                            "cacheType": o.cache_type,
+                            "cacheSize": o.cache_size,
+                            "min": o.min,
+                            "max": o.max,
+                            "base": o.base,
+                            "bitDepth": o.bit_depth,
+                            "timeQuantum": o.time_quantum,
+                            "keys": o.keys,
+                            "noStandardView": o.no_standard_view,
+                        },
+                    }
+                )
+            out.append(
+                {"name": idx.name, "options": {"keys": idx.keys}, "fields": fields}
+            )
+        return out

@@ -1,0 +1,155 @@
+"""Index: per-index namespace of fields + existence tracking.
+
+Reference: /root/reference/index.go — fields map (index.go:37), `_exists`
+existence field for Not()/existence queries (holder.go:46, index.go:215),
+AvailableShards union over fields (index.go:292)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_SET,
+    Field,
+    FieldOptions,
+    validate_name,
+)
+
+EXISTENCE_FIELD_NAME = "_exists"  # reference: existenceFieldName, holder.go:46
+
+
+class Index:
+    def __init__(
+        self,
+        path: Optional[str],
+        name: str,
+        *,
+        keys: bool = False,
+        track_existence: bool = True,
+    ):
+        validate_name(name)
+        self.path = path
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self._mu = threading.RLock()
+        self._fields: Dict[str, Field] = {}
+
+    # ------------------------------------------------------------------
+
+    @property
+    def meta_path(self) -> Optional[str]:
+        return None if self.path is None else os.path.join(self.path, ".meta.json")
+
+    def open(self) -> "Index":
+        if self.path is not None:
+            os.makedirs(self.path, exist_ok=True)
+            if os.path.exists(self.meta_path):
+                with open(self.meta_path) as f:
+                    data = json.load(f)
+                self.keys = data.get("keys", self.keys)
+                self.track_existence = data.get("track_existence", self.track_existence)
+            else:
+                self.save_meta()
+            for fn in sorted(os.listdir(self.path)):
+                fdir = os.path.join(self.path, fn)
+                if os.path.isdir(fdir) and os.path.exists(
+                    os.path.join(fdir, ".meta.json")
+                ):
+                    f = Field(fdir, self.name, fn, FieldOptions()).open()
+                    self._fields[fn] = f
+        if self.track_existence and EXISTENCE_FIELD_NAME not in self._fields:
+            self._create_existence_field()
+        return self
+
+    def close(self) -> None:
+        with self._mu:
+            for f in self._fields.values():
+                f.close()
+
+    def save_meta(self) -> None:
+        if self.path is None:
+            return
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"keys": self.keys, "track_existence": self.track_existence}, f
+            )
+        os.replace(tmp, self.meta_path)
+
+    # ------------------------------------------------------------------
+
+    def _field_path(self, name: str) -> Optional[str]:
+        return None if self.path is None else os.path.join(self.path, name)
+
+    def _create_existence_field(self) -> Field:
+        f = Field(
+            self._field_path(EXISTENCE_FIELD_NAME),
+            self.name,
+            EXISTENCE_FIELD_NAME,
+            FieldOptions(type=FIELD_TYPE_SET, cache_type="none", cache_size=0),
+        )
+        f.open()
+        self._fields[EXISTENCE_FIELD_NAME] = f
+        return f
+
+    def create_field(self, name: str, options: Optional[FieldOptions] = None) -> Field:
+        with self._mu:
+            validate_name(name)
+            if name in self._fields:
+                raise ValueError(f"field already exists: {name}")
+            f = Field(self._field_path(name), self.name, name, options or FieldOptions())
+            f.open()
+            self._fields[name] = f
+            return f
+
+    def create_field_if_not_exists(
+        self, name: str, options: Optional[FieldOptions] = None
+    ) -> Field:
+        with self._mu:
+            if name in self._fields:
+                return self._fields[name]
+            return self.create_field(name, options)
+
+    def field(self, name: str) -> Optional[Field]:
+        return self._fields.get(name)
+
+    def fields(self, include_hidden: bool = False) -> List[Field]:
+        with self._mu:
+            return [
+                f
+                for n, f in sorted(self._fields.items())
+                if include_hidden or not n.startswith("_")
+            ]
+
+    def delete_field(self, name: str) -> None:
+        with self._mu:
+            f = self._fields.pop(name, None)
+            if f is None:
+                raise KeyError(f"field not found: {name}")
+            f.close()
+            if f.path is not None:
+                import shutil
+
+                shutil.rmtree(f.path, ignore_errors=True)
+
+    def existence_field(self) -> Optional[Field]:
+        return self._fields.get(EXISTENCE_FIELD_NAME) if self.track_existence else None
+
+    def track_columns(self, cols: np.ndarray) -> None:
+        """Mark columns as existing (row 0 of `_exists`; index.go:215)."""
+        ef = self.existence_field()
+        if ef is not None and len(cols):
+            ef.import_bits(np.zeros(len(cols), np.uint64), cols)
+
+    def available_shards(self) -> Set[int]:
+        with self._mu:
+            shards: Set[int] = set()
+            for f in self._fields.values():
+                shards.update(f.available_shards())
+            return shards

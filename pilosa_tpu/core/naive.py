@@ -104,10 +104,7 @@ class NaiveBitmap:
 
     def flip(self, start: int, stop: int) -> "NaiveBitmap":
         """Flip bits in [start, stop] inclusive (reference flip semantics)."""
-        out = set(self._bits)
-        for p in range(start, stop + 1):
-            out.symmetric_difference_update({p})
-        return NaiveBitmap(out)
+        return NaiveBitmap(self._bits ^ set(range(start, stop + 1)))
 
     def offset_range(self, offset: int, start: int, end: int) -> "NaiveBitmap":
         """Positions in [start, end) rebased to offset (reference:

@@ -1,0 +1,114 @@
+"""View: groups fragments by shard for one "view" of a field.
+
+Reference: /root/reference/view.go — view names are `standard`, time-quantum
+views (`standard_2019`, `standard_201907`, ...), and `bsig_<field>` for BSI
+groups (view.go:37-41)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+class View:
+    def __init__(
+        self,
+        name: str,
+        index: str,
+        field: str,
+        path: Optional[str],
+        *,
+        mutex: bool = False,
+        max_op_n: int = 10_000,
+    ):
+        self.name = name
+        self.index = index
+        self.field = field
+        self.path = path  # directory holding fragments/; None => in-memory
+        self.mutex = mutex
+        self.max_op_n = max_op_n
+        self._mu = threading.RLock()
+        self.fragments: Dict[int, Fragment] = {}
+
+    def open(self) -> "View":
+        """Load existing fragments from disk (view.go:120 openFragments)."""
+        if self.path is not None:
+            frag_dir = os.path.join(self.path, "fragments")
+            if os.path.isdir(frag_dir):
+                for fn in sorted(os.listdir(frag_dir)):
+                    if fn.endswith(".snap") or fn.endswith(".wal"):
+                        shard_s = fn.rsplit(".", 1)[0]
+                        if shard_s.isdigit():
+                            self.fragment(int(shard_s))
+        return self
+
+    def close(self) -> None:
+        with self._mu:
+            for frag in self.fragments.values():
+                frag.close()
+
+    def _fragment_path(self, shard: int) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, "fragments", str(shard))
+
+    def fragment(self, shard: int) -> Fragment:
+        """Get-or-create the fragment for a shard (view.go:263
+        CreateFragmentIfNotExists)."""
+        with self._mu:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                frag = Fragment(
+                    self._fragment_path(shard),
+                    self.index,
+                    self.field,
+                    self.name,
+                    shard,
+                    mutex=self.mutex,
+                    max_op_n=self.max_op_n,
+                ).open()
+                self.fragments[shard] = frag
+            return frag
+
+    def fragment_if_exists(self, shard: int) -> Optional[Fragment]:
+        return self.fragments.get(shard)
+
+    def available_shards(self) -> List[int]:
+        with self._mu:
+            return sorted(self.fragments)
+
+    # -- fan-down helpers (view.go:367-474) --------------------------------
+
+    def set_bit(self, row_id: int, col: int) -> bool:
+        return self.fragment(col // SHARD_WIDTH).set_bit(row_id, col)
+
+    def clear_bit(self, row_id: int, col: int) -> bool:
+        frag = self.fragment_if_exists(col // SHARD_WIDTH)
+        return frag.clear_bit(row_id, col) if frag is not None else False
+
+    def set_value(self, col: int, bit_depth: int, value: int, clear: bool = False) -> bool:
+        return self.fragment(col // SHARD_WIDTH).set_value(col, bit_depth, value, clear)
+
+    def value(self, col: int, bit_depth: int):
+        frag = self.fragment_if_exists(col // SHARD_WIDTH)
+        if frag is None:
+            return 0, False
+        return frag.value(col, bit_depth)
+
+    def row_positions(self, row_id: int) -> np.ndarray:
+        """Absolute columns of a row across all shards (host; for exports)."""
+        cols = []
+        for shard in self.available_shards():
+            p = self.fragments[shard].row_positions(row_id)
+            if len(p):
+                cols.append(p.astype(np.uint64) + np.uint64(shard) * np.uint64(SHARD_WIDTH))
+        return np.concatenate(cols) if cols else np.empty(0, np.uint64)

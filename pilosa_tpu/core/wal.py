@@ -1,0 +1,112 @@
+"""Fragment persistence: snapshot files + append-only op log (WAL).
+
+Reference model: a fragment persists as a full roaring serialization with ops
+appended after the snapshot section, replayed on open (fragment.go:311-458
+openStorage, roaring.go:4662-4692 op apply, writeOp at :1612). Crash safety
+comes from temp-file + atomic rename (.snapshotting/.temp extensions,
+fragment.go:68-78).
+
+Here the snapshot is our own dense-block dialect (the roaring interchange
+format lives separately in core/roaring_io.py for import/export compat), and
+the WAL is a separate sidecar file of batched set/clear records, each
+CRC-guarded so a torn tail is detected and discarded on replay.
+
+Snapshot file (.snap):
+    magic  b"PTSNAP01"
+    u64 shard, u64 n_bits, u64 n_rows
+    n_rows * ( u64 row_id, u8 rep, u64 n_items, payload uint32[n_items] )
+
+WAL file (.wal), per record:
+    u32 magic 0x5054574C ("PTWL"), u8 op (0=set 1=clear), u32 n,
+    u32 crc32(payload), payload = uint64[n] fragment positions
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+from pilosa_tpu.core.rowstore import RowBits
+
+SNAP_MAGIC = b"PTSNAP01"
+WAL_MAGIC = 0x5054574C
+OP_SET = 0
+OP_CLEAR = 1
+
+_REC_HDR = struct.Struct("<IBII")
+
+
+def write_snapshot(path: str, shard: int, n_bits: int, rows: Dict[int, RowBits]) -> None:
+    """Atomically write a full snapshot (temp file + rename)."""
+    tmp = path + ".snapshotting"
+    with open(tmp, "wb") as f:
+        f.write(SNAP_MAGIC)
+        f.write(struct.pack("<QQQ", shard, n_bits, len(rows)))
+        for row_id in sorted(rows):
+            rb = rows[row_id]
+            payload = rb.payload()
+            f.write(struct.pack("<QBQ", row_id, rb.rep(), len(payload)))
+            f.write(payload.astype(np.uint32, copy=False).tobytes())
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_snapshot(path: str) -> Tuple[int, int, Dict[int, RowBits]]:
+    """Read a snapshot; returns (shard, n_bits, rows)."""
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != SNAP_MAGIC:
+            raise ValueError(f"{path}: bad snapshot magic {magic!r}")
+        shard, n_bits, n_rows = struct.unpack("<QQQ", f.read(24))
+        rows: Dict[int, RowBits] = {}
+        for _ in range(n_rows):
+            row_id, rep, n_items = struct.unpack("<QBQ", f.read(17))
+            payload = np.frombuffer(f.read(n_items * 4), dtype=np.uint32)
+            rows[row_id] = RowBits.from_payload(n_bits, rep, payload)
+    return shard, n_bits, rows
+
+
+class WalWriter:
+    """Append-only op log. One writer per fragment (single-writer, like the
+    reference's per-fragment storage lock)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "ab")
+
+    def append(self, op: int, positions: np.ndarray) -> None:
+        payload = np.asarray(positions, dtype=np.uint64).tobytes()
+        rec = _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))
+        self._f.write(rec + payload)
+        self._f.flush()
+
+    def truncate(self) -> None:
+        """Reset after a snapshot has absorbed all ops."""
+        self._f.truncate(0)
+        self._f.seek(0)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def replay_wal(path: str) -> Iterator[Tuple[int, np.ndarray]]:
+    """Yield (op, positions) records; stops cleanly at a torn/corrupt tail."""
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_REC_HDR.size)
+            if len(hdr) < _REC_HDR.size:
+                return
+            magic, op, n, crc = _REC_HDR.unpack(hdr)
+            if magic != WAL_MAGIC:
+                return
+            payload = f.read(n * 8)
+            if len(payload) < n * 8 or zlib.crc32(payload) != crc:
+                return
+            yield op, np.frombuffer(payload, dtype=np.uint64)

@@ -87,8 +87,8 @@ def b_not(a, exists):
     return jnp.bitwise_and(jnp.bitwise_not(a), exists)
 
 
-# Count convention: one (row, shard) holds at most SHARD_WIDTH <= 2^32 bits, so
-# a per-row popcount always fits uint32. Cross-row / cross-shard totals can
+# Count convention: one (row, shard) holds at most SHARD_WIDTH <= 2^30 bits
+# (shardwidth.py caps the exponent), so a per-row popcount always fits uint32. Cross-row / cross-shard totals can
 # exceed 2^32; the *_rows variants below are therefore the query-path API — the
 # executor reduces the per-row partials host-side in exact Python ints
 # (mirroring the reference's reduceFn merges, executor.go:2489), and the mesh

@@ -1,0 +1,227 @@
+"""Device BSI (bit-sliced index) arithmetic.
+
+TPU-native port of the reference's per-fragment BSI loops
+(/root/reference/fragment.go:1111-1538: sum, minUnsigned/maxUnsigned,
+rangeEQ/NEQ/LT/GT/Between ladders). Values are stored sign+magnitude
+(fragment.go:936-1041 positionsForValue): plane layout follows
+fragment.go:88-96 — row 0 = exists (not-null), row 1 = sign, rows 2.. =
+magnitude bit planes (handled by the fragment layer; functions here receive
+the plane stack directly).
+
+Layout here: `planes: uint32[bit_depth, W]` (plane i = bit i of magnitude),
+`exists/sign/filter: uint32[W]` dense word rows. The sequential Go ladders
+become unrolled elementwise XLA programs: `bit_depth` is static (compile-time
+unrolled, one fused kernel), the *predicate* is traced, so one compiled
+program serves every query at a given depth. Branches on predicate bits
+become `jnp.where` selects — both sides are cheap elementwise ops, and XLA
+fuses the whole ladder into a single pass over HBM.
+
+Counts return as per-plane uint32 partials; hosts combine with exact Python
+ints (see the count convention in ops/bitmap.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_pc = jax.lax.population_count
+
+
+def _count(words):
+    """uint32 popcount over the trailing axis (a single row's words)."""
+    return jnp.sum(_pc(words), dtype=jnp.uint32)
+
+
+@partial(jax.jit, static_argnames=("bit_depth",))
+def sum_counts(planes, exists, sign, filter_words, bit_depth: int):
+    """Per-plane intersection counts for BSI sum (fragment.go:1111).
+
+    Returns (count, pos_counts[bit_depth], neg_counts[bit_depth]); the host
+    computes sum = Σ 2^i * (pos[i] - neg[i]) in exact Python ints.
+    filter_words of all-ones means "no filter".
+    """
+    consider = jnp.bitwise_and(exists, filter_words)
+    nrow = jnp.bitwise_and(sign, consider)
+    prow = jnp.bitwise_and(consider, jnp.bitwise_not(sign))
+    count = _count(consider)
+    pos_counts = jnp.stack([_count(jnp.bitwise_and(planes[i], prow)) for i in range(bit_depth)])
+    neg_counts = jnp.stack([_count(jnp.bitwise_and(planes[i], nrow)) for i in range(bit_depth)])
+    return count, pos_counts, neg_counts
+
+
+@partial(jax.jit, static_argnames=("bit_depth",))
+def min_unsigned(planes, filter_words, bit_depth: int):
+    """Lowest magnitude among filter columns (fragment.go:1173 minUnsigned).
+
+    Returns (min_value uint32, final_filter_words). The count of columns
+    attaining the min is popcount(final_filter) — computed by the caller.
+    """
+    filt = filter_words
+    mval = jnp.uint32(0)
+    for i in reversed(range(bit_depth)):
+        row = jnp.bitwise_and(filt, jnp.bitwise_not(planes[i]))
+        c = _count(row)
+        nonzero = c > 0
+        filt = jnp.where(nonzero, row, filt)
+        mval = mval + jnp.where(nonzero, jnp.uint32(0), jnp.uint32(1) << i)
+    return mval, filt
+
+
+@partial(jax.jit, static_argnames=("bit_depth",))
+def max_unsigned(planes, filter_words, bit_depth: int):
+    """Highest magnitude among filter columns (fragment.go:1215 maxUnsigned)."""
+    filt = filter_words
+    mval = jnp.uint32(0)
+    for i in reversed(range(bit_depth)):
+        row = jnp.bitwise_and(planes[i], filt)
+        c = _count(row)
+        nonzero = c > 0
+        filt = jnp.where(nonzero, row, filt)
+        mval = mval + jnp.where(nonzero, jnp.uint32(1) << i, jnp.uint32(0))
+    return mval, filt
+
+
+# ---------------------------------------------------------------------------
+# Range ladders. All predicates are traced uint32 magnitudes; sign split is
+# done by the caller (fragment layer) exactly as in rangeLT/rangeGT/rangeEQ.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("bit_depth",))
+def range_eq_unsigned(base, planes, upredicate, bit_depth: int):
+    """Columns whose magnitude == upredicate, within base (fragment.go:1288)."""
+    b = base
+    for i in reversed(range(bit_depth)):
+        bit = (upredicate >> jnp.uint32(i)) & jnp.uint32(1)
+        row = planes[i]
+        b = jnp.where(bit == 1, jnp.bitwise_and(b, row), jnp.bitwise_and(b, jnp.bitwise_not(row)))
+    return b
+
+
+@partial(jax.jit, static_argnames=("bit_depth", "allow_equality"))
+def range_lt_unsigned(filter_words, planes, upredicate, bit_depth: int, allow_equality: bool):
+    """Columns with magnitude < (or <=) upredicate (fragment.go:1358
+    rangeLTUnsigned). Fully traced port of the keep/leading-zeros ladder."""
+    filt = filter_words
+    keep = jnp.zeros_like(filter_words)
+    leading_zeros = jnp.bool_(True)
+    for i in reversed(range(bit_depth)):
+        row = planes[i]
+        bit = (upredicate >> jnp.uint32(i)) & jnp.uint32(1)
+        bit_is_zero = bit == 0
+
+        # leading-zeros phase: predicate bit 0 -> drop columns with this bit set.
+        in_lz_skip = jnp.logical_and(leading_zeros, bit_is_zero)
+        filt_lz = jnp.bitwise_and(filt, jnp.bitwise_not(row))
+        leading_zeros = jnp.logical_and(leading_zeros, bit_is_zero)
+
+        if i == 0 and not allow_equality:
+            # If bit is zero: only already-kept columns. If one: remove
+            # exact-match columns (row minus keep). Note: when the predicate is
+            # 0 this returns empty (strict `< 0` has no unsigned solutions);
+            # the reference's ladder would return the 0-valued columns here
+            # (fragment.go:1358 leading-zeros `continue` at i==0) — an edge
+            # quirk we deliberately correct.
+            return jnp.where(
+                bit_is_zero,
+                keep,
+                jnp.bitwise_and(
+                    filt, jnp.bitwise_not(jnp.bitwise_and(row, jnp.bitwise_not(keep)))
+                ),
+            )
+
+        # bit == 0: filter = filter - (row - keep)
+        drop = jnp.bitwise_and(
+            filt, jnp.bitwise_not(jnp.bitwise_and(row, jnp.bitwise_not(keep)))
+        )
+        # bit == 1: keep |= filter - row (not on final iteration)
+        keep_next = (
+            jnp.bitwise_or(keep, jnp.bitwise_and(filt, jnp.bitwise_not(row))) if i > 0 else keep
+        )
+
+        filt = jnp.where(in_lz_skip, filt_lz, jnp.where(bit_is_zero, drop, filt))
+        keep = jnp.where(jnp.logical_or(in_lz_skip, bit_is_zero), keep, keep_next)
+    return filt
+
+
+@partial(jax.jit, static_argnames=("bit_depth", "allow_equality"))
+def range_gt_unsigned(filter_words, planes, upredicate, bit_depth: int, allow_equality: bool):
+    """Columns with magnitude > (or >=) upredicate (fragment.go:1425
+    rangeGTUnsigned)."""
+    filt = filter_words
+    keep = jnp.zeros_like(filter_words)
+    for i in reversed(range(bit_depth)):
+        row = planes[i]
+        bit = (upredicate >> jnp.uint32(i)) & jnp.uint32(1)
+        bit_is_one = bit == 1
+
+        if i == 0 and not allow_equality:
+            # bit one -> only kept columns; bit zero -> remove columns that are
+            # exactly equal: filter - ((filter - row) - keep)
+            eq_removed = jnp.bitwise_and(
+                filt,
+                jnp.bitwise_not(
+                    jnp.bitwise_and(
+                        jnp.bitwise_and(filt, jnp.bitwise_not(row)), jnp.bitwise_not(keep)
+                    )
+                ),
+            )
+            return jnp.where(bit_is_one, keep, eq_removed)
+
+        # bit == 1: filter = filter - ((filter - row) - keep)
+        narrowed = jnp.bitwise_and(
+            filt,
+            jnp.bitwise_not(
+                jnp.bitwise_and(
+                    jnp.bitwise_and(filt, jnp.bitwise_not(row)), jnp.bitwise_not(keep)
+                )
+            ),
+        )
+        # bit == 0: keep |= filter & row (not on final iteration)
+        keep_next = jnp.bitwise_or(keep, jnp.bitwise_and(filt, row)) if i > 0 else keep
+
+        filt = jnp.where(bit_is_one, narrowed, filt)
+        keep = jnp.where(bit_is_one, keep, keep_next)
+    return filt
+
+
+@partial(jax.jit, static_argnames=("bit_depth",))
+def range_between_unsigned(filter_words, planes, umin, umax, bit_depth: int):
+    """Columns with umin <= magnitude <= umax (fragment.go:1506
+    rangeBetweenUnsigned): the GTE and LTE ladders run in one pass."""
+    filt = filter_words
+    keep1 = jnp.zeros_like(filter_words)  # GTE side
+    keep2 = jnp.zeros_like(filter_words)  # LTE side
+    for i in reversed(range(bit_depth)):
+        row = planes[i]
+        bit1 = (umin >> jnp.uint32(i)) & jnp.uint32(1)
+        bit2 = (umax >> jnp.uint32(i)) & jnp.uint32(1)
+
+        # GTE umin
+        narrowed = jnp.bitwise_and(
+            filt,
+            jnp.bitwise_not(
+                jnp.bitwise_and(
+                    jnp.bitwise_and(filt, jnp.bitwise_not(row)), jnp.bitwise_not(keep1)
+                )
+            ),
+        )
+        keep1_next = jnp.bitwise_or(keep1, jnp.bitwise_and(filt, row)) if i > 0 else keep1
+        filt = jnp.where(bit1 == 1, narrowed, filt)
+        keep1 = jnp.where(bit1 == 1, keep1, keep1_next)
+
+        # LTE umax
+        dropped = jnp.bitwise_and(
+            filt, jnp.bitwise_not(jnp.bitwise_and(row, jnp.bitwise_not(keep2)))
+        )
+        keep2_next = (
+            jnp.bitwise_or(keep2, jnp.bitwise_and(filt, jnp.bitwise_not(row)))
+            if i > 0
+            else keep2
+        )
+        filt = jnp.where(bit2 == 0, dropped, filt)
+        keep2 = jnp.where(bit2 == 0, keep2, keep2_next)
+    return filt

@@ -13,10 +13,15 @@ uint32 (not uint64) keeps popcount and bitwise ops native-width on TPU.
 
 import os
 
+# The reference allows exponents 16..32 (shardwidth build tags). We cap at 30:
+# device arithmetic traces range bounds as int32 (x64 stays off for TPU), so
+# in-shard positions must stay below 2^31 — and a 2^30-column shard already
+# exceeds any practical fragment (128 MiB dense per row). Exponent 31/32 would
+# also let a single row's popcount wrap uint32.
 SHARD_WIDTH_EXPONENT = int(os.environ.get("PILOSA_TPU_SHARD_WIDTH_EXPONENT", "20"))
-if not 16 <= SHARD_WIDTH_EXPONENT <= 32:
+if not 16 <= SHARD_WIDTH_EXPONENT <= 30:
     raise ValueError(
-        f"PILOSA_TPU_SHARD_WIDTH_EXPONENT must be in [16, 32], got {SHARD_WIDTH_EXPONENT}"
+        f"PILOSA_TPU_SHARD_WIDTH_EXPONENT must be in [16, 30], got {SHARD_WIDTH_EXPONENT}"
     )
 
 SHARD_WIDTH = 1 << SHARD_WIDTH_EXPONENT

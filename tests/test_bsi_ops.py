@@ -1,0 +1,133 @@
+"""Differential tests for the device BSI ladders vs plain integer math.
+
+Values are assigned to random columns; plane stacks are built exactly as the
+fragment layer will build them (sign+magnitude, fragment.go:936). Every ladder
+output must equal the set computed by naive integer comparison."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitmap as ob
+from pilosa_tpu.ops import bsi as obsi
+
+N_BITS = 1 << 14
+W = N_BITS // 32
+DEPTH = 8
+
+
+def build_planes(values: dict):
+    """values: col -> int (sign+magnitude encodable in DEPTH bits)."""
+    exists = ob.pack_positions(sorted(values), N_BITS)
+    sign = ob.pack_positions(sorted(c for c, v in values.items() if v < 0), N_BITS)
+    planes = np.stack(
+        [
+            ob.pack_positions(
+                sorted(c for c, v in values.items() if (abs(v) >> i) & 1), N_BITS
+            )
+            for i in range(DEPTH)
+        ]
+    )
+    return planes, exists, sign
+
+
+@pytest.fixture
+def values(rng):
+    cols = rng.choice(N_BITS, size=2000, replace=False)
+    vals = rng.integers(-(2**DEPTH) + 1, 2**DEPTH, size=2000)
+    return {int(c): int(v) for c, v in zip(cols, vals)}
+
+
+def to_set(words):
+    return set(ob.unpack_positions(np.asarray(words)).tolist())
+
+
+FULL = np.full(W, 0xFFFFFFFF, dtype=np.uint32)
+
+
+class TestSum:
+    def test_sum_counts(self, values):
+        planes, exists, sign = build_planes(values)
+        count, pos, neg = obsi.sum_counts(planes, exists, sign, FULL, DEPTH)
+        total = sum(
+            (1 << i) * (int(pos[i]) - int(neg[i])) for i in range(DEPTH)
+        )
+        assert int(count) == len(values)
+        assert total == sum(values.values())
+
+    def test_sum_filtered(self, values):
+        planes, exists, sign = build_planes(values)
+        keep = {c for c in values if c % 3 == 0}
+        filt = ob.pack_positions(sorted(keep), N_BITS)
+        count, pos, neg = obsi.sum_counts(planes, exists, sign, filt, DEPTH)
+        assert int(count) == len(keep)
+        total = sum((1 << i) * (int(pos[i]) - int(neg[i])) for i in range(DEPTH))
+        assert total == sum(values[c] for c in keep)
+
+
+class TestMinMaxUnsigned:
+    def test_min_unsigned(self, values):
+        mags = {c: abs(v) for c, v in values.items()}
+        planes, exists, _ = build_planes({c: m for c, m in mags.items()})
+        mval, filt = obsi.min_unsigned(planes, exists, DEPTH)
+        expect = min(mags.values())
+        assert int(mval) == expect
+        assert to_set(filt) == {c for c, m in mags.items() if m == expect}
+
+    def test_max_unsigned(self, values):
+        mags = {c: abs(v) for c, v in values.items()}
+        planes, exists, _ = build_planes({c: m for c, m in mags.items()})
+        mval, filt = obsi.max_unsigned(planes, exists, DEPTH)
+        expect = max(mags.values())
+        assert int(mval) == expect
+        assert to_set(filt) == {c for c, m in mags.items() if m == expect}
+
+    def test_empty_filter(self):
+        planes, exists, _ = build_planes({1: 5})
+        empty = np.zeros(W, dtype=np.uint32)
+        mval, filt = obsi.min_unsigned(planes, empty, DEPTH)
+        assert to_set(filt) == set()
+
+
+class TestRangeLadders:
+    """Unsigned ladders compared against integer math on magnitudes."""
+
+    @pytest.fixture
+    def mags(self, rng):
+        cols = rng.choice(N_BITS, size=1500, replace=False)
+        vals = rng.integers(0, 2**DEPTH, size=1500)
+        return {int(c): int(v) for c, v in zip(cols, vals)}
+
+    @pytest.fixture
+    def setup(self, mags):
+        planes, exists, _ = build_planes(dict(mags))
+        return planes, exists
+
+    @pytest.mark.parametrize("pred", [0, 1, 7, 64, 100, 255])
+    def test_eq(self, setup, mags, pred):
+        planes, exists = setup
+        out = obsi.range_eq_unsigned(exists, planes, np.uint32(pred), DEPTH)
+        assert to_set(out) == {c for c, v in mags.items() if v == pred}
+
+    @pytest.mark.parametrize("pred", [0, 1, 7, 64, 100, 255])
+    @pytest.mark.parametrize("eq", [True, False])
+    def test_lt(self, setup, mags, pred, eq):
+        planes, exists = setup
+        out = obsi.range_lt_unsigned(exists, planes, np.uint32(pred), DEPTH, eq)
+        op = (lambda v: v <= pred) if eq else (lambda v: v < pred)
+        assert to_set(out) == {c for c, v in mags.items() if op(v)}
+
+    @pytest.mark.parametrize("pred", [0, 1, 7, 64, 100, 255])
+    @pytest.mark.parametrize("eq", [True, False])
+    def test_gt(self, setup, mags, pred, eq):
+        planes, exists = setup
+        out = obsi.range_gt_unsigned(exists, planes, np.uint32(pred), DEPTH, eq)
+        op = (lambda v: v >= pred) if eq else (lambda v: v > pred)
+        assert to_set(out) == {c for c, v in mags.items() if op(v)}
+
+    @pytest.mark.parametrize("lo,hi", [(0, 255), (10, 20), (7, 7), (200, 100), (0, 0)])
+    def test_between(self, setup, mags, lo, hi):
+        planes, exists = setup
+        out = obsi.range_between_unsigned(
+            exists, planes, np.uint32(lo), np.uint32(hi), DEPTH
+        )
+        assert to_set(out) == {c for c, v in mags.items() if lo <= v <= hi}

@@ -1,0 +1,310 @@
+"""Storage hierarchy tests: fragment/view/field/index/holder.
+
+Mirrors the reference's white-box tier (fragment_internal_test.go,
+field_internal_test.go, holder_internal_test.go)."""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_TIME, FieldOptions
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core import timeq
+from pilosa_tpu.ops import bitmap as ob
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def frag(path=None, **kw):
+    return Fragment(path, "i", "f", "standard", 0, **kw).open()
+
+
+class TestFragment:
+    def test_set_clear_bit(self):
+        f = frag()
+        assert f.set_bit(3, 100)
+        assert not f.set_bit(3, 100)
+        assert f.contains(3, 100)
+        assert f.row_count(3) == 1
+        assert f.clear_bit(3, 100)
+        assert not f.clear_bit(3, 100)
+        assert f.row_count(3) == 0
+
+    def test_absolute_and_inshard_cols(self):
+        f = Fragment(None, "i", "f", "standard", 2).open()
+        assert f.set_bit(1, 2 * SHARD_WIDTH + 7)  # absolute col of shard 2
+        assert f.contains(1, 7)
+        with pytest.raises(ValueError):
+            f.set_bit(1, 5 * SHARD_WIDTH + 7)  # wrong shard
+
+    def test_bulk_import_and_row(self, rng):
+        f = frag()
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, 5000))
+        f.bulk_import(np.full(len(cols), 7, np.uint64), cols)
+        assert f.row_count(7) == len(cols)
+        assert np.array_equal(f.row_positions(7), cols.astype(np.uint32))
+        # device row matches host row
+        dev = np.asarray(f.row_device(7))
+        assert ob.unpack_positions(dev).tolist() == cols.tolist()
+
+    def test_row_counts_batched(self, rng):
+        f = frag()
+        for r in range(5):
+            cols = np.unique(rng.integers(0, SHARD_WIDTH, 100 * (r + 1)))
+            f.bulk_import(np.full(len(cols), r, np.uint64), cols)
+        counts = f.row_counts(f.row_ids())
+        assert counts.tolist() == [f.row_count(r) for r in f.row_ids()]
+
+    def test_mutex(self):
+        f = frag(mutex=True)
+        assert f.set_bit(1, 10)
+        assert f.set_bit(2, 10)  # moves col 10 from row 1 to 2
+        assert not f.contains(1, 10)
+        assert f.contains(2, 10)
+        assert not f.set_bit(2, 10)
+
+    def test_mutex_bulk(self):
+        f = frag(mutex=True)
+        f.bulk_import(
+            np.array([1, 2, 3, 2], np.uint64), np.array([5, 5, 6, 6], np.uint64)
+        )
+        assert not f.contains(1, 5)
+        assert f.contains(2, 5)
+        assert not f.contains(3, 6)
+        assert f.contains(2, 6)
+
+    def test_persistence_snapshot_and_wal(self, tmp_path):
+        p = str(tmp_path / "0")
+        f = Fragment(p, "i", "f", "standard", 0).open()
+        f.set_bit(1, 100)
+        f.set_bit(2, 200)
+        f.snapshot()
+        f.set_bit(3, 300)  # lives only in WAL
+        f.clear_bit(1, 100)
+        f.close()
+
+        f2 = Fragment(p, "i", "f", "standard", 0).open()
+        assert not f2.contains(1, 100)
+        assert f2.contains(2, 200)
+        assert f2.contains(3, 300)
+
+    def test_wal_torn_tail(self, tmp_path):
+        p = str(tmp_path / "0")
+        f = Fragment(p, "i", "f", "standard", 0).open()
+        f.set_bit(1, 100)
+        f.close()
+        with open(p + ".wal", "ab") as fh:
+            fh.write(b"\x4c\x57\x54\x50garbage")  # torn record
+        f2 = Fragment(p, "i", "f", "standard", 0).open()
+        assert f2.contains(1, 100)  # clean prefix replayed
+
+    def test_auto_snapshot_on_max_op_n(self, tmp_path):
+        p = str(tmp_path / "0")
+        f = Fragment(p, "i", "f", "standard", 0, max_op_n=10).open()
+        cols = np.arange(50, dtype=np.uint64)
+        f.bulk_import(np.zeros(50, np.uint64), cols)
+        import os
+
+        assert os.path.exists(p + ".snap")
+        assert os.path.getsize(p + ".wal") == 0  # truncated after snapshot
+        f.close()
+        f2 = Fragment(p, "i", "f", "standard", 0).open()
+        assert f2.row_count(0) == 50
+
+
+class TestFragmentBSI:
+    def test_value_roundtrip(self):
+        f = frag()
+        for col, val in [(0, 0), (1, 5), (2, -7), (100, 255)]:
+            f.set_value(col, 8, val)
+        for col, val in [(0, 0), (1, 5), (2, -7), (100, 255)]:
+            got, exists = f.value(col, 8)
+            assert exists and got == val
+        assert f.value(999, 8) == (0, False)
+
+    def test_overwrite_value(self):
+        f = frag()
+        f.set_value(1, 8, 200)
+        f.set_value(1, 8, 3)
+        assert f.value(1, 8) == (3, True)
+
+    def test_sum_min_max(self, rng):
+        f = frag()
+        values = {int(c): int(v) for c, v in zip(
+            rng.choice(10000, 500, replace=False), rng.integers(-100, 100, 500)
+        )}
+        cols = np.array(sorted(values), np.uint64)
+        vals = np.array([values[c] for c in sorted(values)], np.int64)
+        f.import_values(cols, vals, 8)
+        s, cnt = f.sum(None, 8)
+        assert (s, cnt) == (sum(values.values()), len(values))
+        mn, mn_cnt = f.min(None, 8)
+        assert mn == min(values.values())
+        assert mn_cnt == sum(1 for v in values.values() if v == mn)
+        mx, mx_cnt = f.max(None, 8)
+        assert mx == max(values.values())
+        assert mx_cnt == sum(1 for v in values.values() if v == mx)
+
+    @pytest.mark.parametrize("op,pred", [
+        ("eq", 5), ("neq", 5), ("lt", 0), ("lt", 10), ("lte", -3),
+        ("gt", 50), ("gte", -50), ("gt", -1), ("lt", -90),
+    ])
+    def test_range_ops(self, rng, op, pred):
+        f = frag()
+        values = {int(c): int(v) for c, v in zip(
+            rng.choice(5000, 300, replace=False), rng.integers(-100, 100, 300)
+        )}
+        cols = np.array(sorted(values), np.uint64)
+        vals = np.array([values[c] for c in sorted(values)], np.int64)
+        f.import_values(cols, vals, 8)
+        out = set(ob.unpack_positions(np.asarray(f.range_op(op, 8, pred))).tolist())
+        pyop = {
+            "eq": lambda v: v == pred, "neq": lambda v: v != pred,
+            "lt": lambda v: v < pred, "lte": lambda v: v <= pred,
+            "gt": lambda v: v > pred, "gte": lambda v: v >= pred,
+        }[op]
+        assert out == {c for c, v in values.items() if pyop(v)}
+
+    def test_range_between(self, rng):
+        f = frag()
+        values = {int(c): int(v) for c, v in zip(
+            rng.choice(5000, 300, replace=False), rng.integers(-100, 100, 300)
+        )}
+        f.import_values(
+            np.array(sorted(values), np.uint64),
+            np.array([values[c] for c in sorted(values)], np.int64),
+            8,
+        )
+        for lo, hi in [(-10, 10), (0, 50), (-100, -1), (20, 20)]:
+            out = set(ob.unpack_positions(np.asarray(f.range_between(8, lo, hi))).tolist())
+            assert out == {c for c, v in values.items() if lo <= v <= hi}
+
+
+class TestTimeQuantum:
+    def test_views_by_time(self):
+        t = datetime(2019, 7, 4, 15, 0)
+        assert timeq.views_by_time("standard", t, "YMDH") == [
+            "standard_2019", "standard_201907", "standard_20190704",
+            "standard_2019070415",
+        ]
+
+    def test_views_by_time_range_ymdh(self):
+        views = timeq.views_by_time_range(
+            "standard", datetime(2019, 12, 31, 22, 0), datetime(2020, 1, 2, 2, 0), "YMDH"
+        )
+        assert views == [
+            "standard_2019123122", "standard_2019123123",
+            "standard_20200101",
+            "standard_2020010200", "standard_2020010201",
+        ]
+
+    def test_views_by_time_range_y(self):
+        views = timeq.views_by_time_range(
+            "standard", datetime(2018, 1, 1), datetime(2020, 1, 1), "Y"
+        )
+        assert views == ["standard_2018", "standard_2019"]
+
+    def test_invalid_quantum(self):
+        with pytest.raises(ValueError):
+            timeq.validate_quantum("XZ")
+
+
+class TestFieldIndexHolder:
+    def test_set_field_with_time(self):
+        h = Holder().open()
+        idx = h.create_index("i")
+        f = idx.create_field(
+            "events", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD")
+        )
+        ts = datetime(2019, 7, 4, 15, 0)
+        assert f.set_bit(1, 100, ts)
+        # bit present in standard + 3 time views
+        assert sorted(f.views) == [
+            "standard", "standard_2019", "standard_201907", "standard_20190704",
+        ]
+        for v in f.views.values():
+            assert v.fragment(0).contains(1, 100)
+
+    def test_int_field_value(self):
+        h = Holder().open()
+        idx = h.create_index("i")
+        f = idx.create_field("amount", FieldOptions(type=FIELD_TYPE_INT, min=-100, max=1000))
+        assert f.options.base == 0
+        assert f.set_value(5, 250)
+        assert f.value(5) == (250, True)
+        assert f.value(6) == (0, False)
+        with pytest.raises(ValueError):
+            f.set_value(1, 5000)
+
+    def test_int_field_base_offset(self):
+        h = Holder().open()
+        idx = h.create_index("i")
+        f = idx.create_field("year", FieldOptions(type=FIELD_TYPE_INT, min=2000, max=2100))
+        assert f.options.base == 2000
+        f.set_value(1, 2019)
+        assert f.value(1) == (2019, True)
+
+    def test_bool_mutex_semantics(self):
+        h = Holder().open()
+        idx = h.create_index("i")
+        f = idx.create_field("flag", FieldOptions(type=FIELD_TYPE_BOOL))
+        f.set_bit(1, 10)  # true
+        f.set_bit(0, 10)  # flips to false
+        std = f.view("standard")
+        assert not std.fragment(0).contains(1, 10)
+        assert std.fragment(0).contains(0, 10)
+
+    def test_existence_tracking(self):
+        h = Holder().open()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        idx.track_columns(np.array([1, 5, 9], np.uint64))
+        ef = idx.existence_field()
+        assert ef.view("standard").fragment(0).row_count(0) == 3
+
+    def test_holder_persistence_roundtrip(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("myidx", keys=False)
+        f = idx.create_field("stars", FieldOptions(cache_size=100))
+        f.set_bit(10, 12345)
+        fi = idx.create_field("amount", FieldOptions(type=FIELD_TYPE_INT, min=0, max=500))
+        fi.set_value(3, 42)
+        h.close()
+
+        h2 = Holder(str(tmp_path)).open()
+        idx2 = h2.index("myidx")
+        assert idx2 is not None
+        f2 = idx2.field("stars")
+        assert f2.options.cache_size == 100
+        assert f2.view("standard").fragment(0).contains(10, 12345)
+        assert idx2.field("amount").value(3) == (42, True)
+        assert idx2.field("amount").options.type == FIELD_TYPE_INT
+
+    def test_schema(self):
+        h = Holder().open()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        schema = h.schema()
+        assert schema[0]["name"] == "i"
+        assert schema[0]["fields"][0]["name"] == "f"
+
+    def test_invalid_names(self):
+        h = Holder().open()
+        with pytest.raises(ValueError):
+            h.create_index("Bad")
+        idx = h.create_index("ok")
+        with pytest.raises(ValueError):
+            idx.create_field("_reserved")
+
+    def test_delete(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        idx.create_field("f").set_bit(1, 1)
+        idx.delete_field("f")
+        assert idx.field("f") is None
+        h.delete_index("i")
+        assert h.index("i") is None
+        import os
+
+        assert not os.path.exists(os.path.join(str(tmp_path), "i"))
