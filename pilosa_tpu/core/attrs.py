@@ -1,0 +1,85 @@
+"""Row/column attribute stores.
+
+Reference: /root/reference/attr.go (AttrStore interface) + boltdb/attrstore.go
+(BoltDB implementation with block-checksum diffing for anti-entropy). Here:
+an in-memory dict with JSON-file persistence and the same block/diff shape
+(blocks of 100 ids, xxhash-free checksums via zlib.crc32) so the anti-entropy
+layer can sync attrs the same way the reference does (attr.go:90
+AttrBlock.Diff)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+ATTR_BLOCK_SIZE = 100  # reference: attrBlockSize, attr.go
+
+
+class AttrStore:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._mu = threading.RLock()
+        self._attrs: Dict[int, dict] = {}
+        if path is not None and os.path.exists(path):
+            with open(path) as f:
+                self._attrs = {int(k): v for k, v in json.load(f).items()}
+
+    def attrs(self, id: int) -> dict:
+        with self._mu:
+            return dict(self._attrs.get(id, {}))
+
+    def set_attrs(self, id: int, attrs: dict) -> None:
+        """Merge attrs; a None value deletes the key (reference semantics)."""
+        with self._mu:
+            cur = self._attrs.setdefault(id, {})
+            for k, v in attrs.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            self._flush()
+
+    def set_bulk_attrs(self, m: Dict[int, dict]) -> None:
+        with self._mu:
+            for id, attrs in m.items():
+                cur = self._attrs.setdefault(id, {})
+                cur.update({k: v for k, v in attrs.items() if v is not None})
+            self._flush()
+
+    def ids(self) -> List[int]:
+        with self._mu:
+            return sorted(self._attrs)
+
+    def _flush(self) -> None:
+        if self.path is None:
+            return
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in self._attrs.items()}, f)
+        os.replace(tmp, self.path)
+
+    # -- anti-entropy support (attr.go:90) ---------------------------------
+
+    def blocks(self) -> List[dict]:
+        """Per-block checksums for replica diffing."""
+        with self._mu:
+            out = []
+            by_block: Dict[int, List[int]] = {}
+            for id in sorted(self._attrs):
+                by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append(id)
+            for block_id, ids in sorted(by_block.items()):
+                payload = json.dumps(
+                    [(i, sorted(self._attrs[i].items())) for i in ids]
+                ).encode()
+                out.append({"id": block_id, "checksum": zlib.crc32(payload)})
+            return out
+
+    def block_data(self, block_id: int) -> Dict[int, dict]:
+        with self._mu:
+            lo = block_id * ATTR_BLOCK_SIZE
+            hi = lo + ATTR_BLOCK_SIZE
+            return {i: dict(a) for i, a in self._attrs.items() if lo <= i < hi}

@@ -89,6 +89,12 @@ class Field:
         # shards this node knows exist cluster-wide (field.go:88
         # remoteAvailableShards); local shards are derived from fragments.
         self.remote_available_shards: Set[int] = set()
+        # per-row attributes (reference: field.go rowAttrStore)
+        from pilosa_tpu.core.attrs import AttrStore
+
+        self.row_attr_store = AttrStore(
+            None if path is None else os.path.join(path, ".row_attrs.json")
+        )
 
         if options.type == FIELD_TYPE_INT:
             if options.min == 0 and options.max == 0:

@@ -240,6 +240,9 @@ class Fragment:
             return n_set, n_clear
 
     def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
+        # The single mutation funnel: every write path (including WAL replay,
+        # clears from Store/ClearRow, bulk clear imports) flows through here,
+        # so the mutex vector is maintained here and nowhere else.
         n_set = n_clear = 0
         if len(to_set):
             rows = (to_set // SHARD_WIDTH).astype(np.int64)
@@ -248,17 +251,25 @@ class Fragment:
                 rb = self._rows.get(int(row_id))
                 if rb is None:
                     rb = self._rows[int(row_id)] = RowBits(SHARD_WIDTH)
-                n_set += rb.add(cols[rows == row_id])
+                row_cols = cols[rows == row_id]
+                n_set += rb.add(row_cols)
                 self._dev.pop(int(row_id), None)
+                if self._mutex_map is not None:
+                    for c in row_cols:
+                        self._mutex_map[int(c)] = int(row_id)
         if len(to_clear):
             rows = (to_clear // SHARD_WIDTH).astype(np.int64)
             cols = (to_clear % SHARD_WIDTH).astype(np.uint32)
             for row_id in np.unique(rows):
                 rb = self._rows.get(int(row_id))
-                if rb is None:
-                    continue
-                n_clear += rb.discard(cols[rows == row_id])
-                self._dev.pop(int(row_id), None)
+                row_cols = cols[rows == row_id]
+                if rb is not None:
+                    n_clear += rb.discard(row_cols)
+                    self._dev.pop(int(row_id), None)
+                if self._mutex_map is not None:
+                    for c in row_cols:
+                        if self._mutex_map.get(int(c)) == int(row_id):
+                            del self._mutex_map[int(c)]
         return n_set, n_clear
 
     def _wal_append(self, op: int, positions: np.ndarray) -> None:

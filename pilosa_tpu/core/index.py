@@ -39,6 +39,12 @@ class Index:
         self.track_existence = track_existence
         self._mu = threading.RLock()
         self._fields: Dict[str, Field] = {}
+        # per-column attributes (reference: index.go columnAttrStore)
+        from pilosa_tpu.core.attrs import AttrStore
+
+        self.column_attr_store = AttrStore(
+            None if path is None else os.path.join(path, ".col_attrs.json")
+        )
 
     # ------------------------------------------------------------------
 

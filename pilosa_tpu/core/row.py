@@ -1,0 +1,119 @@
+"""Row: cross-shard query-result bitmap.
+
+Reference: /root/reference/row.go — a Row is a list of per-shard rowSegments
+wrapping roaring bitmaps (row.go:27,332). Here a Row maps shard -> dense
+device words; algebra is elementwise device ops per aligned shard, and counts
+reduce exactly on the host (Python ints).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pilosa_tpu.ops import bitmap as ob
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class Row:
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, segments: Optional[Dict[int, object]] = None):
+        # shard -> uint32 words (jax device array or numpy)
+        self.segments: Dict[int, object] = dict(segments or {})
+        self.attrs: Optional[dict] = None
+        self.keys: Optional[List[str]] = None
+
+    # -- algebra (row.go:91-330) ------------------------------------------
+
+    def union(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for o in others:
+            for shard, words in o.segments.items():
+                cur = out.get(shard)
+                out[shard] = words if cur is None else ob.b_or(cur, words)
+        return Row(out)
+
+    def intersect(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for o in others:
+            nxt = {}
+            for shard, words in o.segments.items():
+                cur = out.get(shard)
+                if cur is not None:
+                    nxt[shard] = ob.b_and(cur, words)
+            out = nxt
+        return Row(out)
+
+    def difference(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for o in others:
+            for shard, words in o.segments.items():
+                cur = out.get(shard)
+                if cur is not None:
+                    out[shard] = ob.b_andnot(cur, words)
+        return Row(out)
+
+    def xor(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for o in others:
+            for shard, words in o.segments.items():
+                cur = out.get(shard)
+                out[shard] = words if cur is None else ob.b_xor(cur, words)
+        return Row(out)
+
+    def shift(self, n: int = 1) -> "Row":
+        """Shift all columns up by n; bits crossing a shard boundary carry
+        into the next shard (the reference's per-segment shift drops them —
+        row.go Shift; we keep the carry, a deliberate correction)."""
+        out: Dict[int, object] = {}
+        carry_by_shard: Dict[int, object] = {}
+        for shard in sorted(self.segments):
+            shifted, overflow = ob.shift_bits(self.segments[shard], n)
+            out[shard] = shifted
+            if bool(ob.any_set(overflow)):
+                carry_by_shard[shard + 1] = overflow
+        for shard, words in carry_by_shard.items():
+            cur = out.get(shard)
+            out[shard] = words if cur is None else ob.b_or(cur, words)
+        return Row(out)
+
+    # -- reads -------------------------------------------------------------
+
+    def count(self) -> int:
+        return int(sum(int(ob.popcount(w)) for w in self.segments.values()))
+
+    def any(self) -> bool:
+        return any(bool(ob.any_set(w)) for w in self.segments.values())
+
+    def columns(self) -> np.ndarray:
+        """Sorted absolute column ids (host; result materialization only)."""
+        cols = []
+        for shard in sorted(self.segments):
+            pos = ob.unpack_positions(np.asarray(self.segments[shard]))
+            if len(pos):
+                cols.append(pos + np.uint64(shard) * np.uint64(SHARD_WIDTH))
+        return np.concatenate(cols) if cols else np.empty(0, np.uint64)
+
+    def shards(self) -> List[int]:
+        return sorted(self.segments)
+
+    def segment(self, shard: int):
+        return self.segments.get(shard)
+
+    def includes(self, col: int) -> bool:
+        words = self.segments.get(col // SHARD_WIDTH)
+        if words is None:
+            return False
+        w = np.asarray(words)
+        pos = col % SHARD_WIDTH
+        return bool((int(w[pos >> 5]) >> (pos & 31)) & 1)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Row):
+            return NotImplemented
+        return self.columns().tolist() == other.columns().tolist()
+
+    def __repr__(self) -> str:
+        return f"Row(shards={self.shards()}, count={self.count()})"

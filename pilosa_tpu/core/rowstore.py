@@ -18,6 +18,19 @@ import numpy as np
 ARRAY_REP = 0
 DENSE_REP = 1
 
+if hasattr(np, "bitwise_count"):  # numpy >= 2.0
+
+    def _popcount_words(words: np.ndarray) -> int:
+        return int(np.bitwise_count(words).sum())
+
+else:
+    # 16-bit popcount lookup table (128 KiB once) — avoids the 32x blowup of
+    # np.unpackbits on hot count paths.
+    _POPCNT16 = np.array([bin(i).count("1") for i in range(1 << 16)], dtype=np.uint8)
+
+    def _popcount_words(words: np.ndarray) -> int:
+        return int(_POPCNT16[words.view(np.uint16)].sum())
+
 
 class RowBits:
     """Bits of one (row, shard) pair: sorted uint32 positions or dense words.
@@ -61,8 +74,7 @@ class RowBits:
 
     def count(self) -> int:
         if self.dense is not None:
-            # popcount via uint8 view + lookup-free bit_count if available
-            return int(np.unpackbits(self.dense.view(np.uint8)).sum())
+            return _popcount_words(self.dense)
         return len(self.positions)
 
     def to_words(self) -> np.ndarray:

@@ -95,6 +95,36 @@ def _next_day_gte(t: datetime, end: datetime) -> bool:
     return end > nxt
 
 
+def min_max_view_times(view_names, quantum: str):
+    """Time span covered by existing time views: (min_start, max_end_exclusive),
+    or (None, None) when there are no time views (reference: time.go:237
+    minMaxViews + timeOfView)."""
+    suffixes = []
+    for vname in view_names:
+        suffix = vname.rsplit("_", 1)[-1]
+        if suffix.isdigit() and len(suffix) in (4, 6, 8, 10):
+            suffixes.append(suffix)
+    if not suffixes:
+        return None, None
+    lo, hi = min(suffixes), max(suffixes)
+    fmts = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}
+
+    def start_of(s: str) -> datetime:
+        return datetime.strptime(s, fmts[len(s)])
+
+    def end_of(s: str) -> datetime:
+        t = start_of(s)
+        if len(s) == 4:
+            return _add_year(t)
+        if len(s) == 6:
+            return _go_add_months(t, 1)
+        if len(s) == 8:
+            return t + timedelta(days=1)
+        return t + timedelta(hours=1)
+
+    return start_of(lo), end_of(hi)
+
+
 def views_by_time_range(name: str, start: datetime, end: datetime, quantum: str) -> List[str]:
     """Minimal covering view set for [start, end) (time.go:104)."""
     has_y = "Y" in quantum

@@ -1,0 +1,1 @@
+from pilosa_tpu.exec.executor import Executor, ExecOptions, GroupCount, Pair, ValCount  # noqa: F401

@@ -1,0 +1,917 @@
+"""Query executor: per-call dispatch + per-shard map + reduce.
+
+Reference: /root/reference/executor.go — executeCall dispatch (:274-339),
+per-shard mapReduce (:2460-2613), per-call implementations (:360-2418).
+
+TPU-first structure: every bitmap call lowers, per shard, to dense device
+words; cross-child algebra happens on device; cross-shard reduction happens
+with exact host ints (counts) or segment maps (rows). The single-node
+executor walks shards in a Python loop — the mesh path (parallel/) stacks
+shards into one [n_shards, W] sharded array and jits the whole map+reduce
+with collectives; both share the per-shard lowering here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from pilosa_tpu.core import timeq
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_TIME,
+    Field,
+)
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.ops import bitmap as ob
+from pilosa_tpu.pql import Call, Query, parse
+from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+DEFAULT_MIN_THRESHOLD = 1  # reference: defaultMinThreshold, executor.go
+
+
+class ExecError(Exception):
+    pass
+
+
+class NotFoundError(ExecError):
+    pass
+
+
+@dataclass
+class ExecOptions:
+    remote: bool = False
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+    column_attrs: bool = False
+    shards: Optional[List[int]] = None
+    max_writes: int = 5000  # reference: MaxWritesPerRequest
+
+
+@dataclass
+class Pair:
+    """TopN result entry (reference: Pair, cache.go:317)."""
+
+    id: int
+    count: int
+    key: Optional[str] = None
+
+    def to_json(self):
+        d = {"id": self.id, "count": self.count}
+        if self.key is not None:
+            d["key"] = self.key
+        return d
+
+
+@dataclass
+class ValCount:
+    """Sum/Min/Max result (reference: ValCount, executor.go)."""
+
+    value: int
+    count: int
+
+    def to_json(self):
+        return {"value": self.value, "count": self.count}
+
+
+@dataclass
+class FieldRow:
+    field: str
+    row_id: int
+    row_key: Optional[str] = None
+
+    def to_json(self):
+        if self.row_key:
+            return {"field": self.field, "rowKey": self.row_key}
+        return {"field": self.field, "rowID": self.row_id}
+
+
+@dataclass
+class GroupCount:
+    group: List[FieldRow]
+    count: int
+
+    def to_json(self):
+        return {"group": [g.to_json() for g in self.group], "count": self.count}
+
+    def compare_key(self):
+        return tuple(g.row_id for g in self.group)
+
+
+_COND_OP_NAME = {EQ: "eq", NEQ: "neq", LT: "lt", LTE: "lte", GT: "gt", GTE: "gte"}
+
+
+class Executor:
+    """Single-node executor. Cluster fan-out wraps this via the same
+    per-shard lowering (reference: executor.go:44)."""
+
+    def __init__(self, holder: Holder):
+        self.holder = holder
+
+    # ------------------------------------------------------------------
+    # entry
+    # ------------------------------------------------------------------
+
+    def execute(
+        self,
+        index_name: str,
+        query: Union[str, Query],
+        shards: Optional[Sequence[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> List[Any]:
+        opt = opt or ExecOptions()
+        if isinstance(query, str):
+            query = parse(query)
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        if query.write_call_n() > opt.max_writes:
+            raise ExecError("too many writes in a single request")
+        if shards is None:
+            shards = opt.shards
+        results = []
+        for call in query.calls:
+            results.append(self._execute_call(idx, call, shards, opt))
+        return results
+
+    def _shards_for(self, idx: Index, shards, call: Optional[Call] = None) -> List[int]:
+        if shards is not None:
+            s = list(shards)
+        else:
+            s = sorted(idx.available_shards()) or [0]
+        if call is not None:
+            # Shift carries bits into following shards; materialize them even
+            # when the index has no data there yet.
+            k = self._count_shifts(call)
+            if k:
+                ext = set(s)
+                for sh in s:
+                    ext.update(range(sh + 1, sh + 1 + k))
+                s = sorted(ext)
+        return s
+
+    # ------------------------------------------------------------------
+    # dispatch (executor.go:274)
+    # ------------------------------------------------------------------
+
+    def _execute_call(self, idx: Index, c: Call, shards, opt: ExecOptions):
+        name = c.name
+        if name not in ("Set", "Clear", "SetRowAttrs", "SetColumnAttrs", "Options"):
+            shards = self._shards_for(idx, shards, c)
+        if name == "Sum":
+            return self._execute_sum(idx, c, shards)
+        if name == "Min":
+            return self._execute_min_max(idx, c, shards, is_min=True)
+        if name == "Max":
+            return self._execute_min_max(idx, c, shards, is_min=False)
+        if name == "MinRow":
+            return self._execute_min_max_row(idx, c, shards, is_min=True)
+        if name == "MaxRow":
+            return self._execute_min_max_row(idx, c, shards, is_min=False)
+        if name == "Clear":
+            return self._execute_clear(idx, c)
+        if name == "ClearRow":
+            return self._execute_clear_row(idx, c, shards)
+        if name == "Store":
+            return self._execute_store(idx, c, shards)
+        if name == "Count":
+            return self._execute_count(idx, c, shards)
+        if name == "Set":
+            return self._execute_set(idx, c)
+        if name == "SetRowAttrs":
+            self._execute_set_row_attrs(idx, c)
+            return None
+        if name == "SetColumnAttrs":
+            self._execute_set_column_attrs(idx, c)
+            return None
+        if name == "TopN":
+            return self._execute_topn(idx, c, shards, opt)
+        if name == "Rows":
+            return self._execute_rows(idx, c, shards)
+        if name == "GroupBy":
+            return self._execute_group_by(idx, c, shards)
+        if name == "Options":
+            return self._execute_options(idx, c, shards, opt)
+        return self._execute_bitmap_call(idx, c, shards)
+
+    # ------------------------------------------------------------------
+    # bitmap calls
+    # ------------------------------------------------------------------
+
+    def _count_shifts(self, c: Call) -> int:
+        n = 1 if c.name == "Shift" else 0
+        n += sum(self._count_shifts(ch) for ch in c.children)
+        n += sum(self._count_shifts(v) for v in c.args.values() if isinstance(v, Call))
+        return n
+
+    def _execute_bitmap_call(self, idx: Index, c: Call, shards) -> Row:
+        shard_list = self._shards_for(idx, shards)
+        segments = {}
+        for shard in shard_list:
+            words = self._bitmap_call_shard(idx, c, shard)
+            if words is not None:
+                segments[shard] = words
+        return Row(segments)
+
+    def _bitmap_call_shard(self, idx: Index, c: Call, shard: int):
+        """Lower one bitmap call for one shard to device words (or None)."""
+        name = c.name
+        if name in ("Row", "Range"):
+            return self._row_shard(idx, c, shard)
+        if name == "Intersect":
+            return self._nary_shard(idx, c, shard, "intersect")
+        if name == "Union":
+            return self._nary_shard(idx, c, shard, "union")
+        if name == "Difference":
+            return self._nary_shard(idx, c, shard, "difference")
+        if name == "Xor":
+            return self._nary_shard(idx, c, shard, "xor")
+        if name == "Not":
+            return self._not_shard(idx, c, shard)
+        if name == "Shift":
+            # Shift crosses shard boundaries: this shard's result is its own
+            # child bits shifted up, OR'd with the overflow carried out of the
+            # previous shard's child bits — composable per shard, so Shift
+            # works nested inside any other call.
+            if len(c.children) != 1:
+                raise ExecError("Shift() requires a single bitmap input")
+            n = c.int_arg("n")
+            n = 1 if n is None else n
+            cur = self._bitmap_call_shard(idx, c.children[0], shard)
+            out = None
+            if cur is not None:
+                out, _ = ob.shift_bits(cur, n)
+            if shard > 0:
+                prev = self._bitmap_call_shard(idx, c.children[0], shard - 1)
+                if prev is not None:
+                    _, carry = ob.shift_bits(prev, n)
+                    out = carry if out is None else ob.b_or(out, carry)
+            return out
+        if name == "All":
+            return self._existence_words(idx, shard)
+        raise ExecError(f"unknown call: {name}")
+
+    def _nary_shard(self, idx: Index, c: Call, shard: int, op: str):
+        if not c.children:
+            if op == "intersect":
+                raise ExecError("empty Intersect query is currently not supported")
+            return None
+        words = [self._bitmap_call_shard(idx, ch, shard) for ch in c.children]
+        zero = None
+        if op == "intersect":
+            if any(w is None for w in words):
+                return None
+            out = words[0]
+            for w in words[1:]:
+                out = ob.b_and(out, w)
+            return out
+        if op == "union":
+            present = [w for w in words if w is not None]
+            if not present:
+                return None
+            out = present[0]
+            for w in present[1:]:
+                out = ob.b_or(out, w)
+            return out
+        if op == "difference":
+            out = words[0]
+            if out is None:
+                return None
+            for w in words[1:]:
+                if w is not None:
+                    out = ob.b_andnot(out, w)
+            return out
+        if op == "xor":
+            present = [w for w in words if w is not None]
+            if not present:
+                return None
+            out = present[0]
+            for w in present[1:]:
+                out = ob.b_xor(out, w)
+            return out
+        raise AssertionError(op)
+
+    def _not_shard(self, idx: Index, c: Call, shard: int):
+        """Not via the existence field (executor.go:1734 executeNot)."""
+        if not idx.track_existence:
+            raise ExecError("Not() query requires existence tracking to be enabled")
+        if len(c.children) != 1:
+            raise ExecError("Not() requires a single bitmap input")
+        exists = self._existence_words(idx, shard)
+        if exists is None:
+            return None
+        child = self._bitmap_call_shard(idx, c.children[0], shard)
+        if child is None:
+            return exists
+        return ob.b_andnot(exists, child)
+
+    def _existence_words(self, idx: Index, shard: int):
+        ef = idx.existence_field()
+        if ef is None:
+            raise ExecError("existence field not available")
+        v = ef.view(VIEW_STANDARD)
+        if v is None:
+            return None
+        frag = v.fragment_if_exists(shard)
+        return None if frag is None else frag.row_device(0)
+
+    # -- Row / Range -------------------------------------------------------
+
+    def _field_of(self, idx: Index, name: str) -> Field:
+        f = idx.field(name)
+        if f is None:
+            raise NotFoundError(f"field not found: {name}")
+        return f
+
+    def _row_shard(self, idx: Index, c: Call, shard: int):
+        if c.has_conditions():
+            return self._row_bsi_shard(idx, c, shard)
+        field_name = self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        row_id = c.args.get(field_name)
+        if isinstance(row_id, bool):
+            if f.options.type != FIELD_TYPE_BOOL:
+                raise ExecError("Row() bool value requires a bool field")
+            row_id = 1 if row_id else 0
+        if not isinstance(row_id, int):
+            if isinstance(row_id, str):
+                raise ExecError(
+                    f"string row key {row_id!r} requires field keys (translation)"
+                )
+            raise ExecError("Row() must specify a row")
+        if f.options.type == FIELD_TYPE_BOOL and row_id not in (0, 1):
+            raise ExecError("Row() bool field expects row 0 or 1")
+
+        from_arg = c.args.get("from")
+        to_arg = c.args.get("to")
+        if from_arg is None and to_arg is None:
+            v = f.view(VIEW_STANDARD)
+            if v is None:
+                return None
+            frag = v.fragment_if_exists(shard)
+            return None if frag is None else frag.row_device(row_id)
+
+        # time range (executor.go executeRowShard from/to handling)
+        if f.options.type != FIELD_TYPE_TIME:
+            raise ExecError(f"field {field_name} is not a time field")
+        quantum = f.options.time_quantum
+        from_t = timeq.parse_time(from_arg) if from_arg is not None else None
+        to_t = timeq.parse_time(to_arg) if to_arg is not None else None
+        if from_t is None or to_t is None:
+            lo, hi = self._field_time_bounds(f)
+            if lo is None:
+                return None
+            from_t = from_t or lo
+            to_t = to_t or hi
+        out = None
+        for vname in timeq.views_by_time_range(VIEW_STANDARD, from_t, to_t, quantum):
+            v = f.view(vname)
+            if v is None:
+                continue
+            frag = v.fragment_if_exists(shard)
+            if frag is None:
+                continue
+            w = frag.row_device(row_id)
+            out = w if out is None else ob.b_or(out, w)
+        return out
+
+    def _field_time_bounds(self, f: Field):
+        """Min/max time covered by the field's existing time views."""
+        return timeq.min_max_view_times(f.views.keys(), f.options.time_quantum)
+
+    def _field_arg_name(self, c: Call) -> str:
+        for k in c.args:
+            if not k.startswith("_") and k not in ("from", "to"):
+                return k
+        raise ExecError(f"{c.name}() argument required: field")
+
+    def _row_bsi_shard(self, idx: Index, c: Call, shard: int):
+        """BSI condition row (executor.go:1533 executeRowBSIGroupShard)."""
+        conds = c.condition_args()
+        if len(c.args) != 1 or len(conds) != 1:
+            raise ExecError("Row(): exactly one condition required")
+        field_name, cond = next(iter(conds.items()))
+        f = self._field_of(idx, field_name)
+        if f.options.type != FIELD_TYPE_INT:
+            raise ExecError(f"field {field_name} is not an int field")
+        o = f.options
+        bsiv = f.view(f.bsi_view_name())
+        if bsiv is None:
+            return None
+        frag = bsiv.fragment_if_exists(shard)
+        if frag is None:
+            return None
+
+        if cond.op == NEQ and cond.value is None:  # != null
+            return frag.not_null()
+        if cond.op == BETWEEN:
+            lo, hi = cond.int_pair()
+            blo, bhi, out_of_range = f.base_value_between(lo, hi)
+            if out_of_range:
+                return None
+            if lo <= o.min and hi >= o.max:
+                return frag.not_null()
+            return frag.range_between(o.bit_depth, blo, bhi)
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise ExecError("Row(): conditions only support integer values")
+        value = cond.value
+        op = _COND_OP_NAME[cond.op]
+        base_value, out_of_range = f.base_value(op, value)
+        if out_of_range and cond.op != NEQ:
+            return None
+        # full-range saturation -> notNull
+        if (
+            (cond.op == LT and value > o.max)
+            or (cond.op == LTE and value >= o.max)
+            or (cond.op == GT and value < o.min)
+            or (cond.op == GTE and value <= o.min)
+        ):
+            return frag.not_null()
+        if out_of_range and cond.op == NEQ:
+            return frag.not_null()
+        return frag.range_op(op, o.bit_depth, base_value)
+
+    # ------------------------------------------------------------------
+    # Count / Sum / Min / Max
+    # ------------------------------------------------------------------
+
+    def _execute_count(self, idx: Index, c: Call, shards) -> int:
+        if len(c.children) != 1:
+            raise ExecError("Count() only accepts a single bitmap input")
+        shard_list = self._shards_for(idx, shards)
+        total = 0
+        for shard in shard_list:
+            words = self._bitmap_call_shard(idx, c.children[0], shard)
+            if words is not None:
+                total += int(ob.popcount(words))
+        return total
+
+    def _sum_filter_words(self, idx: Index, c: Call, shard: int):
+        if len(c.children) == 1:
+            return self._bitmap_call_shard(idx, c.children[0], shard), True
+        filt = c.args.get("filter")
+        if isinstance(filt, Call):
+            return self._bitmap_call_shard(idx, filt, shard), True
+        return None, False
+
+    def _execute_sum(self, idx: Index, c: Call, shards) -> ValCount:
+        field_name = c.string_arg("field") or self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        if f.options.type != FIELD_TYPE_INT:
+            raise ExecError(f"field {field_name} is not an int field")
+        bsiv = f.view(f.bsi_view_name())
+        total = 0
+        count = 0
+        if bsiv is not None:
+            for shard in self._shards_for(idx, shards):
+                frag = bsiv.fragment_if_exists(shard)
+                if frag is None:
+                    continue
+                fw, has_filter = self._sum_filter_words(idx, c, shard)
+                if has_filter and fw is None:
+                    continue
+                s, n = frag.sum(fw, f.options.bit_depth)
+                total += s
+                count += n
+        return ValCount(value=total + count * f.options.base, count=count)
+
+    def _execute_min_max(self, idx: Index, c: Call, shards, is_min: bool) -> ValCount:
+        field_name = c.string_arg("field") or self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        if f.options.type != FIELD_TYPE_INT:
+            raise ExecError(f"field {field_name} is not an int field")
+        bsiv = f.view(f.bsi_view_name())
+        best: Optional[Tuple[int, int]] = None
+        if bsiv is not None:
+            for shard in self._shards_for(idx, shards):
+                frag = bsiv.fragment_if_exists(shard)
+                if frag is None:
+                    continue
+                fw, has_filter = self._sum_filter_words(idx, c, shard)
+                if has_filter and fw is None:
+                    continue
+                val, cnt = (
+                    frag.min(fw, f.options.bit_depth)
+                    if is_min
+                    else frag.max(fw, f.options.bit_depth)
+                )
+                if cnt == 0:
+                    continue
+                if best is None or (val < best[0] if is_min else val > best[0]):
+                    best = (val, cnt)
+                elif val == best[0]:
+                    best = (val, best[1] + cnt)
+        if best is None:
+            return ValCount(0, 0)
+        return ValCount(value=best[0] + f.options.base, count=best[1])
+
+    def _execute_min_max_row(self, idx: Index, c: Call, shards, is_min: bool):
+        """MinRow/MaxRow (executor.go:514-581)."""
+        field_name = c.string_arg("field") or c.string_arg("_field")
+        if field_name is None:
+            field_name = self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        v = f.view(VIEW_STANDARD)
+        filter_call = c.children[0] if c.children else None
+        best_row = None
+        best_count = 0
+        if v is not None:
+            for shard in self._shards_for(idx, shards):
+                frag = v.fragment_if_exists(shard)
+                if frag is None:
+                    continue
+                fw = (
+                    self._bitmap_call_shard(idx, filter_call, shard)
+                    if filter_call
+                    else None
+                )
+                if filter_call and fw is None:
+                    continue
+                ids = frag.row_ids()
+                if not ids:
+                    continue
+                if filter_call is None:
+                    rid = min(ids) if is_min else max(ids)
+                    if (
+                        best_row is None
+                        or (rid < best_row if is_min else rid > best_row)
+                    ):
+                        best_row, best_count = rid, 1
+                    continue
+                counts = frag.row_counts(ids, fw)
+                for rid, cnt in zip(ids, counts):
+                    if cnt == 0:
+                        continue
+                    if (
+                        best_row is None
+                        or (rid < best_row if is_min else rid > best_row)
+                    ):
+                        best_row, best_count = rid, int(cnt)
+                    elif rid == best_row:
+                        best_count += int(cnt)
+        return {"id": 0 if best_row is None else best_row, "count": best_count}
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+
+    def _execute_set(self, idx: Index, c: Call) -> bool:
+        col = c.args.get("_col")
+        if not isinstance(col, int):
+            raise ExecError("Set() column argument required (or keys not enabled)")
+        field_name = self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        if f.options.type == FIELD_TYPE_INT:
+            value = c.int_arg(field_name)
+            if value is None:
+                raise ExecError("Set() int field requires an integer value")
+            changed = f.set_value(col, value)
+        else:
+            row_id = c.args.get(field_name)
+            if f.options.type == FIELD_TYPE_BOOL:
+                if not isinstance(row_id, bool):
+                    raise ExecError("Set() bool field requires true/false")
+                row_id = 1 if row_id else 0
+            if not isinstance(row_id, int):
+                raise ExecError("Set() row argument required")
+            ts = c.args.get("_timestamp")
+            changed = f.set_bit(
+                row_id, col, timeq.parse_time(ts) if ts is not None else None
+            )
+        idx.track_columns(np.array([col], np.uint64))
+        return changed
+
+    def _execute_clear(self, idx: Index, c: Call) -> bool:
+        col = c.args.get("_col")
+        if not isinstance(col, int):
+            raise ExecError("Clear() column argument required")
+        field_name = self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        if f.options.type == FIELD_TYPE_INT:
+            return f.clear_value(col)
+        row_id = c.args.get(field_name)
+        if f.options.type == FIELD_TYPE_BOOL and isinstance(row_id, bool):
+            row_id = 1 if row_id else 0
+        if not isinstance(row_id, int):
+            raise ExecError("Clear() row argument required")
+        return f.clear_bit(row_id, col)
+
+    def _execute_clear_row(self, idx: Index, c: Call, shards) -> bool:
+        field_name = self._field_arg_name(c)
+        f = self._field_of(idx, field_name)
+        if f.options.type not in ("set", "time", "mutex", "bool"):
+            raise ExecError(f"ClearRow() is not supported on {f.options.type} fields")
+        row_id = c.args.get(field_name)
+        if f.options.type == FIELD_TYPE_BOOL and isinstance(row_id, bool):
+            row_id = 1 if row_id else 0
+        if not isinstance(row_id, int):
+            raise ExecError("ClearRow() row argument required")
+        changed = False
+        for v in list(f.views.values()):
+            for shard in self._shards_for(idx, shards):
+                frag = v.fragment_if_exists(shard)
+                if frag is None:
+                    continue
+                pos = frag.row_positions(row_id)
+                if len(pos):
+                    frag.import_positions(
+                        None, np.uint64(row_id) * SHARD_WIDTH + pos.astype(np.uint64)
+                    )
+                    changed = True
+        return changed
+
+    def _execute_store(self, idx: Index, c: Call, shards) -> bool:
+        """Store(Row(...), f=row): overwrite a row with the result bitmap
+        (executor.go:1937 executeSetRow)."""
+        if len(c.children) != 1:
+            raise ExecError("Store() requires a single bitmap input")
+        field_name = self._field_arg_name(c)
+        f = idx.field(field_name)
+        if f is None:
+            f = idx.create_field(field_name)
+        row_id = c.args.get(field_name)
+        if not isinstance(row_id, int):
+            raise ExecError("Store() row argument required")
+        v = f._view_create(VIEW_STANDARD)
+        changed = False
+        for shard in self._shards_for(idx, shards):
+            words = self._bitmap_call_shard(idx, c.children[0], shard)
+            new_pos = (
+                ob.unpack_positions(np.asarray(words))
+                if words is not None
+                else np.empty(0, np.uint64)
+            )
+            frag = v.fragment(shard)
+            old_pos = frag.row_positions(row_id).astype(np.uint64)
+            to_set = np.setdiff1d(new_pos, old_pos)
+            to_clear = np.setdiff1d(old_pos, new_pos)
+            if len(to_set) or len(to_clear):
+                base = np.uint64(row_id) * np.uint64(SHARD_WIDTH)
+                frag.import_positions(
+                    base + to_set if len(to_set) else None,
+                    base + to_clear if len(to_clear) else None,
+                )
+                changed = True
+        return changed
+
+    def _execute_set_row_attrs(self, idx: Index, c: Call) -> None:
+        field_name = c.args.get("_field")
+        f = self._field_of(idx, field_name)
+        row_id = c.args.get("_row")
+        if not isinstance(row_id, int):
+            raise ExecError("SetRowAttrs() row argument required")
+        attrs = {
+            k: v for k, v in c.args.items() if k not in ("_field", "_row")
+        }
+        f.row_attr_store.set_attrs(row_id, attrs)
+
+    def _execute_set_column_attrs(self, idx: Index, c: Call) -> None:
+        col = c.args.get("_col")
+        if not isinstance(col, int):
+            raise ExecError("SetColumnAttrs() column argument required")
+        attrs = {k: v for k, v in c.args.items() if k != "_col"}
+        idx.column_attr_store.set_attrs(col, attrs)
+
+    # ------------------------------------------------------------------
+    # TopN (two-pass protocol, executor.go:860-999)
+    # ------------------------------------------------------------------
+
+    def _execute_topn(self, idx: Index, c: Call, shards, opt: ExecOptions) -> List[Pair]:
+        ids_arg = c.args.get("ids")
+        n = c.uint_arg("n")
+        pairs = self._topn_shards(idx, c, shards)
+        if not pairs or ids_arg or opt.remote:
+            if n and len(pairs) > n:
+                pairs = pairs[:n]
+            return pairs
+        # Second pass: exact counts for the candidate ids.
+        other = Call(c.name, dict(c.args), list(c.children))
+        other.args["ids"] = sorted(p.id for p in pairs)
+        trimmed = self._topn_shards(idx, other, shards)
+        if n and len(trimmed) > n:
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _topn_shards(self, idx: Index, c: Call, shards) -> List[Pair]:
+        merged: Dict[int, int] = {}
+        for shard in self._shards_for(idx, shards):
+            for pair in self._topn_shard(idx, c, shard):
+                merged[pair.id] = merged.get(pair.id, 0) + pair.count
+        pairs = [Pair(id=i, count=cnt) for i, cnt in merged.items()]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        return pairs
+
+    def _topn_shard(self, idx: Index, c: Call, shard: int) -> List[Pair]:
+        field_name = c.args.get("_field")
+        f = self._field_of(idx, field_name)
+        if f.options.type == FIELD_TYPE_INT:
+            raise ExecError(f"cannot compute TopN() on integer field: {field_name!r}")
+        if f.options.cache_type == "none":
+            raise ExecError(f'cannot compute TopN(), field has no cache: "{field_name}"')
+        n = c.uint_arg("n")
+        ids = c.args.get("ids")
+        threshold = c.uint_arg("threshold") or DEFAULT_MIN_THRESHOLD
+        src = None
+        if len(c.children) == 1:
+            src = self._bitmap_call_shard(idx, c.children[0], shard)
+            if src is None:
+                return []
+        elif len(c.children) > 1:
+            raise ExecError("TopN() can only have one input bitmap")
+        v = f.view(VIEW_STANDARD)
+        if v is None:
+            return []
+        frag = v.fragment_if_exists(shard)
+        if frag is None:
+            return []
+        if ids:
+            row_ids = [int(i) for i in ids]
+        else:
+            row_ids = frag.row_ids()
+        if not row_ids:
+            return []
+        counts = frag.row_counts(row_ids, src)
+        out = [
+            Pair(id=rid, count=int(cnt))
+            for rid, cnt in zip(row_ids, counts)
+            if cnt >= threshold
+        ]
+        out.sort(key=lambda p: (-p.count, p.id))
+        # per-shard candidate pool: keep enough for a correct global top-n
+        if n and not ids and len(out) > n * 2:
+            out = out[: n * 2]
+        return out
+
+    # ------------------------------------------------------------------
+    # Rows / GroupBy (executor.go:1068-1273)
+    # ------------------------------------------------------------------
+
+    def _execute_rows(self, idx: Index, c: Call, shards) -> List[int]:
+        field_name = c.string_arg("field") or c.args.get("_field")
+        if not field_name:
+            raise ExecError("Rows() field required")
+        col = c.uint_arg("column")
+        if col is not None:
+            shards = [col // SHARD_WIDTH]
+        limit = c.uint_arg("limit")
+        merged: set = set()
+        for shard in self._shards_for(idx, shards):
+            merged.update(self._rows_shard(idx, field_name, c, shard))
+        out = sorted(merged)
+        prev = c.uint_arg("previous")
+        if prev is not None:
+            out = [r for r in out if r > prev]
+        if limit is not None:
+            out = out[:limit]
+        return out
+
+    def _rows_shard(self, idx: Index, field_name: str, c: Call, shard: int) -> List[int]:
+        f = self._field_of(idx, field_name)
+        views = [VIEW_STANDARD]
+        from_arg = c.args.get("from")
+        to_arg = c.args.get("to")
+        if f.options.type == FIELD_TYPE_TIME and (
+            from_arg is not None or to_arg is not None or f.options.no_standard_view
+        ):
+            if not f.options.time_quantum:
+                return []
+            lo, hi = self._field_time_bounds(f)
+            if lo is None:
+                return []
+            from_t = timeq.parse_time(from_arg) if from_arg is not None else lo
+            to_t = timeq.parse_time(to_arg) if to_arg is not None else hi
+            views = timeq.views_by_time_range(VIEW_STANDARD, from_t, to_t, f.options.time_quantum)
+        col = c.uint_arg("column")
+        if col is not None and col // SHARD_WIDTH != shard:
+            return []
+        out: set = set()
+        for vname in views:
+            v = f.view(vname)
+            if v is None:
+                continue
+            frag = v.fragment_if_exists(shard)
+            if frag is None:
+                continue
+            ids = frag.row_ids()
+            if col is not None:
+                ids = [r for r in ids if frag.contains(r, col % SHARD_WIDTH)]
+            else:
+                ids = [r for r in ids if frag.row_count(r) > 0]
+            out.update(ids)
+        return sorted(out)
+
+    def _execute_group_by(self, idx: Index, c: Call, shards) -> List[GroupCount]:
+        if not c.children:
+            raise ExecError("need at least one child call")
+        for child in c.children:
+            if child.name != "Rows":
+                raise ExecError(
+                    f"'{child.name}' is not a valid child query for GroupBy, must be 'Rows'"
+                )
+        limit = c.uint_arg("limit")
+        filter_call = c.args.get("filter")
+        if filter_call is not None and not isinstance(filter_call, Call):
+            raise ExecError("GroupBy filter must be a query")
+
+        # Pre-fetch child row id lists (cluster-wide semantics).
+        child_fields = []
+        child_rows: List[List[int]] = []
+        for child in c.children:
+            fname = child.string_arg("field") or child.args.get("_field")
+            child_fields.append(fname)
+            child_rows.append(self._execute_rows(idx, child, shards))
+            if not child_rows[-1]:
+                return []
+
+        merged: Dict[Tuple[int, ...], int] = {}
+        for shard in self._shards_for(idx, shards):
+            fw = (
+                self._bitmap_call_shard(idx, filter_call, shard)
+                if filter_call is not None
+                else None
+            )
+            if filter_call is not None and fw is None:
+                continue
+            self._group_by_shard(
+                idx, child_fields, child_rows, fw, shard, merged
+            )
+        out = [
+            GroupCount(
+                group=[
+                    FieldRow(field=fn, row_id=rid)
+                    for fn, rid in zip(child_fields, key)
+                ],
+                count=cnt,
+            )
+            for key, cnt in merged.items()
+            if cnt > 0
+        ]
+        out.sort(key=lambda g: g.compare_key())
+        offset = c.uint_arg("offset")
+        if offset:
+            out = out[offset:]
+        if limit is not None:
+            out = out[:limit]
+        return out
+
+    def _group_by_shard(
+        self, idx, child_fields, child_rows, filter_words, shard, merged
+    ) -> None:
+        """Nested cross-product with zero-count pruning (the reference's
+        groupByIterator, executor.go:3063)."""
+        frags = []
+        for fname in child_fields:
+            f = self._field_of(idx, fname)
+            v = f.view(VIEW_STANDARD)
+            frag = v.fragment_if_exists(shard) if v is not None else None
+            if frag is None:
+                return
+            frags.append(frag)
+
+        def recurse(depth: int, acc_words, prefix: Tuple[int, ...]):
+            frag = frags[depth]
+            ids = [r for r in child_rows[depth] if frag.has_row(r)]
+            if not ids:
+                return
+            counts = frag.row_counts(ids, acc_words)
+            for rid, cnt in zip(ids, counts):
+                if cnt == 0:
+                    continue
+                key = prefix + (rid,)
+                if depth == len(frags) - 1:
+                    merged[key] = merged.get(key, 0) + int(cnt)
+                else:
+                    words = frag.row_device(rid)
+                    nxt = words if acc_words is None else ob.b_and(acc_words, words)
+                    recurse(depth + 1, nxt, key)
+
+        recurse(0, filter_words, ())
+
+    # ------------------------------------------------------------------
+    # Options (executor.go:360)
+    # ------------------------------------------------------------------
+
+    def _execute_options(self, idx: Index, c: Call, shards, opt: ExecOptions):
+        if len(c.children) != 1:
+            raise ExecError("Options() requires a single child query")
+        new_opt = ExecOptions(
+            remote=opt.remote,
+            exclude_row_attrs=bool(c.args.get("excludeRowAttrs", opt.exclude_row_attrs)),
+            exclude_columns=bool(c.args.get("excludeColumns", opt.exclude_columns)),
+            column_attrs=bool(c.args.get("columnAttrs", opt.column_attrs)),
+            max_writes=opt.max_writes,
+        )
+        s = c.args.get("shards")
+        if s is not None:
+            if not isinstance(s, list):
+                raise ExecError("Options() shards must be a list")
+            shards = [int(x) for x in s]
+        return self._execute_call(idx, c.children[0], shards, new_opt)

@@ -1,0 +1,2 @@
+from pilosa_tpu.pql.ast import Call, Condition, Query  # noqa: F401
+from pilosa_tpu.pql.parser import ParseError, parse  # noqa: F401

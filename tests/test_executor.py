@@ -1,0 +1,476 @@
+"""Executor integration tests (reference: executor_test.go patterns)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_TIME, FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import ExecOptions, Executor
+from pilosa_tpu.exec.executor import ExecError, GroupCount, Pair, ValCount
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def hx():
+    h = Holder().open()
+    h.create_index("i")
+    return h, Executor(h)
+
+
+def q(ex, pql, index="i", **kw):
+    return ex.execute(index, pql, **kw)
+
+
+class TestSetRowCount:
+    def test_set_and_row(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        assert q(ex, "Set(100, f=1)") == [True]
+        assert q(ex, "Set(100, f=1)") == [False]  # no change
+        (row,) = q(ex, "Row(f=1)")
+        assert row.columns().tolist() == [100]
+
+    def test_set_across_shards(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        cols = [3, SHARD_WIDTH + 5, 2 * SHARD_WIDTH + 7]
+        for c in cols:
+            q(ex, f"Set({c}, f=9)")
+        (row,) = q(ex, "Row(f=9)")
+        assert row.columns().tolist() == cols
+
+    def test_count(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        for c in [1, 2, SHARD_WIDTH + 1]:
+            q(ex, f"Set({c}, f=1)")
+        assert q(ex, "Count(Row(f=1))") == [3]
+
+    def test_clear(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, "Set(100, f=1)")
+        assert q(ex, "Clear(100, f=1)") == [True]
+        assert q(ex, "Clear(100, f=1)") == [False]
+        assert q(ex, "Count(Row(f=1))") == [0]
+
+    def test_multiple_calls_one_query(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        res = q(ex, "Set(1, f=1) Set(2, f=1) Count(Row(f=1))")
+        assert res == [True, True, 2]
+
+
+class TestBitmapAlgebra:
+    @pytest.fixture
+    def data(self, hx):
+        h, ex = hx
+        h.index("i").create_field("a")
+        h.index("i").create_field("b")
+        for c in [1, 2, 3, SHARD_WIDTH + 1]:
+            q(ex, f"Set({c}, a=1)")
+        for c in [2, 3, 4]:
+            q(ex, f"Set({c}, b=1)")
+        return h, ex
+
+    def test_intersect(self, data):
+        _, ex = data
+        (row,) = q(ex, "Intersect(Row(a=1), Row(b=1))")
+        assert row.columns().tolist() == [2, 3]
+
+    def test_union(self, data):
+        _, ex = data
+        (row,) = q(ex, "Union(Row(a=1), Row(b=1))")
+        assert row.columns().tolist() == [1, 2, 3, 4, SHARD_WIDTH + 1]
+
+    def test_difference(self, data):
+        _, ex = data
+        (row,) = q(ex, "Difference(Row(a=1), Row(b=1))")
+        assert row.columns().tolist() == [1, SHARD_WIDTH + 1]
+
+    def test_xor(self, data):
+        _, ex = data
+        (row,) = q(ex, "Xor(Row(a=1), Row(b=1))")
+        assert row.columns().tolist() == [1, 4, SHARD_WIDTH + 1]
+
+    def test_not(self, data):
+        _, ex = data
+        (row,) = q(ex, "Not(Row(b=1))")
+        # existence = all set columns; Not(b) = exists - b
+        assert row.columns().tolist() == [1, SHARD_WIDTH + 1]
+
+    def test_count_intersect(self, data):
+        _, ex = data
+        assert q(ex, "Count(Intersect(Row(a=1), Row(b=1)))") == [2]
+
+    def test_shift(self, data):
+        _, ex = data
+        (row,) = q(ex, "Shift(Row(b=1), n=2)")
+        assert row.columns().tolist() == [4, 5, 6]
+
+    def test_shift_across_shard_boundary(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, f"Set({SHARD_WIDTH - 1}, f=1)")
+        (row,) = q(ex, "Shift(Row(f=1), n=1)")
+        assert row.columns().tolist() == [SHARD_WIDTH]
+
+    def test_empty_intersect_error(self, data):
+        _, ex = data
+        with pytest.raises(ExecError):
+            q(ex, "Intersect()")
+
+
+class TestBSIQueries:
+    @pytest.fixture
+    def data(self, hx):
+        h, ex = hx
+        h.index("i").create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=-1000, max=1000))
+        h.index("i").create_field("f")
+        self.values = {1: 10, 2: -5, 3: 100, 4: 0, SHARD_WIDTH + 2: 40}
+        for col, val in self.values.items():
+            q(ex, f"Set({col}, v={val})")
+            q(ex, f"Set({col}, f=1)")
+        return h, ex
+
+    def test_row_gt(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(v > 5)")
+        assert row.columns().tolist() == [1, 3, SHARD_WIDTH + 2]
+
+    def test_row_lt_negative(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(v < 0)")
+        assert row.columns().tolist() == [2]
+
+    def test_row_eq_neq(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(v == 10)")
+        assert row.columns().tolist() == [1]
+        (row,) = q(ex, "Row(v != 10)")
+        assert row.columns().tolist() == [2, 3, 4, SHARD_WIDTH + 2]
+
+    def test_row_neq_null(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(v != null)")
+        assert row.columns().tolist() == sorted(self.values)
+
+    def test_row_between(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(0 <= v <= 40)")
+        assert row.columns().tolist() == [1, 4, SHARD_WIDTH + 2]
+        (row,) = q(ex, "Row(v >< [-5, 10])")
+        assert row.columns().tolist() == [1, 2, 4]
+
+    def test_row_saturated_ranges(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(v < 2000)")  # fully encompassing -> notNull
+        assert row.columns().tolist() == sorted(self.values)
+        (row,) = q(ex, "Row(v > 2000)")  # out of range -> empty
+        assert row.columns().tolist() == []
+
+    def test_sum(self, data):
+        _, ex = data
+        (vc,) = q(ex, "Sum(field=v)")
+        assert vc == ValCount(value=sum(self.values.values()), count=len(self.values))
+
+    def test_sum_filtered(self, data):
+        _, ex = data
+        (vc,) = q(ex, "Sum(Row(v > 0), field=v)")
+        positive = [v for v in self.values.values() if v > 0]
+        assert vc == ValCount(value=sum(positive), count=len(positive))
+
+    def test_min_max(self, data):
+        _, ex = data
+        assert q(ex, "Min(field=v)") == [ValCount(value=-5, count=1)]
+        assert q(ex, "Max(field=v)") == [ValCount(value=100, count=1)]
+
+    def test_min_max_filtered(self, data):
+        _, ex = data
+        (vc,) = q(ex, "Max(Row(v < 50), field=v)")
+        assert vc == ValCount(value=40, count=1)
+
+    def test_set_overwrite_value(self, data):
+        _, ex = data
+        q(ex, "Set(1, v=77)")
+        (row,) = q(ex, "Row(v == 77)")
+        assert row.columns().tolist() == [1]
+
+    def test_clear_value(self, data):
+        _, ex = data
+        assert q(ex, "Clear(1, v=0)") == [True]
+        (row,) = q(ex, "Row(v != null)")
+        assert 1 not in row.columns().tolist()
+
+
+class TestTopN:
+    @pytest.fixture
+    def data(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        # row 1: 4 cols, row 2: 2 cols, row 3: 6 cols (across 2 shards)
+        for c in [1, 2, 3, 4]:
+            q(ex, f"Set({c}, f=1)")
+        for c in [1, 2]:
+            q(ex, f"Set({c}, f=2)")
+        for c in [1, 2, 3, SHARD_WIDTH + 1, SHARD_WIDTH + 2, SHARD_WIDTH + 3]:
+            q(ex, f"Set({c}, f=3)")
+        return h, ex
+
+    def test_topn(self, data):
+        _, ex = data
+        (pairs,) = q(ex, "TopN(f, n=2)")
+        assert pairs == [Pair(id=3, count=6), Pair(id=1, count=4)]
+
+    def test_topn_all(self, data):
+        _, ex = data
+        (pairs,) = q(ex, "TopN(f)")
+        assert pairs == [Pair(id=3, count=6), Pair(id=1, count=4), Pair(id=2, count=2)]
+
+    def test_topn_with_src(self, data):
+        _, ex = data
+        (pairs,) = q(ex, "TopN(f, Row(f=2), n=5)")
+        assert pairs[0] == Pair(id=1, count=2) or pairs[0] == Pair(id=2, count=2)
+        by_id = {p.id: p.count for p in pairs}
+        assert by_id == {1: 2, 2: 2, 3: 2}
+
+    def test_topn_ids(self, data):
+        _, ex = data
+        (pairs,) = q(ex, "TopN(f, ids=[1, 2])")
+        assert {p.id: p.count for p in pairs} == {1: 4, 2: 2}
+
+    def test_topn_threshold(self, data):
+        _, ex = data
+        (pairs,) = q(ex, "TopN(f, threshold=3)")
+        assert {p.id for p in pairs} == {1, 3}
+
+    def test_topn_int_field_error(self, hx):
+        h, ex = hx
+        h.index("i").create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10))
+        with pytest.raises(ExecError, match="integer field"):
+            q(ex, "TopN(v)")
+
+
+class TestRowsGroupBy:
+    @pytest.fixture
+    def data(self, hx):
+        h, ex = hx
+        h.index("i").create_field("a")
+        h.index("i").create_field("b")
+        # a rows: 0 {1,2}, 1 {2,3}; b rows: 10 {1,3}, 11 {2}
+        for col, row in [(1, 0), (2, 0), (2, 1), (3, 1)]:
+            q(ex, f"Set({col}, a={row})")
+        for col, row in [(1, 10), (3, 10), (2, 11)]:
+            q(ex, f"Set({col}, b={row})")
+        return h, ex
+
+    def test_rows(self, data):
+        _, ex = data
+        assert q(ex, "Rows(a)") == [[0, 1]]
+
+    def test_rows_previous_limit(self, data):
+        _, ex = data
+        assert q(ex, "Rows(a, previous=0)") == [[1]]
+        assert q(ex, "Rows(a, limit=1)") == [[0]]
+
+    def test_rows_column(self, data):
+        _, ex = data
+        assert q(ex, "Rows(a, column=3)") == [[1]]
+
+    def test_groupby(self, data):
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(a), Rows(b))")
+        got = {(tuple(fr.row_id for fr in g.group)): g.count for g in groups}
+        # a=0 {1,2} x b=10 {1,3} -> {1}; a=0 x b=11 {2} -> {2};
+        # a=1 {2,3} x b=10 -> {3}; a=1 x b=11 -> {2}
+        assert got == {(0, 10): 1, (0, 11): 1, (1, 10): 1, (1, 11): 1}
+
+    def test_groupby_filter(self, data):
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(a), filter=Row(b=10))")
+        got = {tuple(fr.row_id for fr in g.group): g.count for g in groups}
+        assert got == {(0,): 1, (1,): 1}
+
+    def test_groupby_limit(self, data):
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(a), Rows(b), limit=2)")
+        assert len(groups) == 2
+
+    def test_groupby_invalid_child(self, data):
+        _, ex = data
+        with pytest.raises(ExecError, match="must be 'Rows'"):
+            q(ex, "GroupBy(Row(a=0))")
+
+
+class TestStoreClearRow:
+    def test_store(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        for c in [1, 2, 3]:
+            q(ex, f"Set({c}, f=1)")
+        assert q(ex, "Store(Row(f=1), f=9)") == [True]
+        (row,) = q(ex, "Row(f=9)")
+        assert row.columns().tolist() == [1, 2, 3]
+
+    def test_store_overwrites(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, "Set(1, f=1) Set(9, f=2) Set(10, f=2)")
+        q(ex, "Store(Row(f=1), f=2)")
+        (row,) = q(ex, "Row(f=2)")
+        assert row.columns().tolist() == [1]
+
+    def test_clear_row(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, f"Set(1, f=1) Set({SHARD_WIDTH + 1}, f=1) Set(2, f=2)")
+        assert q(ex, "ClearRow(f=1)") == [True]
+        assert q(ex, "Count(Row(f=1))") == [0]
+        assert q(ex, "Count(Row(f=2))") == [1]
+
+
+class TestTimeQueries:
+    @pytest.fixture
+    def data(self, hx):
+        h, ex = hx
+        h.index("i").create_field(
+            "e", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMDH")
+        )
+        q(ex, "Set(1, e=1, 2019-01-05T10:00)")
+        q(ex, "Set(2, e=1, 2019-03-10T11:00)")
+        q(ex, "Set(3, e=1, 2020-06-01T00:00)")
+        return h, ex
+
+    def test_row_no_range(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(e=1)")
+        assert row.columns().tolist() == [1, 2, 3]
+
+    def test_row_time_range(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(e=1, from='2019-01-01T00:00', to='2019-12-31T00:00')")
+        assert row.columns().tolist() == [1, 2]
+
+    def test_row_from_only(self, data):
+        _, ex = data
+        (row,) = q(ex, "Row(e=1, from='2019-02-01T00:00')")
+        assert row.columns().tolist() == [2, 3]
+
+    def test_rows_time_range(self, data):
+        _, ex = data
+        assert q(ex, "Rows(e, from='2019-01-01T00:00', to='2019-02-01T00:00')") == [[1]]
+
+
+class TestMutexBool:
+    def test_mutex_field(self, hx):
+        h, ex = hx
+        h.index("i").create_field("m", FieldOptions(type=FIELD_TYPE_MUTEX))
+        q(ex, "Set(5, m=1)")
+        q(ex, "Set(5, m=2)")
+        assert q(ex, "Count(Row(m=1))") == [0]
+        assert q(ex, "Count(Row(m=2))") == [1]
+
+    def test_bool_field(self, hx):
+        h, ex = hx
+        h.index("i").create_field("b", FieldOptions(type=FIELD_TYPE_BOOL))
+        q(ex, "Set(5, b=true)")
+        (row,) = q(ex, "Row(b=true)")
+        assert row.columns().tolist() == [5]
+        q(ex, "Set(5, b=false)")
+        (row,) = q(ex, "Row(b=false)")
+        assert row.columns().tolist() == [5]
+        assert q(ex, "Count(Row(b=true))") == [0]
+
+
+class TestAttrsOptions:
+    def test_row_attrs(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        assert q(ex, 'SetRowAttrs(f, 1, label="hello", rank=5)') == [None]
+        assert h.index("i").field("f").row_attr_store.attrs(1) == {
+            "label": "hello",
+            "rank": 5,
+        }
+
+    def test_column_attrs(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, 'SetColumnAttrs(9, name="col9")')
+        assert h.index("i").column_attr_store.attrs(9) == {"name": "col9"}
+
+    def test_attr_delete_with_null(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, 'SetRowAttrs(f, 1, x=5)')
+        q(ex, 'SetRowAttrs(f, 1, x=null)')
+        assert h.index("i").field("f").row_attr_store.attrs(1) == {}
+
+    def test_options_shards(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, f"Set(1, f=1) Set({SHARD_WIDTH + 1}, f=1)")
+        (row,) = q(ex, "Options(Row(f=1), shards=[0])")
+        assert row.columns().tolist() == [1]
+
+
+class TestErrors:
+    def test_missing_index(self, hx):
+        _, ex = hx
+        from pilosa_tpu.exec.executor import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            ex.execute("nope", "Row(f=1)")
+
+    def test_missing_field(self, hx):
+        _, ex = hx
+        from pilosa_tpu.exec.executor import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            q(ex, "Row(f=1)")
+
+    def test_count_two_children(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        with pytest.raises(ExecError):
+            q(ex, "Count(Row(f=1), Row(f=2))")
+
+
+class TestReviewRegressions:
+    """Regressions for review-confirmed bugs."""
+
+    def test_mutex_clear_then_set(self, hx):
+        # clear paths must maintain the mutex vector
+        h, ex = hx
+        h.index("i").create_field("b", FieldOptions(type=FIELD_TYPE_BOOL))
+        assert q(ex, "Set(5, b=true)") == [True]
+        assert q(ex, "Clear(5, b=true)") == [True]
+        assert q(ex, "Set(5, b=true)") == [True]  # was False before fix
+        assert q(ex, "Count(Row(b=true))") == [1]
+
+    def test_mutex_clear_row_then_set(self, hx):
+        h, ex = hx
+        h.index("i").create_field("m", FieldOptions(type=FIELD_TYPE_MUTEX))
+        q(ex, "Set(5, m=3)")
+        q(ex, "ClearRow(m=3)")
+        assert q(ex, "Set(5, m=3)") == [True]
+        assert q(ex, "Count(Row(m=3))") == [1]
+
+    def test_shift_nested_in_intersect(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, "Set(10, f=1) Set(11, f=2)")
+        (row,) = q(ex, "Intersect(Shift(Row(f=1), n=1), Row(f=2))")
+        assert row.columns().tolist() == [11]
+
+    def test_count_shift_across_boundary(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, f"Set({SHARD_WIDTH - 1}, f=1) Set(1, f=1)")
+        assert q(ex, "Count(Shift(Row(f=1), n=1))") == [2]
+
+    def test_nested_double_shift(self, hx):
+        h, ex = hx
+        h.index("i").create_field("f")
+        q(ex, f"Set({SHARD_WIDTH - 1}, f=1)")
+        (row,) = q(ex, "Shift(Shift(Row(f=1), n=1), n=1)")
+        assert row.columns().tolist() == [SHARD_WIDTH + 1]

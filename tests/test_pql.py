@@ -1,0 +1,249 @@
+"""PQL grammar corpus tests (reference: pql/pqlpeg_test.go patterns)."""
+
+import pytest
+
+from pilosa_tpu.pql import Call, Condition, ParseError, parse
+
+
+def one(src):
+    q = parse(src)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+class TestBasicCalls:
+    def test_set(self):
+        c = one("Set(1, f=2)")
+        assert c.name == "Set"
+        assert c.args == {"_col": 1, "f": 2}
+
+    def test_set_string_keys(self):
+        c = one('Set("col-key", f="row-key")')
+        assert c.args == {"_col": "col-key", "f": "row-key"}
+
+    def test_set_with_timestamp(self):
+        c = one("Set(1, f=2, 2019-07-04T12:00)")
+        assert c.args["_timestamp"] == "2019-07-04T12:00"
+
+    def test_set_with_quoted_timestamp(self):
+        c = one("Set(1, f=2, '2019-07-04T12:00')")
+        assert c.args["_timestamp"] == "2019-07-04T12:00"
+
+    def test_row(self):
+        c = one("Row(f=5)")
+        assert c.name == "Row" and c.args == {"f": 5}
+
+    def test_row_key(self):
+        assert one("Row(f=abcd)").args == {"f": "abcd"}
+
+    def test_clear(self):
+        assert one("Clear(3, f=1)").args == {"_col": 3, "f": 1}
+
+    def test_clear_row(self):
+        assert one("ClearRow(f=5)").args == {"f": 5}
+
+    def test_store(self):
+        c = one("Store(Row(f=10), f=20)")
+        assert c.name == "Store"
+        assert c.children[0].name == "Row"
+        assert c.args == {"f": 20}
+
+    def test_multiple_calls(self):
+        q = parse("Set(1, f=2) Set(3, f=4)\nCount(Row(f=2))")
+        assert [c.name for c in q.calls] == ["Set", "Set", "Count"]
+        assert q.write_call_n() == 2
+
+
+class TestNestedCalls:
+    def test_intersect(self):
+        c = one("Intersect(Row(a=1), Row(b=2))")
+        assert c.name == "Intersect"
+        assert [ch.name for ch in c.children] == ["Row", "Row"]
+        assert c.children[0].args == {"a": 1}
+
+    def test_deep_nesting(self):
+        c = one("Count(Union(Intersect(Row(a=1), Row(b=2)), Not(Row(c=3))))")
+        assert c.name == "Count"
+        u = c.children[0]
+        assert [ch.name for ch in u.children] == ["Intersect", "Not"]
+
+    def test_call_and_args_mix(self):
+        c = one("Shift(Row(f=1), n=3)")
+        assert c.children[0].name == "Row"
+        assert c.args == {"n": 3}
+
+    def test_call_as_arg_value(self):
+        c = one("Sum(filter=Row(a=1), field=f)")
+        assert isinstance(c.args["filter"], Call)
+        assert c.args["filter"].name == "Row"
+        assert c.args["field"] == "f"
+        assert c.children == []
+
+
+class TestTopNRows:
+    def test_topn_bare(self):
+        c = one("TopN(f)")
+        assert c.args == {"_field": "f"}
+
+    def test_topn_n(self):
+        c = one("TopN(f, n=5)")
+        assert c.args == {"_field": "f", "n": 5}
+
+    def test_topn_with_filter_child(self):
+        c = one("TopN(f, Row(other=1), n=5)")
+        assert c.children[0].name == "Row"
+        assert c.args["n"] == 5
+
+    def test_topn_attr_values(self):
+        c = one('TopN(f, n=2, attrName="category", attrValues=[1, 2, 3])')
+        assert c.args["attrValues"] == [1, 2, 3]
+
+    def test_rows(self):
+        c = one("Rows(f, limit=10, previous=3, column=5)")
+        assert c.args == {"_field": "f", "limit": 10, "previous": 3, "column": 5}
+
+    def test_groupby(self):
+        c = one("GroupBy(Rows(a), Rows(b), limit=10, filter=Row(c=1))")
+        assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+        assert c.args["limit"] == 10
+        assert isinstance(c.args["filter"], Call)
+
+
+class TestConditions:
+    def test_gt(self):
+        c = one("Row(f > 5)")
+        assert isinstance(c.args["f"], Condition)
+        assert c.args["f"].op == ">" and c.args["f"].value == 5
+
+    @pytest.mark.parametrize("op", ["<", ">", "<=", ">=", "==", "!="])
+    def test_all_ops(self, op):
+        c = one(f"Row(f {op} 5)")
+        assert c.args["f"].op == op
+
+    def test_neq_null(self):
+        c = one("Row(f != null)")
+        assert c.args["f"].op == "!=" and c.args["f"].value is None
+
+    def test_between_conditional(self):
+        c = one("Row(5 < f < 10)")
+        assert c.args["f"].op == "><"
+        assert c.args["f"].value == [6, 9]  # strict bounds shifted inward
+
+    def test_between_conditional_lte(self):
+        c = one("Row(5 <= f <= 10)")
+        assert c.args["f"].value == [5, 10]
+
+    def test_between_brackets(self):
+        c = one("Row(f >< [5, 10])")
+        assert c.args["f"].op == "><" and c.args["f"].value == [5, 10]
+
+    def test_negative_predicate(self):
+        c = one("Row(f > -10)")
+        assert c.args["f"].value == -10
+
+
+class TestRange:
+    def test_range_time(self):
+        c = one("Range(f=1, from='2010-01-01T00:00', to='2011-01-01T00:00')")
+        assert c.name == "Range"
+        assert c.args == {
+            "f": 1,
+            "from": "2010-01-01T00:00",
+            "to": "2011-01-01T00:00",
+        }
+
+    def test_range_no_keywords(self):
+        c = one("Range(f=1, 2010-01-01T00:00, 2011-01-01T00:00)")
+        assert c.args["from"] == "2010-01-01T00:00"
+
+    def test_range_cond_fallback(self):
+        c = one("Range(f > 5)")
+        assert c.args["f"].op == ">"
+
+    def test_row_time_range(self):
+        c = one("Row(f=1, from='2010-01-01T00:00', to='2011-01-01T00:00')")
+        assert c.args["from"] == "2010-01-01T00:00"
+
+
+class TestAttrs:
+    def test_set_row_attrs(self):
+        c = one('SetRowAttrs(f, 1, a=1, b="x", c=true, d=null)')
+        assert c.args == {"_field": "f", "_row": 1, "a": 1, "b": "x", "c": True, "d": None}
+
+    def test_set_column_attrs(self):
+        c = one("SetColumnAttrs(1, a=1.5, b=false)")
+        assert c.args == {"_col": 1, "a": 1.5, "b": False}
+
+    def test_set_row_attrs_string_row(self):
+        c = one('SetRowAttrs(f, "rowkey", x=1)')
+        assert c.args["_row"] == "rowkey"
+
+
+class TestValues:
+    def test_float(self):
+        assert one("F(x=1.5)").args["x"] == 1.5
+
+    def test_leading_dot_float(self):
+        assert one("F(x=.5)").args["x"] == 0.5
+
+    def test_negative(self):
+        assert one("F(x=-42)").args["x"] == -42
+
+    def test_bools_null(self):
+        assert one("F(a=true, b=false, c=null)").args == {"a": True, "b": False, "c": None}
+
+    def test_list(self):
+        assert one("F(x=[1, two, 3.5])").args["x"] == [1, "two", 3.5]
+
+    def test_quoted_strings(self):
+        assert one('F(x="hello world")').args["x"] == "hello world"
+        assert one("F(x='sq')").args["x"] == "sq"
+
+    def test_escaped_quotes(self):
+        assert one('F(x="he said \\"hi\\"")').args["x"] == 'he said "hi"'
+
+    def test_bare_string_with_specials(self):
+        assert one("F(x=ab-cd_ef:1)").args["x"] == "ab-cd_ef:1"
+
+    def test_options_shards(self):
+        c = one("Options(Row(f=1), excludeColumns=true, shards=[0, 2])")
+        assert c.children[0].name == "Row"
+        assert c.args == {"excludeColumns": True, "shards": [0, 2]}
+
+
+class TestErrors:
+    @pytest.mark.parametrize("bad", [
+        "Set(1, f=2",            # unbalanced
+        "Row(f=)",               # missing value
+        "Row(=5)",               # missing field
+        "Set(1, f=2))",          # trailing garbage
+        "Row(f ~ 5)",            # bad operator
+        "(Row(f=1))",            # no call name
+    ])
+    def test_syntax_errors(self, bad):
+        with pytest.raises(ParseError):
+            parse(bad)
+
+    def test_duplicate_arg(self):
+        with pytest.raises(ParseError, match="duplicate"):
+            parse("Row(f=1, f=2)")
+
+    def test_empty_query(self):
+        assert parse("").calls == []
+        assert parse("   \n\t ").calls == []
+
+    def test_error_reports_position(self):
+        with pytest.raises(ParseError, match="line 1"):
+            parse("Row(f=1) garbage")
+
+
+class TestStringification:
+    @pytest.mark.parametrize("src", [
+        "Row(f=5)",
+        "Intersect(Row(a=1), Row(b=2))",
+        "TopN(f, n=5)",
+    ])
+    def test_roundtrip(self, src):
+        q = parse(src)
+        q2 = parse(str(q))
+        assert str(q2) == str(q)
