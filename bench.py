@@ -1,0 +1,85 @@
+"""Headline benchmark: Count(Intersect(Row, Row)) on a 1-billion-column index.
+
+BASELINE.md north star: Count(Intersect) at 10B cols x 1M rows < 10 ms p50 on
+a v5e-64. This single-chip bench runs the same query shape at 1B columns
+(954 shards x 2^20 cols) — the per-chip slice of the 64-chip target — as one
+fused device reduction (no CPU bitmap math on the query path).
+
+The reference publishes no absolute numbers (BASELINE.md: "published: {}"),
+so vs_baseline is measured on the spot: the same popcount(a & b) computed
+with vectorized numpy (16-bit LUT) on the host CPU — the reference's
+execution model (per-shard CPU bitmap math) with Python/HTTP overheads
+removed, i.e. a generous stand-in for the Go engine. vs_baseline = CPU p50 /
+TPU p50 (higher = faster than baseline).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from pilosa_tpu.parallel.mesh import count_and_stacked
+    from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+
+    n_cols = 1_000_000_000
+    n_shards = (n_cols + SHARD_WIDTH - 1) // SHARD_WIDTH
+    shape = (n_shards, WORDS_PER_ROW)
+
+    rng = np.random.default_rng(7)
+    # ~25% bit density: dense-ish rows (worst case for the compute path;
+    # sparse shards would be skipped by the executor's shard index).
+    a_h = (rng.integers(0, 2**32, shape, np.uint32) & rng.integers(0, 2**32, shape, np.uint32)).astype(np.uint32)
+    b_h = (rng.integers(0, 2**32, shape, np.uint32) & rng.integers(0, 2**32, shape, np.uint32)).astype(np.uint32)
+
+    a = jax.device_put(a_h)
+    b = jax.device_put(b_h)
+    # warmup / compile
+    expect = int(count_and_stacked(a, b))
+
+    iters = 30
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = count_and_stacked(a, b)
+        out.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000)
+    tpu_p50 = float(np.median(times))
+
+    # CPU comparator: vectorized numpy popcount over the same data.
+    if hasattr(np, "bitwise_count"):
+        def cpu_count():
+            return int(np.bitwise_count(a_h & b_h).sum())
+    else:
+        lut = np.array([bin(i).count("1") for i in range(1 << 16)], np.uint16)
+        def cpu_count():
+            return int(lut[(a_h & b_h).view(np.uint16)].sum(dtype=np.int64))
+
+    cpu_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        got = cpu_count()
+        cpu_times.append((time.perf_counter() - t0) * 1000)
+    cpu_p50 = float(np.median(cpu_times))
+    assert got == expect, (got, expect)
+
+    print(
+        json.dumps(
+            {
+                "metric": "count_intersect_1b_cols_p50_ms",
+                "value": round(tpu_p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_p50 / tpu_p50, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

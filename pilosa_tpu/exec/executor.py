@@ -214,27 +214,42 @@ class Executor:
     def _execute_bitmap_call(self, idx: Index, c: Call, shards) -> Row:
         shard_list = self._shards_for(idx, shards)
         segments = {}
+        memo: dict = {}
         for shard in shard_list:
-            words = self._bitmap_call_shard(idx, c, shard)
+            words = self._bitmap_call_shard(idx, c, shard, memo)
             if words is not None:
                 segments[shard] = words
         return Row(segments)
 
-    def _bitmap_call_shard(self, idx: Index, c: Call, shard: int):
-        """Lower one bitmap call for one shard to device words (or None)."""
+    def _bitmap_call_shard(self, idx: Index, c: Call, shard: int, memo=None):
+        """Lower one bitmap call for one shard to device words (or None).
+
+        `memo` caches (call, shard) -> words within one query execution so a
+        call subtree referenced twice (e.g. by Shift's cross-shard carry) is
+        lowered once."""
+        if memo is not None:
+            key = (id(c), shard)
+            if key in memo:
+                return memo[key]
+        words = self._bitmap_call_shard_uncached(idx, c, shard, memo)
+        if memo is not None:
+            memo[(id(c), shard)] = words
+        return words
+
+    def _bitmap_call_shard_uncached(self, idx: Index, c: Call, shard: int, memo=None):
         name = c.name
         if name in ("Row", "Range"):
             return self._row_shard(idx, c, shard)
         if name == "Intersect":
-            return self._nary_shard(idx, c, shard, "intersect")
+            return self._nary_shard(idx, c, shard, "intersect", memo)
         if name == "Union":
-            return self._nary_shard(idx, c, shard, "union")
+            return self._nary_shard(idx, c, shard, "union", memo)
         if name == "Difference":
-            return self._nary_shard(idx, c, shard, "difference")
+            return self._nary_shard(idx, c, shard, "difference", memo)
         if name == "Xor":
-            return self._nary_shard(idx, c, shard, "xor")
+            return self._nary_shard(idx, c, shard, "xor", memo)
         if name == "Not":
-            return self._not_shard(idx, c, shard)
+            return self._not_shard(idx, c, shard, memo)
         if name == "Shift":
             # Shift crosses shard boundaries: this shard's result is its own
             # child bits shifted up, OR'd with the overflow carried out of the
@@ -244,12 +259,12 @@ class Executor:
                 raise ExecError("Shift() requires a single bitmap input")
             n = c.int_arg("n")
             n = 1 if n is None else n
-            cur = self._bitmap_call_shard(idx, c.children[0], shard)
+            cur = self._bitmap_call_shard(idx, c.children[0], shard, memo)
             out = None
             if cur is not None:
                 out, _ = ob.shift_bits(cur, n)
             if shard > 0:
-                prev = self._bitmap_call_shard(idx, c.children[0], shard - 1)
+                prev = self._bitmap_call_shard(idx, c.children[0], shard - 1, memo)
                 if prev is not None:
                     _, carry = ob.shift_bits(prev, n)
                     out = carry if out is None else ob.b_or(out, carry)
@@ -258,12 +273,12 @@ class Executor:
             return self._existence_words(idx, shard)
         raise ExecError(f"unknown call: {name}")
 
-    def _nary_shard(self, idx: Index, c: Call, shard: int, op: str):
+    def _nary_shard(self, idx: Index, c: Call, shard: int, op: str, memo=None):
         if not c.children:
             if op == "intersect":
                 raise ExecError("empty Intersect query is currently not supported")
             return None
-        words = [self._bitmap_call_shard(idx, ch, shard) for ch in c.children]
+        words = [self._bitmap_call_shard(idx, ch, shard, memo) for ch in c.children]
         zero = None
         if op == "intersect":
             if any(w is None for w in words):
@@ -298,7 +313,7 @@ class Executor:
             return out
         raise AssertionError(op)
 
-    def _not_shard(self, idx: Index, c: Call, shard: int):
+    def _not_shard(self, idx: Index, c: Call, shard: int, memo=None):
         """Not via the existence field (executor.go:1734 executeNot)."""
         if not idx.track_existence:
             raise ExecError("Not() query requires existence tracking to be enabled")
@@ -307,7 +322,7 @@ class Executor:
         exists = self._existence_words(idx, shard)
         if exists is None:
             return None
-        child = self._bitmap_call_shard(idx, c.children[0], shard)
+        child = self._bitmap_call_shard(idx, c.children[0], shard, memo)
         if child is None:
             return exists
         return ob.b_andnot(exists, child)
@@ -448,8 +463,9 @@ class Executor:
             raise ExecError("Count() only accepts a single bitmap input")
         shard_list = self._shards_for(idx, shards)
         total = 0
+        memo: dict = {}
         for shard in shard_list:
-            words = self._bitmap_call_shard(idx, c.children[0], shard)
+            words = self._bitmap_call_shard(idx, c.children[0], shard, memo)
             if words is not None:
                 total += int(ob.popcount(words))
         return total
@@ -623,7 +639,9 @@ class Executor:
                 pos = frag.row_positions(row_id)
                 if len(pos):
                     frag.import_positions(
-                        None, np.uint64(row_id) * SHARD_WIDTH + pos.astype(np.uint64)
+                        None,
+                        np.uint64(row_id) * np.uint64(SHARD_WIDTH)
+                        + pos.astype(np.uint64),
                     )
                     changed = True
         return changed
@@ -634,9 +652,12 @@ class Executor:
         if len(c.children) != 1:
             raise ExecError("Store() requires a single bitmap input")
         field_name = self._field_arg_name(c)
-        f = idx.field(field_name)
-        if f is None:
-            f = idx.create_field(field_name)
+        f = self._field_of(idx, field_name)
+        if f.options.type != "set":
+            # reference executeSetRowShard (executor.go:1989) only allows set
+            # fields — overwriting rows on mutex/bool would break the
+            # one-row-per-column invariant, and BSI views aren't row-shaped.
+            raise ExecError("Store() is only supported on set fields")
         row_id = c.args.get(field_name)
         if not isinstance(row_id, int):
             raise ExecError("Store() row argument required")
@@ -688,9 +709,10 @@ class Executor:
         ids_arg = c.args.get("ids")
         n = c.uint_arg("n")
         pairs = self._topn_shards(idx, c, shards)
+        # ids/remote paths return untrimmed (reference executor.go:881): the
+        # caller (or coordinating node) needs exact counts for every
+        # candidate id to merge correctly.
         if not pairs or ids_arg or opt.remote:
-            if n and len(pairs) > n:
-                pairs = pairs[:n]
             return pairs
         # Second pass: exact counts for the candidate ids.
         other = Call(c.name, dict(c.args), list(c.children))

@@ -1,0 +1,6 @@
+from pilosa_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    make_query_step,
+    make_single_device_step,
+    shard_stack,
+)

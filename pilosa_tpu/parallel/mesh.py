@@ -1,0 +1,154 @@
+"""Mesh parallelism: the distributed query/ingest step.
+
+This replaces the reference's per-shard mapReduce + HTTP fan-out
+(/root/reference/executor.go:2460-2613 mapperLocal/worker pool, and the
+cluster broadcast plane cluster.go/broadcast.go) with a compiled SPMD
+program over a `jax.sharding.Mesh`:
+
+- mesh axis "shards": the shard (column-block) axis — the reference's
+  data-parallel unit (`shard = col / ShardWidth`). Each device owns a
+  contiguous stripe of shards, exactly like nodes own shard partitions.
+- mesh axis "cols": the word axis *within* a shard — sequence-parallel
+  splitting of the column space, the analog of the reference's
+  2^16-bit containers within a shard (fragment.go:55-63).
+
+Reductions (Count, TopN tallies, BSI plane counts) become `lax.psum` over
+both axes — they ride ICI instead of HTTP+protobuf. Union/Intersect are
+elementwise and need no communication at all. Ingest is a bitwise-or merge
+with buffer donation, the device-side analog of fragment.bulkImport
+(fragment.go:1997).
+
+Data layout: `data: uint32[S, R, W]` — S shards × R rows × W words,
+sharded P("shards", None, "cols"). Rows are replicated across the mesh so
+any row pair intersects locally (rows are the small axis; shards/cols are
+the 2^64-column scale-out axes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pilosa_tpu.ops import bitmap as ob
+
+_pc = jax.lax.population_count
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None, shards_axis: Optional[int] = None
+) -> Mesh:
+    """Build a 2D ("shards", "cols") mesh over the given devices.
+
+    The factorization favors the shard axis (the reference's scaling axis);
+    "cols" gets a factor of 2 when the device count allows, exercising the
+    sequence-parallel dimension."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shards_axis is None:
+        cols_axis = 2 if n % 2 == 0 and n >= 4 else 1
+        shards_axis = n // cols_axis
+    else:
+        cols_axis = n // shards_axis
+    if shards_axis * cols_axis != n:
+        raise ValueError(f"cannot factor {n} devices into ({shards_axis}, {cols_axis})")
+    arr = np.array(devices).reshape(shards_axis, cols_axis)
+    return Mesh(arr, ("shards", "cols"))
+
+
+DATA_SPEC = P("shards", None, "cols")
+
+
+def shard_stack(mesh: Mesh, data: np.ndarray) -> jax.Array:
+    """Place a [S, R, W] stack onto the mesh with the canonical sharding."""
+    return jax.device_put(data, NamedSharding(mesh, DATA_SPEC))
+
+
+def _query_math(data, row_a: int, row_b: int):
+    """The shared single-program query math over a local [S, R, W] block.
+
+    Returns (intersect_count, union_count, row_counts[R], bsi_plane_counts)
+    as LOCAL partial sums — callers psum them (mesh path) or use them
+    directly (single device).
+    """
+    a = data[:, row_a, :]
+    b = data[:, row_b, :]
+    intersect_count = jnp.sum(_pc(jnp.bitwise_and(a, b)), dtype=jnp.uint32)
+    union_count = jnp.sum(_pc(jnp.bitwise_or(a, b)), dtype=jnp.uint32)
+    # per-row tallies: the TopN candidate counts AND the BSI per-plane counts
+    # (planes are rows 2.. in a BSI fragment) in one reduction.
+    row_counts = jnp.sum(_pc(data), axis=(0, 2), dtype=jnp.uint32)
+    return intersect_count, union_count, row_counts
+
+
+def make_query_step(mesh: Mesh, row_a: int = 0, row_b: int = 1):
+    """Compiled distributed ingest+query step.
+
+    One call = the full Pilosa serving loop for a query batch: merge a delta
+    of new bits (ingest), then answer Count(Intersect), Count(Union) and the
+    per-row tallies, with psum reductions over ICI. `data` is donated — the
+    store updates in place in HBM.
+    """
+
+    def local_step(data, delta):
+        data = jnp.bitwise_or(data, delta)
+        inter, uni, rows = _query_math(data, row_a, row_b)
+        inter = jax.lax.psum(inter, ("shards", "cols"))
+        uni = jax.lax.psum(uni, ("shards", "cols"))
+        rows = jax.lax.psum(rows, ("shards", "cols"))
+        return data, inter, uni, rows
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(DATA_SPEC, DATA_SPEC),
+        out_specs=(DATA_SPEC, P(), P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_single_device_step(row_a: int = 0, row_b: int = 1):
+    """Single-chip version of the query step (same math, no collectives)."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(data, delta):
+        data = jnp.bitwise_or(data, delta)
+        inter, uni, rows = _query_math(data, row_a, row_b)
+        return data, inter, uni, rows
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Sharded executor bridge: stack fragment rows across shards and answer
+# multi-shard counts in one compiled call (used by bench + the server's
+# fast path for large indexes).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def count_and_stacked(a, b):
+    """Total intersection count over stacked [S, W] rows. When a/b carry a
+    NamedSharding, XLA partitions the reduction and inserts the all-reduce."""
+    return jnp.sum(_pc(jnp.bitwise_and(a, b)), dtype=jnp.uint32)
+
+
+@jax.jit
+def count_stacked(a):
+    return jnp.sum(_pc(a), dtype=jnp.uint32)
+
+
+def stack_field_row(field, row_id: int, shards: Sequence[int]) -> np.ndarray:
+    """Materialize one row across shards as a [S, W] host stack."""
+    from pilosa_tpu.core.view import VIEW_STANDARD
+
+    v = field.view(VIEW_STANDARD)
+    rows = []
+    for s in shards:
+        frag = v.fragment_if_exists(s) if v is not None else None
+        rows.append(frag.row_words(row_id) if frag is not None else ob.empty_row())
+    return np.stack(rows)

@@ -1,0 +1,31 @@
+"""Force a pure-CPU JAX runtime with N virtual devices.
+
+The hosted-TPU environment registers a tunneled PJRT backend from
+sitecustomize at interpreter start — which also pre-imports jax, so
+JAX_PLATFORMS set afterwards (e.g. by a test conftest) may be ignored, and
+any backend enumeration dials the TPU tunnel even for CPU-only work (and
+hangs when the tunnel is unhealthy). This helper makes CPU-only runs
+hermetic: drop non-CPU backend factories before any client is created and
+pin the platform via jax.config.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Must run before the first jax.devices()/jit call in the process."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+    from jax._src import xla_bridge as xb
+
+    for name in list(xb._backend_factories):
+        if name != "cpu":
+            xb._backend_factories.pop(name)
+    jax.config.update("jax_platforms", "cpu")

@@ -5,15 +5,14 @@ in-process multi-node cluster harness, /root/reference/test/pilosa.go:390
 MustRunCluster). Real-TPU behavior is exercised by bench.py and the driver's
 compile checks, not by the unit suite.
 
-Env must be set before jax is imported anywhere.
+force_cpu must run before anything initializes a JAX backend — the hosted
+environment's sitecustomize pre-registers a tunneled TPU backend that would
+otherwise be dialed (and can hang) even for CPU-only tests.
 """
 
-import os
+from pilosa_tpu.utils.cpuonly import force_cpu
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+force_cpu(8)
 
 import numpy as np
 import pytest
