@@ -95,6 +95,12 @@ class Field:
         self.row_attr_store = AttrStore(
             None if path is None else os.path.join(path, ".row_attrs.json")
         )
+        # row key translation (reference: field.go per-field translateStore)
+        from pilosa_tpu.core.translate import TranslateStore
+
+        self.translate_store = TranslateStore(
+            None if path is None else os.path.join(path, ".keys.translate")
+        )
 
         if options.type == FIELD_TYPE_INT:
             if options.min == 0 and options.max == 0:
@@ -128,12 +134,15 @@ class Field:
             if os.path.isdir(views_dir):
                 for vname in sorted(os.listdir(views_dir)):
                     self._view_create(vname)
+        if self.options.keys:
+            self.translate_store.open()
         return self
 
     def close(self) -> None:
         with self._mu:
             for v in self.views.values():
                 v.close()
+            self.translate_store.close()
 
     def save_meta(self) -> None:
         if self.path is None:

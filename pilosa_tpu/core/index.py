@@ -45,6 +45,12 @@ class Index:
         self.column_attr_store = AttrStore(
             None if path is None else os.path.join(path, ".col_attrs.json")
         )
+        # column key translation (reference: index.go per-index translateStore)
+        from pilosa_tpu.core.translate import TranslateStore
+
+        self.translate_store = TranslateStore(
+            None if path is None else os.path.join(path, ".keys.translate")
+        )
 
     # ------------------------------------------------------------------
 
@@ -71,12 +77,15 @@ class Index:
                     self._fields[fn] = f
         if self.track_existence and EXISTENCE_FIELD_NAME not in self._fields:
             self._create_existence_field()
+        if self.keys:
+            self.translate_store.open()
         return self
 
     def close(self) -> None:
         with self._mu:
             for f in self._fields.values():
                 f.close()
+            self.translate_store.close()
 
     def save_meta(self) -> None:
         if self.path is None:

@@ -1,0 +1,190 @@
+"""Key translation: string key <-> uint64 id stores.
+
+Reference: /root/reference/translate.go (TranslateStore iface :35,
+InMemTranslateStore :195) and boltdb/translate.go:48-310 (file-backed store
+with monotonic ids, single-writer append log consumed by replicas over HTTP,
+http/translator.go:44-128).
+
+TPU-native design: translation is inherently a serial string-keyed KV and
+must stay OFF the device query path (SURVEY.md hard-part #4). This store is
+host-only: an in-memory bidirectional map backed by an append-only log file
+(length-prefixed records), replayed on open. Monotonic ids start at 1 (id 0
+is reserved as "not found", matching boltdb/translate.go semantics).
+Replication: `entries_since(offset)` exposes the append log so a replica (or
+the HTTP translator endpoint) can follow the primary, mirroring
+TranslateEntryReader (holder.go:738-880).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_REC = struct.Struct("<QI")  # id, key-length ; followed by key bytes
+
+
+class TranslateError(Exception):
+    pass
+
+
+class ReadOnlyError(TranslateError):
+    """Raised when writing to a non-primary (replica) store.
+
+    Reference: boltdb/translate.go returns ErrTranslateStoreReadOnly for
+    non-coordinator writes; callers forward the write to the primary."""
+
+
+class TranslateStore:
+    """Bidirectional string<->id map with an append-only on-disk log.
+
+    One store per keyed index (columns) and one per keyed field (rows),
+    mirroring the reference's per-index/per-field boltdb stores."""
+
+    def __init__(self, path: Optional[str] = None, read_only: bool = False):
+        self.path = path
+        self.read_only = read_only
+        self._lock = threading.RLock()
+        self._by_key: Dict[str, int] = {}
+        self._by_id: Dict[int, str] = {}
+        self._next_id = 1
+        self._log_size = 0  # byte offset == replication offset
+        self._fh = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self) -> "TranslateStore":
+        if self.path:
+            if os.path.exists(self.path):
+                self._replay()
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._fh = open(self.path, "ab")
+        return self
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def _replay(self) -> None:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        n = len(data)
+        while off + _REC.size <= n:
+            id_, klen = _REC.unpack_from(data, off)
+            end = off + _REC.size + klen
+            if end > n:  # truncated tail record (crash mid-append): drop it
+                break
+            key = data[off + _REC.size : end].decode("utf-8")
+            self._by_key[key] = id_
+            self._by_id[id_] = key
+            self._next_id = max(self._next_id, id_ + 1)
+            off = end
+        self._log_size = off
+        if off < n:  # truncate the torn tail so appends realign
+            with open(self.path, "r+b") as f:
+                f.truncate(off)
+
+    # -- writes ------------------------------------------------------------
+
+    def translate_key(self, key: str) -> int:
+        """Return the id for key, creating it if absent (single-writer)."""
+        return self.translate_keys([key])[0]
+
+    def translate_keys(self, keys: Sequence[str]) -> List[int]:
+        with self._lock:
+            out = []
+            new: List[Tuple[int, str]] = []
+            for key in keys:
+                id_ = self._by_key.get(key)
+                if id_ is None:
+                    if self.read_only:
+                        raise ReadOnlyError(
+                            f"translate store is read-only; forward {key!r} to primary"
+                        )
+                    id_ = self._next_id
+                    self._next_id += 1
+                    self._by_key[key] = id_
+                    self._by_id[id_] = key
+                    new.append((id_, key))
+                out.append(id_)
+            if new:
+                self._append(new)
+            return out
+
+    def apply_entries(self, entries: Iterable[Tuple[int, str]]) -> None:
+        """Apply replicated entries from the primary (replica follow path)."""
+        with self._lock:
+            new = []
+            for id_, key in entries:
+                if id_ in self._by_id:
+                    continue
+                self._by_id[id_] = key
+                self._by_key[key] = id_
+                self._next_id = max(self._next_id, id_ + 1)
+                new.append((id_, key))
+            if new:
+                self._append(new)
+
+    def _append(self, recs: List[Tuple[int, str]]) -> None:
+        blob = b"".join(
+            _REC.pack(id_, len(kb)) + kb
+            for id_, kb in ((i, k.encode("utf-8")) for i, k in recs)
+        )
+        self._log_size += len(blob)
+        if self._fh:
+            self._fh.write(blob)
+            self._fh.flush()
+
+    # -- reads -------------------------------------------------------------
+
+    def find_key(self, key: str) -> Optional[int]:
+        """id for key, or None — never creates (read path)."""
+        return self._by_key.get(key)
+
+    def key_for_id(self, id_: int) -> Optional[str]:
+        return self._by_id.get(id_)
+
+    def keys_for_ids(self, ids: Sequence[int]) -> List[Optional[str]]:
+        return [self._by_id.get(i) for i in ids]
+
+    def max_id(self) -> int:
+        return self._next_id - 1
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    # -- replication -------------------------------------------------------
+
+    @property
+    def write_offset(self) -> int:
+        """Current append-log byte offset (replication high-water mark)."""
+        return self._log_size
+
+    def entries_since(self, offset: int = 0) -> Tuple[List[Tuple[int, str]], int]:
+        """Entries appended at/after byte offset; returns (entries, new_offset).
+
+        Reference: the HTTP translate-data endpoint streams the boltdb log
+        from an offset (http/translator.go:44-128)."""
+        with self._lock:
+            if not self.path or not os.path.exists(self.path):
+                # memory-only store: serve from the map (offset = entry index)
+                items = sorted(self._by_id.items())
+                return items[offset:], len(items)
+            if self._fh:
+                self._fh.flush()
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                data = f.read()
+        out = []
+        off = 0
+        while off + _REC.size <= len(data):
+            id_, klen = _REC.unpack_from(data, off)
+            end = off + _REC.size + klen
+            if end > len(data):
+                break
+            out.append((id_, data[off + _REC.size : end].decode("utf-8")))
+            off = end
+        return out, offset + off

@@ -29,6 +29,7 @@ from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec import translation
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
@@ -136,9 +137,16 @@ class Executor:
             raise ExecError("too many writes in a single request")
         if shards is None:
             shards = opt.shards
+        # key -> id translation (executor.go:2615 translateCalls); remote
+        # (fan-out) requests arrive pre-translated by the coordinator.
+        if not opt.remote:
+            translation.translate_query(idx, query)
         results = []
         for call in query.calls:
             results.append(self._execute_call(idx, call, shards, opt))
+        # id -> key translation of results (executor.go:2786)
+        if not opt.remote:
+            results = translation.translate_results(idx, query, results)
         return results
 
     def _shards_for(self, idx: Index, shards, call: Optional[Call] = None) -> List[int]:

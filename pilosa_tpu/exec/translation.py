@@ -1,0 +1,148 @@
+"""Query key translation: string keys in calls <-> uint64 ids in results.
+
+Reference: /root/reference/executor.go:2615-2912 (translateCalls /
+translateResults) — before execution, every string key in the AST is
+replaced by its uint64 id via the index's column TranslateStore or the
+field's row TranslateStore; after execution, ids in results are mapped back
+to keys when the index/field has keys enabled.
+
+Translation allocates ids on demand (the reference's
+TranslateColumnsToUint64 allocates for both reads and writes — a read of a
+never-seen key yields a fresh id whose row/column is empty, so results are
+unchanged). Allocation is host-side and never touches the device path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.pql.ast import Call, Query
+
+# Calls whose `_col` argument addresses a column in the index's key space.
+_COL_CALLS = {"Set", "Clear", "SetColumnAttrs"}
+# Calls whose `_row` argument addresses a row of the `_field` field.
+_ROW_CALLS = {"ClearRow", "Store", "SetRowAttrs"}
+
+
+class TranslationError(Exception):
+    pass
+
+
+def translate_call(idx: Index, c: Call) -> None:
+    """In-place key->id translation of one call tree."""
+    # column keys (Set(col, ...), SetColumnAttrs(col, ...), Row(_col=...) n/a)
+    col = c.args.get("_col")
+    if isinstance(col, str):
+        if not idx.keys:
+            raise TranslationError(
+                f"string column key {col!r} requires index keys=true"
+            )
+        c.args["_col"] = idx.translate_store.translate_key(col)
+    elif col is not None and idx.keys and not isinstance(col, bool):
+        # integer column on a keyed index is an error in the reference
+        raise TranslationError("column value must be a string when index keys are on")
+
+    # row keys via _row + _field (ClearRow/Store/SetRowAttrs forms)
+    row = c.args.get("_row")
+    if isinstance(row, str):
+        fname = c.args.get("_field")
+        f = idx.field(fname) if fname else None
+        if f is None or not f.options.keys:
+            raise TranslationError(
+                f"string row key {row!r} requires field keys=true"
+            )
+        c.args["_row"] = f.translate_store.translate_key(row)
+
+    # row keys via field-named args: Row(f="key"), Set(c, f="key"), ...
+    for k in list(c.args):
+        if k.startswith("_") or k in ("from", "to"):
+            continue
+        v = c.args[k]
+        if not isinstance(v, str):
+            continue
+        f = idx.field(k)
+        if f is None:
+            continue
+        if not f.options.keys:
+            raise TranslationError(
+                f"string row key {v!r} requires field {k!r} keys=true"
+            )
+        c.args[k] = f.translate_store.translate_key(v)
+
+    # Rows(previous="key") pagination cursor
+    prev = c.args.get("previous")
+    if isinstance(prev, str):
+        fname = c.args.get("field") or c.args.get("_field")
+        f = idx.field(fname) if fname else None
+        if f is None or not f.options.keys:
+            raise TranslationError("Rows(previous=<key>) requires field keys=true")
+        c.args["previous"] = f.translate_store.translate_key(prev)
+
+    # Rows(column="key") / GroupBy filter columns
+    colarg = c.args.get("column")
+    if isinstance(colarg, str):
+        if not idx.keys:
+            raise TranslationError("string column key requires index keys=true")
+        c.args["column"] = idx.translate_store.translate_key(colarg)
+
+    # nested calls in args (e.g. GroupBy filter=<call>)
+    for v in c.args.values():
+        if isinstance(v, Call):
+            translate_call(idx, v)
+    for child in c.children:
+        translate_call(idx, child)
+
+
+def translate_query(idx: Index, q: Query) -> None:
+    for c in q.calls:
+        translate_call(idx, c)
+
+
+def translate_result(idx: Index, c: Call, result: Any) -> Any:
+    """Id->key translation of one call's result (reference:
+    translateResults, executor.go:2786)."""
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.exec.executor import FieldRow, GroupCount, Pair
+
+    if isinstance(result, Row):
+        if idx.keys:
+            result.keys = [
+                idx.translate_store.key_for_id(int(c_)) or ""
+                for c_ in result.columns().tolist()
+            ]
+        return result
+
+    if isinstance(result, list) and result and isinstance(result[0], Pair):
+        fname = c.args.get("_field") or c.string_arg("field")
+        f = idx.field(fname) if fname else None
+        if f is not None and f.options.keys:
+            for p in result:
+                p.key = f.translate_store.key_for_id(p.id)
+        return result
+
+    if isinstance(result, list) and result and isinstance(result[0], GroupCount):
+        for gc in result:
+            for fr in gc.group:
+                f = idx.field(fr.field)
+                if f is not None and f.options.keys:
+                    fr.row_key = f.translate_store.key_for_id(fr.row_id)
+        return result
+
+    # Rows() -> list of row ids
+    if (
+        c.name == "Rows"
+        and isinstance(result, list)
+        and (not result or isinstance(result[0], int))
+    ):
+        fname = c.string_arg("field") or c.args.get("_field")
+        f = idx.field(fname) if fname else None
+        if f is not None and f.options.keys:
+            return [f.translate_store.key_for_id(r) for r in result]
+        return result
+
+    return result
+
+
+def translate_results(idx: Index, q: Query, results: List[Any]) -> List[Any]:
+    return [translate_result(idx, c, r) for c, r in zip(q.calls, results)]

@@ -1,0 +1,168 @@
+"""Key translation tests.
+
+Reference semantics: translate.go / boltdb/translate.go (monotonic ids from
+1, persistence, replication log) and executor.go:2615-2912 (call/result
+translation on keyed indexes/fields).
+"""
+
+import os
+
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.translate import ReadOnlyError, TranslateStore
+from pilosa_tpu.exec.executor import Executor, Pair
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def test_store_monotonic_ids():
+    s = TranslateStore().open()
+    assert s.translate_key("a") == 1
+    assert s.translate_key("b") == 2
+    assert s.translate_key("a") == 1
+    assert s.translate_keys(["c", "a", "d"]) == [3, 1, 4]
+    assert s.key_for_id(3) == "c"
+    assert s.find_key("zzz") is None
+    assert s.max_id() == 4
+    assert len(s) == 4
+
+
+def test_store_persistence(tmp_path):
+    p = str(tmp_path / "keys.translate")
+    s = TranslateStore(p).open()
+    ids = s.translate_keys(["x", "y", "z"])
+    s.close()
+
+    s2 = TranslateStore(p).open()
+    assert s2.translate_keys(["x", "y", "z"]) == ids
+    assert s2.translate_key("w") == 4
+    s2.close()
+
+
+def test_store_torn_tail_recovery(tmp_path):
+    p = str(tmp_path / "keys.translate")
+    s = TranslateStore(p).open()
+    s.translate_keys(["aa", "bb"])
+    s.close()
+    with open(p, "ab") as f:  # simulate crash mid-append
+        f.write(b"\x07\x00\x00")
+    s2 = TranslateStore(p).open()
+    assert s2.find_key("aa") == 1
+    assert s2.find_key("bb") == 2
+    assert s2.translate_key("cc") == 3
+    s2.close()
+    s3 = TranslateStore(p).open()
+    assert s3.find_key("cc") == 3
+
+
+def test_store_read_only_raises():
+    s = TranslateStore(read_only=True).open()
+    with pytest.raises(ReadOnlyError):
+        s.translate_key("nope")
+
+
+def test_store_replication_log(tmp_path):
+    primary = TranslateStore(str(tmp_path / "primary")).open()
+    replica = TranslateStore(str(tmp_path / "replica")).open()
+    primary.translate_keys(["a", "b"])
+    entries, off = primary.entries_since(0)
+    replica.apply_entries(entries)
+    primary.translate_key("c")
+    entries2, off2 = primary.entries_since(off)
+    assert [k for _, k in entries2] == ["c"]
+    replica.apply_entries(entries2)
+    assert replica.find_key("a") == 1
+    assert replica.find_key("c") == 3
+    # replica continues allocating above the replicated high-water mark
+    assert replica.translate_key("local") == 4
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the executor
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def keyed(tmp_path):
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    yield h, Executor(h)
+    h.close()
+
+
+def test_set_row_with_keys(keyed):
+    h, e = keyed
+    assert e.execute("i", 'Set("one", f="red")') == [True]
+    assert e.execute("i", 'Set("two", f="red")') == [True]
+    assert e.execute("i", 'Set("one", f="blue")') == [True]
+    (row,) = e.execute("i", 'Row(f="red")')
+    assert row.keys == ["one", "two"]
+    (cnt,) = e.execute("i", 'Count(Row(f="red"))')
+    assert cnt == 2
+    # unseen key reads as empty
+    (row2,) = e.execute("i", 'Row(f="never")')
+    assert row2.count() == 0
+
+
+def test_keys_persist_across_reopen(tmp_path):
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    e = Executor(h)
+    e.execute("i", 'Set("col-a", f="row-a")')
+    h.close()
+
+    h2 = Holder(str(tmp_path)).open()
+    e2 = Executor(h2)
+    (row,) = e2.execute("i", 'Row(f="row-a")')
+    assert row.keys == ["col-a"]
+    # same keys resolve to the same ids after reopen
+    e2.execute("i", 'Set("col-a", f="row-b")')
+    (row2,) = e2.execute("i", 'Row(f="row-b")')
+    assert row2.keys == ["col-a"]
+    h2.close()
+
+
+def test_topn_returns_keys(keyed):
+    h, e = keyed
+    for col in ("c1", "c2", "c3"):
+        e.execute("i", f'Set("{col}", f="hot")')
+    e.execute("i", 'Set("c1", f="cold")')
+    (pairs,) = e.execute("i", "TopN(f, n=2)")
+    assert [(p.key, p.count) for p in pairs] == [("hot", 3), ("cold", 1)]
+
+
+def test_rows_returns_keys(keyed):
+    h, e = keyed
+    e.execute("i", 'Set("c", f="alpha")')
+    e.execute("i", 'Set("c", f="beta")')
+    (rows,) = e.execute("i", "Rows(f)")
+    assert sorted(rows) == ["alpha", "beta"]
+
+
+def test_groupby_returns_row_keys(keyed):
+    h, e = keyed
+    e.execute("i", 'Set("c1", f="g1")')
+    e.execute("i", 'Set("c2", f="g1")')
+    e.execute("i", 'Set("c1", f="g2")')
+    (groups,) = e.execute("i", "GroupBy(Rows(f))")
+    got = {(g.group[0].row_key, g.count) for g in groups}
+    assert got == {("g1", 2), ("g2", 1)}
+
+
+def test_string_key_without_keys_errors(tmp_path):
+    from pilosa_tpu.exec.translation import TranslationError
+
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("plain")
+    idx.create_field("f", FieldOptions())
+    e = Executor(h)
+    with pytest.raises(TranslationError):
+        e.execute("plain", 'Set(1, f="red")')
+    h.close()
