@@ -1,0 +1,34 @@
+"""Cluster layer: placement, membership, resize math, anti-entropy.
+
+Reference: /root/reference/cluster.go (partition/jump-hash placement,
+replication, resize), gossip/ (membership), fragment.go:1875-1996 +
+2861-3033 (anti-entropy block merge).
+
+TPU-native shape: the data plane inside one host is a device mesh driven by
+collectives (parallel/mesh.py); THIS package is the host control plane —
+which host owns which shard, how replicas converge, how the cluster grows
+and shrinks. All pure host logic, no device code.
+"""
+
+from pilosa_tpu.cluster.topology import (  # noqa: F401
+    DEFAULT_PARTITION_N,
+    STATE_DEGRADED,
+    STATE_DOWN,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+    Cluster,
+    Frag,
+    JumpHasher,
+    ModHasher,
+    Node,
+    ResizeSource,
+    fnv1a64,
+)
+from pilosa_tpu.cluster.antientropy import (  # noqa: F401
+    HASH_BLOCK_SIZE,
+    block_checksums,
+    block_id_of,
+    diff_blocks,
+    merge_block,
+)

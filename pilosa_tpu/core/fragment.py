@@ -522,6 +522,96 @@ class Fragment:
         return out
 
     # ------------------------------------------------------------------
+    # anti-entropy + streaming (reference: fragment.go:1762-1874 Blocks,
+    # :2436-2606 WriteTo/ReadFrom)
+    # ------------------------------------------------------------------
+
+    def pairs(
+        self, row_lo: Optional[int] = None, row_hi: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Bits as (row_ids, in-shard cols) arrays, row-major sorted,
+        optionally restricted to rows in [row_lo, row_hi)."""
+        with self._mu:
+            rows_out = []
+            cols_out = []
+            for row_id in sorted(self._rows):
+                if row_lo is not None and row_id < row_lo:
+                    continue
+                if row_hi is not None and row_id >= row_hi:
+                    continue
+                pos = self._rows[row_id].to_positions()
+                if len(pos):
+                    rows_out.append(np.full(len(pos), row_id, dtype=np.uint64))
+                    cols_out.append(pos.astype(np.uint64))
+            if not rows_out:
+                return np.empty(0, np.uint64), np.empty(0, np.uint64)
+            return np.concatenate(rows_out), np.concatenate(cols_out)
+
+    def block_checksums(self) -> Dict[int, bytes]:
+        """Per-100-row-block digests for replica sync
+        (reference: fragment.go:2814-2838 blockHasher)."""
+        from pilosa_tpu.cluster.antientropy import block_checksums as _bc
+
+        return _bc(self.pairs())
+
+    def block_pairs(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, cols) bits within one checksum block."""
+        from pilosa_tpu.cluster.antientropy import HASH_BLOCK_SIZE
+
+        return self.pairs(block_id * HASH_BLOCK_SIZE, (block_id + 1) * HASH_BLOCK_SIZE)
+
+    def apply_deltas(
+        self, sets: Tuple[np.ndarray, np.ndarray], clears: Tuple[np.ndarray, np.ndarray]
+    ) -> Tuple[int, int]:
+        """Apply (rows, cols) set/clear deltas from an anti-entropy merge."""
+        sr, sc = sets
+        cr, cc = clears
+        to_set = (
+            np.asarray(sr, np.uint64) * SHARD_WIDTH + np.asarray(sc, np.uint64)
+            if len(sr)
+            else None
+        )
+        to_clear = (
+            np.asarray(cr, np.uint64) * SHARD_WIDTH + np.asarray(cc, np.uint64)
+            if len(cr)
+            else None
+        )
+        return self.import_positions(to_set, to_clear)
+
+    def to_bytes(self) -> bytes:
+        """Full-fragment serialization for resize streaming / backup
+        (reference: fragment.go:2436 WriteTo — streams storage as tar)."""
+        import io
+
+        with self._mu:
+            buf = io.BytesIO()
+            walmod.write_snapshot_stream(buf, self.shard, SHARD_WIDTH, self._rows)
+            return buf.getvalue()
+
+    def from_bytes(self, data: bytes) -> None:
+        """Replace this fragment's contents from to_bytes() output
+        (reference: fragment.go:2527 ReadFrom)."""
+        import io
+
+        shard, n_bits, rows = walmod.read_snapshot_stream(io.BytesIO(data))
+        if shard != self.shard:
+            raise ValueError(
+                f"fragment stream is for shard {shard}, not {self.shard}"
+            )
+        if n_bits != SHARD_WIDTH:
+            raise ValueError(
+                f"fragment stream shard width {n_bits} != local {SHARD_WIDTH}"
+            )
+        with self._mu:
+            self._rows = rows
+            self._dev.clear()
+            if self._mutex_map is not None:
+                self._rebuild_mutex_map()
+            self._op_n = self.max_op_n + 1  # force snapshot on next write
+            if self.path is not None:
+                self.snapshot()
+
+    # ------------------------------------------------------------------
     # persistence
     # ------------------------------------------------------------------
 

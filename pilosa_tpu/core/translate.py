@@ -115,11 +115,21 @@ class TranslateStore:
             return out
 
     def apply_entries(self, entries: Iterable[Tuple[int, str]]) -> None:
-        """Apply replicated entries from the primary (replica follow path)."""
+        """Apply replicated entries from the primary (replica follow path).
+
+        A conflicting mapping (same id, different key) means the replica
+        allocated locally instead of forwarding writes to the primary —
+        unrecoverable divergence, so fail loudly rather than skip."""
         with self._lock:
             new = []
             for id_, key in entries:
-                if id_ in self._by_id:
+                existing = self._by_id.get(id_)
+                if existing is not None:
+                    if existing != key:
+                        raise TranslateError(
+                            f"replication conflict: id {id_} is {existing!r} "
+                            f"locally but {key!r} on primary"
+                        )
                     continue
                 self._by_id[id_] = key
                 self._by_key[key] = id_
@@ -133,10 +143,15 @@ class TranslateStore:
             _REC.pack(id_, len(kb)) + kb
             for id_, kb in ((i, k.encode("utf-8")) for i, k in recs)
         )
-        self._log_size += len(blob)
         if self._fh:
+            # file mode: offsets are byte positions in the log
+            self._log_size += len(blob)
             self._fh.write(blob)
             self._fh.flush()
+        else:
+            # memory mode: offsets are entry indexes (entries_since serves
+            # from the map) — keep the two currencies from mixing
+            self._log_size += len(recs)
 
     # -- reads -------------------------------------------------------------
 

@@ -40,17 +40,39 @@ OP_CLEAR = 1
 _REC_HDR = struct.Struct("<IBII")
 
 
+def write_snapshot_stream(f, shard: int, n_bits: int, rows: Dict[int, RowBits]) -> None:
+    """Write the snapshot record stream to an open binary file object.
+
+    Single codec shared by on-disk snapshots and resize/backup streaming
+    (reference: the same WriteTo serves both, fragment.go:2436)."""
+    f.write(SNAP_MAGIC)
+    f.write(struct.pack("<QQQ", shard, n_bits, len(rows)))
+    for row_id in sorted(rows):
+        rb = rows[row_id]
+        payload = rb.payload()
+        f.write(struct.pack("<QBQ", row_id, rb.rep(), len(payload)))
+        f.write(payload.astype(np.uint32, copy=False).tobytes())
+
+
+def read_snapshot_stream(f) -> Tuple[int, int, Dict[int, RowBits]]:
+    """Inverse of write_snapshot_stream; returns (shard, n_bits, rows)."""
+    magic = f.read(8)
+    if magic != SNAP_MAGIC:
+        raise ValueError(f"bad snapshot magic {magic!r}")
+    shard, n_bits, n_rows = struct.unpack("<QQQ", f.read(24))
+    rows: Dict[int, RowBits] = {}
+    for _ in range(n_rows):
+        row_id, rep, n_items = struct.unpack("<QBQ", f.read(17))
+        payload = np.frombuffer(f.read(n_items * 4), dtype=np.uint32).copy()
+        rows[row_id] = RowBits.from_payload(n_bits, rep, payload)
+    return shard, n_bits, rows
+
+
 def write_snapshot(path: str, shard: int, n_bits: int, rows: Dict[int, RowBits]) -> None:
     """Atomically write a full snapshot (temp file + rename)."""
     tmp = path + ".snapshotting"
     with open(tmp, "wb") as f:
-        f.write(SNAP_MAGIC)
-        f.write(struct.pack("<QQQ", shard, n_bits, len(rows)))
-        for row_id in sorted(rows):
-            rb = rows[row_id]
-            payload = rb.payload()
-            f.write(struct.pack("<QBQ", row_id, rb.rep(), len(payload)))
-            f.write(payload.astype(np.uint32, copy=False).tobytes())
+        write_snapshot_stream(f, shard, n_bits, rows)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -59,16 +81,7 @@ def write_snapshot(path: str, shard: int, n_bits: int, rows: Dict[int, RowBits])
 def read_snapshot(path: str) -> Tuple[int, int, Dict[int, RowBits]]:
     """Read a snapshot; returns (shard, n_bits, rows)."""
     with open(path, "rb") as f:
-        magic = f.read(8)
-        if magic != SNAP_MAGIC:
-            raise ValueError(f"{path}: bad snapshot magic {magic!r}")
-        shard, n_bits, n_rows = struct.unpack("<QQQ", f.read(24))
-        rows: Dict[int, RowBits] = {}
-        for _ in range(n_rows):
-            row_id, rep, n_items = struct.unpack("<QBQ", f.read(17))
-            payload = np.frombuffer(f.read(n_items * 4), dtype=np.uint32)
-            rows[row_id] = RowBits.from_payload(n_bits, rep, payload)
-    return shard, n_bits, rows
+        return read_snapshot_stream(f)
 
 
 class WalWriter:

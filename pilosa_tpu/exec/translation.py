@@ -19,12 +19,6 @@ from typing import Any, List
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.pql.ast import Call, Query
 
-# Calls whose `_col` argument addresses a column in the index's key space.
-_COL_CALLS = {"Set", "Clear", "SetColumnAttrs"}
-# Calls whose `_row` argument addresses a row of the `_field` field.
-_ROW_CALLS = {"ClearRow", "Store", "SetRowAttrs"}
-
-
 class TranslationError(Exception):
     pass
 

@@ -1,0 +1,338 @@
+"""Cluster layer tests: placement math, resize diffing, anti-entropy merge.
+
+Reference test model: cluster_internal_test.go (partition/hasher/fragSources
+math) and fragment tests around mergeBlock.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster import (
+    Cluster,
+    Frag,
+    JumpHasher,
+    ModHasher,
+    Node,
+    block_checksums,
+    diff_blocks,
+    merge_block,
+)
+from pilosa_tpu.cluster.topology import (
+    RESIZE_ADD,
+    RESIZE_REMOVE,
+    STATE_DEGRADED,
+    STATE_DOWN,
+    STATE_NORMAL,
+    ClusterError,
+    fnv1a64,
+)
+
+
+def make_cluster(n, replica_n=1, hasher=None):
+    return Cluster(
+        nodes=[Node(id=f"node{i}", uri=f"http://host{i}:10101") for i in range(n)],
+        replica_n=replica_n,
+        hasher=hasher or JumpHasher(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# hashing / placement
+# ---------------------------------------------------------------------------
+
+
+def test_fnv1a64_known_vectors():
+    # standard FNV-1a test vectors
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+
+
+def test_jump_hasher_properties():
+    h = JumpHasher()
+    # deterministic, in range
+    for key in range(200):
+        for n in (1, 2, 3, 8, 64):
+            b = h.hash(key, n)
+            assert 0 <= b < n
+            assert b == h.hash(key, n)
+    # minimal movement: growing n moves keys only INTO the new bucket
+    for key in range(500):
+        b7, b8 = h.hash(key, 7), h.hash(key, 8)
+        assert b7 == b8 or b8 == 7
+
+
+def test_jump_hasher_balance():
+    h = JumpHasher()
+    counts = [0] * 8
+    for key in range(4096):
+        counts[h.hash(key, 8)] += 1
+    for c in counts:
+        assert 300 < c < 730  # roughly uniform
+
+
+def test_partition_determinism_and_spread():
+    c = make_cluster(4)
+    parts = {c.partition("idx", s) for s in range(1000)}
+    assert len(parts) > 200  # spreads over the 256 partitions
+    assert c.partition("idx", 5) == c.partition("idx", 5)
+    assert c.partition("idx", 5) != c.partition("other", 5) or True  # index-dependent
+
+
+def test_shard_nodes_replication():
+    c = make_cluster(5, replica_n=3)
+    owners = c.shard_nodes("i", 42)
+    assert len(owners) == 3
+    assert len({n.id for n in owners}) == 3
+    # consecutive on the ring
+    ids = [n.id for n in c.nodes]
+    start = ids.index(owners[0].id)
+    assert [n.id for n in owners] == [ids[(start + i) % 5] for i in range(3)]
+
+
+def test_replica_n_clamped_to_node_count():
+    c = make_cluster(2, replica_n=5)
+    assert len(c.shard_nodes("i", 0)) == 2
+
+
+def test_owns_shard_and_contains_shards():
+    c = make_cluster(3, replica_n=2)
+    shards = list(range(50))
+    total = 0
+    for node in c.nodes:
+        owned = c.contains_shards("i", shards, node.id)
+        total += len(owned)
+        for s in owned:
+            assert c.owns_shard(node.id, "i", s)
+    assert total == 50 * 2  # every shard placed on exactly replica_n nodes
+
+
+def test_shards_by_node_covers_all_shards():
+    c = make_cluster(4, replica_n=2)
+    shards = list(range(64))
+    grouping = c.shards_by_node("i", shards)
+    got = sorted(s for ss in grouping.values() for s in ss)
+    assert got == shards
+
+
+def test_shards_by_node_skips_down_nodes():
+    c = make_cluster(3, replica_n=2)
+    c.nodes[0].state = "DOWN"
+    grouping = c.shards_by_node("i", list(range(64)))
+    assert c.nodes[0].id not in grouping
+    got = sorted(s for ss in grouping.values() for s in ss)
+    assert got == list(range(64))  # replicas absorb the down node's shards
+
+
+# ---------------------------------------------------------------------------
+# resize math
+# ---------------------------------------------------------------------------
+
+
+def test_diff_add_and_remove():
+    c3 = make_cluster(3)
+    c4 = c3.with_added_node(Node(id="node3"))
+    assert c3.diff(c4) == (RESIZE_ADD, "node3")
+    assert c4.diff(c3) == (RESIZE_REMOVE, "node3")
+    with pytest.raises(ClusterError):
+        c3.diff(c3.with_added_node(Node(id="x")).with_added_node(Node(id="y")))
+
+
+def frags_for(shards, field="f", view="standard"):
+    return [Frag(field=field, view=view, shard=s) for s in shards]
+
+
+def test_frag_sources_add_node():
+    old = make_cluster(3, replica_n=1)
+    new = old.with_added_node(Node(id="node3"))
+    frags = frags_for(range(40))
+    sources = old.frag_sources(new, "i", frags)
+    # the new node must fetch exactly what it now owns
+    new_owned = {fr for fr in frags if new.owns_shard("node3", "i", fr.shard)}
+    fetched = {
+        Frag(field=s.field, view=s.view, shard=s.shard) for s in sources["node3"]
+    }
+    assert fetched == new_owned
+    # every source node actually held the fragment in the old cluster
+    for node_id, srcs in sources.items():
+        for s in srcs:
+            assert old.owns_shard(s.node.id, "i", s.shard)
+    # existing nodes with unchanged placement fetch nothing extra they had
+    for node_id, srcs in sources.items():
+        for s in srcs:
+            assert not old.owns_shard(node_id, "i", s.shard)
+
+
+def test_frag_sources_remove_node_requires_replica():
+    old = make_cluster(3, replica_n=1)
+    new = old.with_removed_node("node2")
+    frags = frags_for(range(40))
+    owned_by_2 = [fr for fr in frags if old.owns_shard("node2", "i", fr.shard)]
+    if owned_by_2:  # with replica 1, removing a data-holding node must fail
+        with pytest.raises(ClusterError):
+            old.frag_sources(new, "i", frags)
+
+
+def test_frag_sources_remove_node_with_replicas():
+    old = make_cluster(3, replica_n=2)
+    new = old.with_removed_node("node2")
+    frags = frags_for(range(40))
+    sources = old.frag_sources(new, "i", frags)
+    for node_id, srcs in sources.items():
+        for s in srcs:
+            assert s.node.id != "node2"  # departing node is never a source
+            assert old.owns_shard(s.node.id, "i", s.shard)
+    # after resize every fragment is fully replicated on the new cluster
+    for fr in frags:
+        owners = {n.id for n in new.shard_nodes("i", fr.shard)}
+        for node_id in owners:
+            had = old.owns_shard(node_id, "i", fr.shard)
+            gets = any(
+                s.shard == fr.shard for s in sources.get(node_id, [])
+            )
+            assert had or gets
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+
+def test_determine_state():
+    c = make_cluster(4, replica_n=2)
+    assert c.determine_state(set()) == STATE_NORMAL
+    assert c.determine_state({"node1"}) == STATE_DEGRADED
+    assert c.determine_state({"node1", "node2"}) == STATE_DOWN
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy
+# ---------------------------------------------------------------------------
+
+
+def P(pairs):
+    if not pairs:
+        return np.empty(0, np.uint64), np.empty(0, np.uint64)
+    r, c = zip(*pairs)
+    return np.array(r, np.uint64), np.array(c, np.uint64)
+
+
+def test_block_checksums_detect_difference():
+    a = block_checksums(P([(0, 1), (0, 5), (150, 7)]))
+    b = block_checksums(P([(0, 1), (0, 5), (150, 8)]))
+    assert set(a) == {0, 1}
+    assert a[0] == b[0]
+    assert a[1] != b[1]
+    assert diff_blocks(a, b) == [1]
+
+
+def test_block_checksums_empty():
+    assert block_checksums(P([])) == {}
+
+
+def test_merge_block_two_replicas_union():
+    # even split -> set wins (fragment.go:1917)
+    a = P([(0, 1), (0, 2)])
+    b = P([(0, 2), (0, 3)])
+    sets, clears = merge_block(0, [a, b])
+    # replica a must add (0,3); replica b must add (0,1); no clears
+    assert [(int(r), int(c)) for r, c in zip(*sets[0])] == [(0, 3)]
+    assert [(int(r), int(c)) for r, c in zip(*sets[1])] == [(0, 1)]
+    assert all(len(r) == 0 for r, _ in clears)
+
+
+def test_merge_block_three_replicas_majority():
+    a = P([(0, 1), (0, 9)])
+    b = P([(0, 1)])
+    c = P([(0, 2)])
+    sets, clears = merge_block(0, [a, b, c])
+    # (0,1): 2/3 votes -> kept; c must set it
+    assert (0, 1) in [(int(r), int(cc)) for r, cc in zip(*sets[2])]
+    # (0,9) and (0,2): 1/3 votes -> cleared from their holders
+    assert (0, 9) in [(int(r), int(cc)) for r, cc in zip(*clears[0])]
+    assert (0, 2) in [(int(r), int(cc)) for r, cc in zip(*clears[2])]
+    # b only needs nothing cleared
+    assert len(clears[1][0]) == 0
+
+
+def test_merge_block_ignores_out_of_block_pairs():
+    a = P([(0, 1), (250, 2)])  # row 250 is in block 2
+    b = P([])
+    sets, clears = merge_block(0, [a, b])
+    got = [(int(r), int(c)) for r, c in zip(*sets[1])]
+    assert got == [(0, 1)]
+
+
+def test_merge_convergence_end_to_end():
+    rng = np.random.default_rng(3)
+    replicas = []
+    for _ in range(3):
+        n = rng.integers(50, 150)
+        rows = rng.integers(0, 100, n).astype(np.uint64)
+        cols = rng.integers(0, 1000, n).astype(np.uint64)
+        replicas.append((rows, cols))
+    sets, clears = merge_block(0, replicas)
+
+    def apply(rep, s, cl):
+        have = {(int(r), int(c)) for r, c in zip(*rep)}
+        have |= {(int(r), int(c)) for r, c in zip(*s)}
+        have -= {(int(r), int(c)) for r, c in zip(*cl)}
+        return have
+
+    states = [apply(rep, s, cl) for rep, s, cl in zip(replicas, sets, clears)]
+    assert states[0] == states[1] == states[2]
+
+
+# ---------------------------------------------------------------------------
+# fragment integration
+# ---------------------------------------------------------------------------
+
+
+def test_fragment_block_sync_roundtrip():
+    from pilosa_tpu.core.fragment import Fragment
+
+    fa = Fragment(None, "i", "f", "standard", 0).open()
+    fb = Fragment(None, "i", "f", "standard", 0).open()
+    fa.bulk_import(np.array([0, 0, 1, 205]), np.array([3, 4, 9, 11]))
+    fb.bulk_import(np.array([0, 1, 205]), np.array([3, 9, 12]))
+
+    diffs = diff_blocks(fa.block_checksums(), fb.block_checksums())
+    assert diffs == [0, 2]
+    for bid in diffs:
+        sets, clears = merge_block(bid, [fa.block_pairs(bid), fb.block_pairs(bid)])
+        fa.apply_deltas(sets[0], clears[0])
+        fb.apply_deltas(sets[1], clears[1])
+    assert diff_blocks(fa.block_checksums(), fb.block_checksums()) == []
+    assert fa.pairs()[1].tolist() == fb.pairs()[1].tolist()
+
+
+def test_fragment_stream_roundtrip(tmp_path):
+    from pilosa_tpu.core.fragment import Fragment
+
+    src = Fragment(None, "i", "f", "standard", 3).open()
+    src.bulk_import(np.array([0, 5, 7]), np.array([10, 20, 30]))
+    blob = src.to_bytes()
+
+    dst = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 3).open()
+    dst.from_bytes(blob)
+    assert dst.pairs()[0].tolist() == src.pairs()[0].tolist()
+    assert dst.pairs()[1].tolist() == src.pairs()[1].tolist()
+    # persisted: reopen from disk
+    dst.close()
+    dst2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 3).open()
+    assert dst2.pairs()[1].tolist() == src.pairs()[1].tolist()
+
+
+def test_fragment_stream_rejects_wrong_shard():
+    from pilosa_tpu.core.fragment import Fragment
+
+    src = Fragment(None, "i", "f", "standard", 3).open()
+    src.bulk_import(np.array([0]), np.array([10]))
+    dst = Fragment(None, "i", "f", "standard", 5).open()
+    with pytest.raises(ValueError):
+        dst.from_bytes(src.to_bytes())
+
+
+def test_mod_hasher():
+    c = make_cluster(3, hasher=ModHasher())
+    assert [c.hasher.hash(k, 3) for k in range(6)] == [0, 1, 2, 0, 1, 2]

@@ -78,8 +78,30 @@ def test_store_replication_log(tmp_path):
     replica.apply_entries(entries2)
     assert replica.find_key("a") == 1
     assert replica.find_key("c") == 3
-    # replica continues allocating above the replicated high-water mark
-    assert replica.translate_key("local") == 4
+
+
+def test_store_replication_conflict_raises():
+    from pilosa_tpu.core.translate import TranslateError
+
+    primary = TranslateStore().open()
+    replica = TranslateStore().open()
+    # replica wrongly allocates locally (writes must forward to the primary)
+    replica.translate_key("local")
+    primary.translate_key("remote")
+    entries, _ = primary.entries_since(0)
+    with pytest.raises(TranslateError):
+        replica.apply_entries(entries)
+
+
+def test_store_memory_mode_offsets_are_entry_indexes():
+    primary = TranslateStore().open()
+    primary.translate_keys(["a", "b"])
+    off = primary.write_offset
+    assert off == 2
+    primary.translate_key("c")
+    entries, new_off = primary.entries_since(off)
+    assert [k for _, k in entries] == ["c"]
+    assert new_off == 3 == primary.write_offset
 
 
 # ---------------------------------------------------------------------------
