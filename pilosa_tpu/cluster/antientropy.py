@@ -17,16 +17,15 @@ walk."""
 
 from __future__ import annotations
 
-import hashlib
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-HASH_BLOCK_SIZE = 100  # rows per block (fragment.go:81)
-
-
-def block_id_of(row_id: int) -> int:
-    return row_id // HASH_BLOCK_SIZE
+from pilosa_tpu.core.blocks import (  # noqa: F401  (re-exported)
+    HASH_BLOCK_SIZE,
+    block_checksums,
+    block_id_of,
+)
 
 
 def _pairs_to_u128(rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
@@ -36,35 +35,6 @@ def _pairs_to_u128(rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
     pairs["r"] = rows.astype(np.uint64)
     pairs["c"] = cols.astype(np.uint64)
     return pairs
-
-
-def block_checksums(
-    rows_cols: Tuple[np.ndarray, np.ndarray]
-) -> Dict[int, bytes]:
-    """Per-block digest of a fragment's (row, in-shard col) pairs.
-
-    Returns {block_id: 16-byte digest}; blocks with no bits are absent
-    (matching the reference, which only reports blocks holding data)."""
-    rows, cols = rows_cols
-    if len(rows) == 0:
-        return {}
-    rows = np.asarray(rows, dtype=np.uint64)
-    cols = np.asarray(cols, dtype=np.uint64)
-    order = np.lexsort((cols, rows))
-    rows, cols = rows[order], cols[order]
-    block_ids = (rows // HASH_BLOCK_SIZE).astype(np.int64)
-    out: Dict[int, bytes] = {}
-    # split at block boundaries
-    boundaries = np.nonzero(np.diff(block_ids))[0] + 1
-    starts = np.concatenate(([0], boundaries))
-    ends = np.concatenate((boundaries, [len(rows)]))
-    for s, e in zip(starts, ends):
-        bid = int(block_ids[s])
-        h = hashlib.blake2b(digest_size=16)
-        h.update(rows[s:e].tobytes())
-        h.update(cols[s:e].tobytes())
-        out[bid] = h.digest()
-    return out
 
 
 def diff_blocks(

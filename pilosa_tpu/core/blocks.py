@@ -1,0 +1,51 @@
+"""Block checksum primitives for fragment comparison.
+
+Reference: /root/reference/fragment.go:81 (HashBlockSize = 100 rows),
+:2814-2838 (blockHasher over the (row,col) pair stream), :1762-1874
+(Blocks/checksum invalidation).
+
+Lives in core/ because fragments own their pair data; the cluster layer's
+anti-entropy (cluster/antientropy.py) builds its replica-merge protocol on
+top of these digests — core stays cluster-unaware."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Tuple
+
+import numpy as np
+
+HASH_BLOCK_SIZE = 100  # rows per block (fragment.go:81)
+
+
+def block_id_of(row_id: int) -> int:
+    return row_id // HASH_BLOCK_SIZE
+
+
+def block_checksums(
+    rows_cols: Tuple[np.ndarray, np.ndarray]
+) -> Dict[int, bytes]:
+    """Per-block digest of a fragment's (row, in-shard col) pairs.
+
+    Returns {block_id: 16-byte digest}; blocks with no bits are absent
+    (matching the reference, which only reports blocks holding data)."""
+    rows, cols = rows_cols
+    if len(rows) == 0:
+        return {}
+    rows = np.asarray(rows, dtype=np.uint64)
+    cols = np.asarray(cols, dtype=np.uint64)
+    order = np.lexsort((cols, rows))
+    rows, cols = rows[order], cols[order]
+    block_ids = (rows // HASH_BLOCK_SIZE).astype(np.int64)
+    out: Dict[int, bytes] = {}
+    # split at block boundaries
+    boundaries = np.nonzero(np.diff(block_ids))[0] + 1
+    starts = np.concatenate(([0], boundaries))
+    ends = np.concatenate((boundaries, [len(rows)]))
+    for s, e in zip(starts, ends):
+        bid = int(block_ids[s])
+        h = hashlib.blake2b(digest_size=16)
+        h.update(rows[s:e].tobytes())
+        h.update(cols[s:e].tobytes())
+        out[bid] = h.digest()
+    return out

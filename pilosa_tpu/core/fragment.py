@@ -550,13 +550,13 @@ class Fragment:
     def block_checksums(self) -> Dict[int, bytes]:
         """Per-100-row-block digests for replica sync
         (reference: fragment.go:2814-2838 blockHasher)."""
-        from pilosa_tpu.cluster.antientropy import block_checksums as _bc
+        from pilosa_tpu.core.blocks import block_checksums as _bc
 
         return _bc(self.pairs())
 
     def block_pairs(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
         """(rows, cols) bits within one checksum block."""
-        from pilosa_tpu.cluster.antientropy import HASH_BLOCK_SIZE
+        from pilosa_tpu.core.blocks import HASH_BLOCK_SIZE
 
         return self.pairs(block_id * HASH_BLOCK_SIZE, (block_id + 1) * HASH_BLOCK_SIZE)
 

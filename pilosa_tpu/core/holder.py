@@ -102,6 +102,13 @@ class Holder:
                     }
                 )
             out.append(
-                {"name": idx.name, "options": {"keys": idx.keys}, "fields": fields}
+                {
+                    "name": idx.name,
+                    "options": {
+                        "keys": idx.keys,
+                        "trackExistence": idx.track_existence,
+                    },
+                    "fields": fields,
+                }
             )
         return out

@@ -45,6 +45,12 @@ class TranslateStore:
     def __init__(self, path: Optional[str] = None, read_only: bool = False):
         self.path = path
         self.read_only = read_only
+        # single-writer replication hooks (reference: boltdb/translate.go
+        # forwards non-primary writes; holder.go:785-880 replica follower).
+        # forward_fn(keys) -> ids: ask the primary to allocate.
+        # catchup_fn() -> None: pull + apply the primary's new entries.
+        self.forward_fn = None
+        self.catchup_fn = None
         self._lock = threading.RLock()
         self._by_key: Dict[str, int] = {}
         self._by_id: Dict[int, str] = {}
@@ -94,16 +100,35 @@ class TranslateStore:
         return self.translate_keys([key])[0]
 
     def translate_keys(self, keys: Sequence[str]) -> List[int]:
+        if self.read_only:
+            # Forward unknown keys to the primary OUTSIDE the lock (a slow
+            # coordinator must not freeze local reads), then apply.
+            with self._lock:
+                missing = sorted({k for k in keys if k not in self._by_key})
+            if missing:
+                if self.forward_fn is None:
+                    raise ReadOnlyError(
+                        f"translate store is read-only; forward {missing[0]!r} to primary"
+                    )
+                ids = self.forward_fn(missing)
+                if len(ids) != len(missing):
+                    raise TranslateError(
+                        f"primary returned {len(ids)} ids for {len(missing)} keys"
+                    )
+                self.apply_entries(zip(ids, missing))
+            with self._lock:
+                try:
+                    return [self._by_key[k] for k in keys]
+                except KeyError as e:
+                    raise TranslateError(
+                        f"key {e.args[0]!r} missing after primary forward"
+                    ) from None
         with self._lock:
             out = []
             new: List[Tuple[int, str]] = []
             for key in keys:
                 id_ = self._by_key.get(key)
                 if id_ is None:
-                    if self.read_only:
-                        raise ReadOnlyError(
-                            f"translate store is read-only; forward {key!r} to primary"
-                        )
                     id_ = self._next_id
                     self._next_id += 1
                     self._by_key[key] = id_
@@ -160,10 +185,18 @@ class TranslateStore:
         return self._by_key.get(key)
 
     def key_for_id(self, id_: int) -> Optional[str]:
-        return self._by_id.get(id_)
+        key = self._by_id.get(id_)
+        if key is None and self.catchup_fn is not None:
+            # stale replica: pull the primary's new entries once and retry
+            try:
+                self.catchup_fn()
+            except Exception:
+                return None
+            key = self._by_id.get(id_)
+        return key
 
     def keys_for_ids(self, ids: Sequence[int]) -> List[Optional[str]]:
-        return [self._by_id.get(i) for i in ids]
+        return [self.key_for_id(i) for i in ids]
 
     def max_id(self) -> int:
         return self._next_id - 1

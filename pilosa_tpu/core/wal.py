@@ -54,16 +54,25 @@ def write_snapshot_stream(f, shard: int, n_bits: int, rows: Dict[int, RowBits]) 
         f.write(payload.astype(np.uint32, copy=False).tobytes())
 
 
+def _read_exact(f, n: int) -> bytes:
+    """Read exactly n bytes or raise — a truncated stream (torn network
+    transfer, partial write) must fail loudly, never parse short."""
+    data = f.read(n)
+    if len(data) != n:
+        raise ValueError(f"truncated snapshot stream: wanted {n} bytes, got {len(data)}")
+    return data
+
+
 def read_snapshot_stream(f) -> Tuple[int, int, Dict[int, RowBits]]:
     """Inverse of write_snapshot_stream; returns (shard, n_bits, rows)."""
-    magic = f.read(8)
+    magic = _read_exact(f, 8)
     if magic != SNAP_MAGIC:
         raise ValueError(f"bad snapshot magic {magic!r}")
-    shard, n_bits, n_rows = struct.unpack("<QQQ", f.read(24))
+    shard, n_bits, n_rows = struct.unpack("<QQQ", _read_exact(f, 24))
     rows: Dict[int, RowBits] = {}
     for _ in range(n_rows):
-        row_id, rep, n_items = struct.unpack("<QBQ", f.read(17))
-        payload = np.frombuffer(f.read(n_items * 4), dtype=np.uint32).copy()
+        row_id, rep, n_items = struct.unpack("<QBQ", _read_exact(f, 17))
+        payload = np.frombuffer(_read_exact(f, n_items * 4), dtype=np.uint32).copy()
         rows[row_id] = RowBits.from_payload(n_bits, rep, payload)
     return shard, n_bits, rows
 

@@ -1,0 +1,348 @@
+"""Distributed executor: cluster fan-out + per-call reduce + failover.
+
+Reference: /root/reference/executor.go:2460-2613 — mapReduce groups shards
+by owner node, runs the local subset on the worker pool and ships remote
+subsets as Remote=true queries (executor.go:2419 remoteExec); the reduce
+loop merges partial results as they arrive and, when a node errors, re-maps
+its shards onto surviving replicas (executor.go:2489-2518).
+
+Structure here: DistributedExecutor subclasses the single-node Executor and
+intercepts exactly the per-call entry points. A "partial" is the result of
+one call restricted to one node's shard subset, executed with remote
+semantics (no translation, untrimmed TopN candidates); `_fan_out` computes
+partials (local subset via super(), remote via InternalClient) and
+`_reduce` folds them per result type — the same shape the reference's
+reduceFn table has. TopN keeps its exact two-pass protocol because pass 1/
+pass 2 each go through the overridden `_topn_shards` fan-out.
+
+Write calls route by ownership: single-column writes go to every replica
+owner of the column's shard (executor.go:2142-2172 fan-out to owners);
+row-wide writes (ClearRow/Store) run on every node over its owned shards;
+attr writes replicate to all nodes."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from pilosa_tpu.cluster.topology import Cluster
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.exec.executor import (
+    ExecError,
+    ExecOptions,
+    Executor,
+    GroupCount,
+    Pair,
+    ValCount,
+)
+from pilosa_tpu.pql.ast import Call
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class RemoteError(ExecError):
+    """A remote node failed to execute its shard subset."""
+
+
+class DistributedExecutor(Executor):
+    def __init__(
+        self,
+        holder: Holder,
+        cluster_fn: Callable[[], Cluster],
+        client,
+        local_id: str,
+    ):
+        super().__init__(holder)
+        self.cluster_fn = cluster_fn
+        self.client = client
+        self.local_id = local_id
+
+    # ------------------------------------------------------------------
+    # fan-out plumbing
+    # ------------------------------------------------------------------
+
+    def _cluster(self) -> Cluster:
+        return self.cluster_fn()
+
+    def _is_single_node(self) -> bool:
+        return len(self._cluster().nodes) <= 1
+
+    def _uri_of(self, node_id: str) -> str:
+        n = self._cluster().node_by_id(node_id)
+        if n is None:
+            raise RemoteError(f"unknown node {node_id}")
+        return n.uri
+
+    def _fan_out(
+        self, idx: Index, c: Call, shards: Optional[Sequence[int]]
+    ) -> List[Any]:
+        """Run call `c` on every owner node over its shard subset; returns
+        the list of partial results (local partial included). Failed nodes'
+        shards are re-mapped to surviving replicas (executor.go:2497)."""
+        cluster = self._cluster()
+        all_shards = self._shards_for(idx, shards, c)
+        remaining = dict(cluster.shards_by_node(idx.name, all_shards))
+        partials: List[Any] = []
+        failed: set = set()
+        attempts = 0
+        while remaining:
+            attempts += 1
+            if attempts > len(cluster.nodes) + 1:
+                raise RemoteError("shards could not be placed on any live node")
+            retry: Dict[str, List[int]] = {}
+            for node_id, node_shards in remaining.items():
+                try:
+                    partials.append(self._node_partial(idx, c, node_id, node_shards))
+                except RemoteError:
+                    failed.add(node_id)
+                    # re-map this node's shards to the next live replica
+                    for s in node_shards:
+                        owners = [
+                            n.id
+                            for n in cluster.shard_nodes(idx.name, s)
+                            if n.id not in failed and n.state != "DOWN"
+                        ]
+                        if not owners:
+                            raise RemoteError(
+                                f"shard {s} unavailable: all replicas down"
+                            )
+                        retry.setdefault(owners[0], []).append(s)
+            remaining = retry
+        return partials
+
+    def _node_partial(
+        self, idx: Index, c: Call, node_id: str, node_shards: List[int]
+    ) -> Any:
+        if node_id == self.local_id:
+            opt = ExecOptions(remote=True)
+            return super()._execute_call(idx, c, node_shards, opt)
+        try:
+            results = self.client.query_node(
+                self._uri_of(node_id),
+                idx.name,
+                str(c),
+                shards=node_shards,
+                remote=True,
+            )
+        except Exception as e:  # transport/remote errors -> failover
+            raise RemoteError(f"node {node_id}: {e}") from e
+        return results[0]
+
+    # ------------------------------------------------------------------
+    # reduce table
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _reduce_rows(partials: List[Any]) -> Row:
+        out = Row()
+        for p in partials:
+            if isinstance(p, Row):
+                out = out.union(p)
+        return out
+
+    def _reduce(self, name: str, c: Call, partials: List[Any]) -> Any:
+        partials = [p for p in partials if p is not None]
+        if name in ("Row", "Union", "Intersect", "Difference", "Xor", "Not", "Shift", "Range", "All"):
+            return self._reduce_rows(partials)
+        if name == "Count":
+            return sum(int(p) for p in partials)
+        if name in ("Clear", "ClearRow", "Store"):
+            return any(bool(p) for p in partials)
+        if name == "Sum":
+            vc = ValCount(0, 0)
+            for p in partials:
+                vc = ValCount(vc.value + p.value, vc.count + p.count)
+            return vc
+        if name in ("Min", "Max"):
+            best: Optional[ValCount] = None
+            for p in partials:
+                if p.count == 0:
+                    continue
+                if best is None:
+                    best = ValCount(p.value, p.count)
+                elif (p.value < best.value) == (name == "Min") and p.value != best.value:
+                    best = ValCount(p.value, p.count)
+                elif p.value == best.value:
+                    best = ValCount(best.value, best.count + p.count)
+            return best or ValCount(0, 0)
+        if name in ("MinRow", "MaxRow"):
+            best = None
+            for p in partials:
+                if not p or p.get("count", 0) == 0:
+                    continue
+                if best is None:
+                    best = dict(p)
+                elif p["id"] == best["id"]:
+                    best["count"] += p["count"]
+                elif (p["id"] < best["id"]) == (name == "MinRow"):
+                    best = dict(p)
+            return best or {"id": 0, "count": 0}
+        if name == "Rows":
+            merged = set()
+            for p in partials:
+                merged.update(p)
+            out = sorted(merged)
+            limit = c.uint_arg("limit")
+            prev = c.uint_arg("previous")
+            if prev is not None:
+                out = [r for r in out if r > prev]
+            if limit is not None:
+                out = out[:limit]
+            return out
+        if name == "GroupBy":
+            merged: Dict[tuple, GroupCount] = {}
+            for p in partials:
+                for gc in p:
+                    key = tuple((fr.field, fr.row_id) for fr in gc.group)
+                    if key in merged:
+                        merged[key].count += gc.count
+                    else:
+                        merged[key] = GroupCount(group=list(gc.group), count=gc.count)
+            out = sorted(merged.values(), key=lambda g: g.compare_key())
+            offset = c.uint_arg("offset")
+            limit = c.uint_arg("limit")
+            if offset:
+                out = out[offset:]
+            if limit is not None:
+                out = out[:limit]
+            return out
+        raise ExecError(f"no distributed reduce for call {name!r}")
+
+    # ------------------------------------------------------------------
+    # call interception
+    # ------------------------------------------------------------------
+
+    _FANOUT_CALLS = {
+        "Row", "Union", "Intersect", "Difference", "Xor", "Not", "Shift",
+        "Range", "All", "Count", "Sum", "Min", "Max", "MinRow", "MaxRow",
+        "Rows", "GroupBy", "ClearRow", "Store",
+    }
+
+    def _execute_call(self, idx: Index, c: Call, shards, opt: ExecOptions):
+        if opt.remote or self._is_single_node():
+            return super()._execute_call(idx, c, shards, opt)
+        name = c.name
+        if name in ("Set", "Clear"):
+            return self._execute_write_by_column(idx, c)
+        if name in ("SetRowAttrs", "SetColumnAttrs"):
+            # attrs replicate to every node (reference broadcasts attr writes)
+            super()._execute_call(idx, c, shards, ExecOptions(remote=True))
+            self._broadcast_call(idx, c)
+            return None
+        if name == "Options":
+            return super()._execute_call(idx, c, shards, opt)
+        if name == "TopN":
+            return self._execute_topn_distributed(idx, c, shards, opt)
+        if name in self._FANOUT_CALLS:
+            partials = self._fan_out(idx, c, shards)
+            return self._reduce(name, c, partials)
+        return super()._execute_call(idx, c, shards, opt)
+
+    def _execute_write_by_column(self, idx: Index, c: Call) -> bool:
+        """Route a single-column write to every replica owner of its shard
+        (executor.go:2142-2172 executeSetBitField)."""
+        col = c.args.get("_col")
+        if not isinstance(col, int) or isinstance(col, bool):
+            raise ExecError(f"{c.name}() column argument required")
+        shard = col // SHARD_WIDTH
+        cluster = self._cluster()
+        owners = cluster.shard_nodes(idx.name, shard)
+        changed = False
+        errs = []
+        for n in owners:
+            try:
+                if n.id == self.local_id:
+                    r = super()._execute_call(
+                        idx, c, [shard], ExecOptions(remote=True)
+                    )
+                else:
+                    r = self.client.query_node(
+                        n.uri, idx.name, str(c), shards=[shard], remote=True
+                    )[0]
+                changed = changed or bool(r)
+            except Exception as e:
+                errs.append(f"{n.id}: {e}")
+        if errs and len(errs) == len(owners):
+            raise RemoteError("; ".join(errs))
+        if c.name == "Set":
+            self._announce_written_shard(idx, c, shard)
+        return changed
+
+    def _announce_written_shard(self, idx: Index, c: Call, shard: int) -> None:
+        """Make a newly-created shard visible to cluster-wide fan-out
+        (reference: field.AddRemoteAvailableShards broadcast on write)."""
+        try:
+            field_name = self._field_arg_name(c)
+        except ExecError:
+            return
+        f = idx.field(field_name)
+        if f is None:
+            return
+        # remote_available_shards doubles as "already announced cluster-wide"
+        if shard in f.remote_available_shards:
+            return
+        f.remote_available_shards.add(shard)
+        msg = {
+            "type": "available-shards",
+            "index": idx.name,
+            "field": field_name,
+            "shards": [shard],
+        }
+        for n in self._cluster().nodes:
+            if n.id == self.local_id or n.state == "DOWN":
+                continue
+            try:
+                self.client.send_message(n.uri, msg)
+            except Exception:
+                pass  # peers discover via the next import/announce
+
+    def _broadcast_call(self, idx: Index, c: Call) -> None:
+        for n in self._cluster().nodes:
+            if n.id == self.local_id or n.state == "DOWN":
+                continue
+            try:
+                self.client.query_node(
+                    n.uri, idx.name, str(c), shards=None, remote=True
+                )
+            except Exception:
+                pass  # attr drift repairs via anti-entropy
+
+    def _topn_fan_out(self, idx: Index, c: Call, shards) -> List[Pair]:
+        """One TopN pass across the cluster: partials are untrimmed
+        per-node candidate lists with exact per-node counts."""
+        partials = self._fan_out(idx, c, shards)
+        merged: Dict[int, int] = {}
+        for p in partials:
+            for pair in p or []:
+                merged[pair.id] = merged.get(pair.id, 0) + pair.count
+        pairs = [Pair(id=i, count=cnt) for i, cnt in merged.items()]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        return pairs
+
+    def _execute_topn_distributed(
+        self, idx: Index, c: Call, shards, opt: ExecOptions
+    ) -> List[Pair]:
+        """Coordinator-level two-pass TopN (executor.go:860-999): pass 1
+        collects per-node candidates; pass 2 re-counts the merged candidate
+        ids exactly on every node."""
+        pairs = self._topn_fan_out(idx, c, shards)
+        n = c.uint_arg("n")
+        if not pairs or c.args.get("ids"):
+            return pairs
+        other = Call(c.name, dict(c.args), list(c.children))
+        other.args["ids"] = sorted(p.id for p in pairs)
+        trimmed = self._topn_fan_out(idx, other, shards)
+        if n and len(trimmed) > n:
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _shards_for(self, idx: Index, shards, call: Optional[Call] = None) -> List[int]:
+        """Cluster-wide shard list: the union of available shards known
+        locally plus remote-available bitmaps (field.go:88)."""
+        if shards is not None:
+            return super()._shards_for(idx, shards, call)
+        s = set(idx.available_shards())
+        for f in idx.fields(include_hidden=True):
+            s.update(f.remote_available_shards)
+        base = sorted(s) or [0]
+        return super()._shards_for(idx, base, call)

@@ -1,0 +1,13 @@
+"""Server layer: API surface, HTTP handler, internode client, daemon.
+
+Reference: /root/reference/api.go (operation surface + state gating),
+http/handler.go (REST routes), http/client.go (InternalClient), server.go
+(daemon composition, broadcast dispatch).
+
+Transport note: internode HTTP here is the *control + compat* plane (multi-
+host DCN in the TPU mapping, SURVEY.md §2.4); the intra-host data plane is
+the compiled mesh program in parallel/. JSON everywhere (the reference's
+protobuf negotiation is an encoding detail, not a capability)."""
+
+from pilosa_tpu.server.api import API, ApiError, DisabledError  # noqa: F401
+from pilosa_tpu.server.node import NodeServer  # noqa: F401

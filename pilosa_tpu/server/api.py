@@ -1,0 +1,417 @@
+"""API: every cluster operation as a validated method.
+
+Reference: /root/reference/api.go — API.Query (:135), CreateIndex/Field,
+Import (:920) with shard->owner routing, ImportValue (:1031), ExportCSV
+(:500), cluster-state gating (:101-126, apiMethod enum :1340-1393),
+ClusterMessage receive (server.go:569 receiveMessage dispatch).
+
+The API belongs to one node (NodeServer); multi-node behavior goes through
+the node's DistributedExecutor and InternalClient."""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pilosa_tpu.cluster.topology import (
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+)
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core import timeq
+from pilosa_tpu.exec.executor import ExecError, ExecOptions, NotFoundError
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class ApiError(Exception):
+    pass
+
+
+class DisabledError(ApiError):
+    """Operation not allowed in the current cluster state
+    (reference: ErrClusterDoesNotOwnShard / apiMethodNotAllowedError)."""
+
+
+# methods allowed per cluster state (api.go:1379-1393): reads survive
+# DEGRADED; writes and DDL require NORMAL; RESIZING allows only status/
+# internal traffic.
+_WRITE_METHODS = {
+    "create_index", "delete_index", "create_field", "delete_field",
+    "import_bits", "import_values", "apply_schema",
+}
+
+
+class API:
+    def __init__(self, server: "NodeServer"):  # noqa: F821
+        self.server = server
+
+    # -- helpers -----------------------------------------------------------
+
+    @property
+    def holder(self):
+        return self.server.holder
+
+    @property
+    def cluster(self):
+        return self.server.cluster
+
+    def _validate(self, method: str, write: bool = False) -> None:
+        state = self.server.state
+        if state == STATE_NORMAL:
+            return
+        if state == STATE_DEGRADED and not write and method not in _WRITE_METHODS:
+            return
+        if state == STATE_RESIZING and method in ("query",) and not write:
+            return
+        raise DisabledError(f"api method {method!r} not allowed in state {state}")
+
+    def _broadcast(self, message: dict) -> None:
+        """Send a cluster message to every peer (reference:
+        server.go:666-705 SendSync; delivery here is per-node HTTP)."""
+        for n in self.cluster.nodes:
+            if n.id == self.server.node.id:
+                continue
+            try:
+                self.server.client.send_message(n.uri, message)
+            except Exception:
+                self.server.logger(
+                    f"broadcast {message.get('type')} to {n.id} failed"
+                )
+
+    # -- query (api.go:135) ------------------------------------------------
+
+    def query(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[Sequence[int]] = None,
+        remote: bool = False,
+    ) -> List[Any]:
+        self._validate("query")
+        opt = ExecOptions(remote=remote)
+        return self.server.executor.execute(index, query, shards=shards, opt=opt)
+
+    # -- schema DDL (api.go:206-368) ---------------------------------------
+
+    def create_index(
+        self,
+        name: str,
+        keys: bool = False,
+        track_existence: bool = True,
+        broadcast: bool = True,
+    ):
+        self._validate("create_index", write=True)
+        idx = self.holder.create_index_if_not_exists(
+            name, keys=keys, track_existence=track_existence
+        )
+        self.server.wire_translation()
+        if broadcast:
+            self._broadcast(
+                {
+                    "type": "create-index",
+                    "index": name,
+                    "keys": keys,
+                    "trackExistence": track_existence,
+                }
+            )
+        return idx
+
+    def delete_index(self, name: str, broadcast: bool = True) -> None:
+        self._validate("delete_index", write=True)
+        try:
+            self.holder.delete_index(name)
+        except KeyError:
+            pass
+        if broadcast:
+            self._broadcast({"type": "delete-index", "index": name})
+
+    def create_field(
+        self,
+        index: str,
+        name: str,
+        options: Optional[dict] = None,
+        broadcast: bool = True,
+    ):
+        self._validate("create_field", write=True)
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        opts = FieldOptions(**(options or {}))
+        f = idx.create_field_if_not_exists(name, opts)
+        self.server.wire_translation()
+        if broadcast:
+            self._broadcast(
+                {
+                    "type": "create-field",
+                    "index": index,
+                    "field": name,
+                    "options": options or {},
+                }
+            )
+        return f
+
+    def delete_field(self, index: str, name: str, broadcast: bool = True) -> None:
+        self._validate("delete_field", write=True)
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        try:
+            idx.delete_field(name)
+        except KeyError:
+            pass
+        if broadcast:
+            self._broadcast({"type": "delete-field", "index": index, "field": name})
+
+    def schema(self) -> List[dict]:
+        return self.holder.schema()
+
+    def apply_schema(self, schema: List[dict]) -> None:
+        """Apply a full schema dump (reference: api.ApplySchema / resize
+        applySchema, holder.go:327)."""
+        self._validate("apply_schema", write=True)
+        for ix in schema:
+            idx = self.holder.create_index_if_not_exists(
+                ix["name"],
+                keys=ix.get("options", {}).get("keys", False),
+                track_existence=ix.get("options", {}).get("trackExistence", True),
+            )
+            for fd in ix.get("fields", []):
+                opts = _field_options_from_json(fd.get("options", {}))
+                idx.create_field_if_not_exists(fd["name"], opts)
+        self.server.wire_translation()
+
+    # -- imports (api.go:920 Import, :1031 ImportValue) --------------------
+
+    def import_bits(
+        self,
+        index: str,
+        field: str,
+        rows: Sequence,
+        cols: Sequence,
+        clear: bool = False,
+        timestamps: Optional[Sequence] = None,
+        local_only: bool = False,
+    ) -> None:
+        """Bulk set-bit import; translates keys, groups bits by shard and
+        routes each shard batch to all its owner nodes (api.go:963-996)."""
+        self._validate("import_bits", write=True)
+        idx, f = self._index_field(index, field)
+        rows, cols = self._translate_import(idx, f, rows, cols)
+        shards = cols // SHARD_WIDTH
+        for shard in np.unique(shards):
+            m = shards == shard
+            ts = (
+                [timestamps[i] for i in np.nonzero(m)[0]]
+                if timestamps is not None
+                else None
+            )
+            self._route_shard_import(
+                idx, f, int(shard), rows[m], cols[m], clear, ts, local_only
+            )
+
+    def import_values(
+        self,
+        index: str,
+        field: str,
+        cols: Sequence,
+        values: Sequence[int],
+        local_only: bool = False,
+    ) -> None:
+        self._validate("import_values", write=True)
+        idx, f = self._index_field(index, field)
+        _, cols = self._translate_import(idx, f, None, cols)
+        values = np.asarray(values, dtype=np.int64)
+        shards = cols // SHARD_WIDTH
+        for shard in np.unique(shards):
+            m = shards == shard
+            owners = self.cluster.shard_nodes(idx.name, int(shard))
+            for n in owners if not local_only else [self.server.node]:
+                if n.id == self.server.node.id:
+                    f.import_values(cols[m], values[m])
+                    idx.track_columns(cols[m])
+                else:
+                    self.server.client.import_values(
+                        n.uri, index, field, int(shard),
+                        cols[m].tolist(), values[m].tolist(),
+                    )
+            if not local_only:
+                self._announce_shard(index, field, int(shard))
+
+    def _index_field(self, index: str, field: str):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        return idx, f
+
+    def _translate_import(self, idx, f, rows, cols):
+        if rows is not None:
+            if len(rows) and isinstance(rows[0], str):
+                if not f.options.keys:
+                    raise ApiError("row keys on an unkeyed field")
+                rows = f.translate_store.translate_keys(list(rows))
+            rows = np.asarray(rows, dtype=np.uint64)
+        if len(cols) and isinstance(cols[0], str):
+            if not idx.keys:
+                raise ApiError("column keys on an unkeyed index")
+            cols = idx.translate_store.translate_keys(list(cols))
+        cols = np.asarray(cols, dtype=np.uint64)
+        return rows, cols
+
+    def _route_shard_import(
+        self, idx, f, shard, rows, cols, clear, timestamps, local_only
+    ) -> None:
+        owners = self.cluster.shard_nodes(idx.name, shard)
+        targets = [self.server.node] if local_only else owners
+        for n in targets:
+            if n.id == self.server.node.id:
+                ts = (
+                    [timeq.parse_time(t) if t is not None else None for t in timestamps]
+                    if timestamps is not None
+                    else None
+                )
+                f.import_bits(rows, cols, timestamps=ts, clear=clear)
+                idx.track_columns(cols)
+            else:
+                self.server.client.import_bits(
+                    n.uri, idx.name, f.name, shard,
+                    rows.tolist(), cols.tolist(), clear,
+                    timestamps=timestamps,
+                )
+        if not local_only:
+            self._announce_shard(idx.name, f.name, shard)
+
+    def _announce_shard(self, index: str, field: str, shard: int) -> None:
+        """Tell every node the shard now exists so query fan-out covers it
+        (reference: field.AddRemoteAvailableShards broadcast)."""
+        msg = {
+            "type": "available-shards",
+            "index": index,
+            "field": field,
+            "shards": [shard],
+        }
+        self.receive_message(msg)
+        self._broadcast(msg)
+
+    # -- export (api.go:500 ExportCSV) -------------------------------------
+
+    def export_csv(self, index: str, field: str, shard: Optional[int] = None) -> str:
+        self._validate("export_csv")
+        idx, f = self._index_field(index, field)
+        from pilosa_tpu.core.view import VIEW_STANDARD
+
+        v = f.view(VIEW_STANDARD)
+        out = io.StringIO()
+        if v is None:
+            return ""
+        shards = [shard] if shard is not None else sorted(v.fragments)
+        for s in shards:
+            frag = v.fragment_if_exists(s)
+            if frag is None:
+                continue
+            rows, cols = frag.pairs()
+            base = s * SHARD_WIDTH
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                rk = (
+                    f.translate_store.key_for_id(int(r))
+                    if f.options.keys
+                    else None
+                )
+                ck = (
+                    idx.translate_store.key_for_id(int(base + c))
+                    if idx.keys
+                    else None
+                )
+                out.write(
+                    f"{rk if rk is not None else int(r)},"
+                    f"{ck if ck is not None else int(base + c)}\n"
+                )
+        return out.getvalue()
+
+    # -- cluster info ------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "state": self.server.state,
+            "localID": self.server.node.id,
+            "clusterID": self.server.cluster_name,
+            "nodes": [n.to_json() for n in self.cluster.nodes],
+        }
+
+    def hosts(self) -> List[dict]:
+        return [n.to_json() for n in self.cluster.nodes]
+
+    def shard_nodes(self, index: str, shard: int) -> List[dict]:
+        return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]
+
+    def max_shards(self) -> Dict[str, int]:
+        out = {}
+        for idx in self.holder.indexes():
+            av = idx.available_shards()
+            out[idx.name] = (max(av) + 1) if av else 0
+        return out
+
+    # -- message dispatch (server.go:569 receiveMessage) -------------------
+
+    def receive_message(self, msg: dict) -> dict:
+        t = msg.get("type")
+        if t == "create-index":
+            self.holder.create_index_if_not_exists(
+                msg["index"],
+                keys=msg.get("keys", False),
+                track_existence=msg.get("trackExistence", True),
+            )
+            self.server.wire_translation()
+        elif t == "delete-index":
+            try:
+                self.holder.delete_index(msg["index"])
+            except KeyError:
+                pass
+        elif t == "create-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.create_field_if_not_exists(
+                    msg["field"], FieldOptions(**msg.get("options", {}))
+                )
+            self.server.wire_translation()
+        elif t == "delete-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.delete_field(msg["field"])
+                except KeyError:
+                    pass
+        elif t == "available-shards":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                f = idx.field(msg["field"])
+                if f is not None:
+                    f.remote_available_shards.update(int(s) for s in msg["shards"])
+        elif t == "cluster-status":
+            self.server.apply_cluster_status(msg)
+        elif t == "node-state":
+            self.server.set_node_state(msg["node"], msg["state"])
+        elif t == "recalculate-caches":
+            pass  # caches recompute lazily
+        else:
+            raise ApiError(f"unknown cluster message type {t!r}")
+        return {"ok": True}
+
+
+def _field_options_from_json(o: dict) -> FieldOptions:
+    return FieldOptions(
+        type=o.get("type", "set"),
+        cache_type=o.get("cacheType", o.get("cache_type", "ranked")),
+        cache_size=o.get("cacheSize", o.get("cache_size", 50000)),
+        min=o.get("min", 0),
+        max=o.get("max", 0),
+        time_quantum=o.get("timeQuantum", o.get("time_quantum", "")),
+        keys=o.get("keys", False),
+        no_standard_view=o.get("noStandardView", o.get("no_standard_view", False)),
+    )

@@ -1,0 +1,246 @@
+"""InternalClient: node-to-node (and CLI-to-node) HTTP client.
+
+Reference: /root/reference/http/client.go — QueryNode (:268), imports
+(:319-669), fragment retrieval for resize (:742 RetrieveShardFromURI),
+block sync (:842-933), message send (:1017); interface in client.go:46.
+
+stdlib urllib only (no external deps); JSON bodies; every method raises
+ClientError on transport or remote failure so the executor's failover path
+can re-map shards."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_TIMEOUT = 30.0
+
+
+class ClientError(Exception):
+    pass
+
+
+class InternalClient:
+    def __init__(self, timeout: float = DEFAULT_TIMEOUT):
+        self.timeout = timeout
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _do(
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        body: Optional[bytes] = None,
+        query: Optional[Dict[str, Any]] = None,
+        content_type: str = "application/json",
+        timeout: Optional[float] = None,
+    ) -> bytes:
+        url = uri.rstrip("/") + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")[:500]
+            raise ClientError(f"{method} {url} -> {e.code}: {detail}") from e
+        except Exception as e:
+            raise ClientError(f"{method} {url}: {e}") from e
+
+    def _json(self, *args, **kw) -> Any:
+        data = self._do(*args, **kw)
+        return json.loads(data) if data else None
+
+    # -- query (http/client.go:268 QueryNode) ------------------------------
+
+    def query_node(
+        self,
+        uri: str,
+        index: str,
+        query: str,
+        shards: Optional[Sequence[int]] = None,
+        remote: bool = False,
+    ) -> List[Any]:
+        from pilosa_tpu.server import wire
+
+        body = {"query": query, "remote": remote}
+        if shards is not None:
+            body["shards"] = list(shards)
+        resp = self._json(
+            "POST",
+            uri,
+            f"/internal/index/{index}/query",
+            json.dumps(body).encode(),
+        )
+        if resp.get("error"):
+            raise ClientError(resp["error"])
+        return [wire.decode_result(r) for r in resp["results"]]
+
+    # -- schema ------------------------------------------------------------
+
+    def schema(self, uri: str) -> List[dict]:
+        return self._json("GET", uri, "/schema")["indexes"]
+
+    def status(self, uri: str, timeout: Optional[float] = None) -> dict:
+        return self._json("GET", uri, "/status", timeout=timeout)
+
+    # -- cluster messages (http/client.go:1017 SendMessage) ----------------
+
+    def send_message(self, uri: str, message: dict) -> dict:
+        return self._json(
+            "POST", uri, "/internal/cluster/message", json.dumps(message).encode()
+        ) or {}
+
+    # -- imports (http/client.go:319-669) ----------------------------------
+
+    def import_bits(
+        self,
+        uri: str,
+        index: str,
+        field: str,
+        shard: int,
+        rows: Sequence[int],
+        cols: Sequence[int],
+        clear: bool = False,
+        timestamps: Optional[Sequence[Optional[str]]] = None,
+    ) -> None:
+        body = {
+            "shard": shard,
+            "rows": [int(r) for r in rows],
+            "cols": [int(c) for c in cols],
+            "clear": clear,
+        }
+        if timestamps is not None:
+            body["timestamps"] = list(timestamps)
+        self._do(
+            "POST",
+            uri,
+            f"/internal/index/{index}/field/{field}/import",
+            json.dumps(body).encode(),
+        )
+
+    def import_values(
+        self,
+        uri: str,
+        index: str,
+        field: str,
+        shard: int,
+        cols: Sequence[int],
+        values: Sequence[int],
+    ) -> None:
+        body = {
+            "shard": shard,
+            "cols": [int(c) for c in cols],
+            "values": [int(v) for v in values],
+        }
+        self._do(
+            "POST",
+            uri,
+            f"/internal/index/{index}/field/{field}/import-value",
+            json.dumps(body).encode(),
+        )
+
+    # -- fragment sync (http/client.go:842-933) ----------------------------
+
+    def fragment_blocks(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> Dict[int, str]:
+        resp = self._json(
+            "GET",
+            uri,
+            "/internal/fragment/blocks",
+            query={"index": index, "field": field, "view": view, "shard": shard},
+        )
+        return {int(k): v for k, v in resp.get("blocks", {}).items()}
+
+    def block_data(
+        self, uri: str, index: str, field: str, view: str, shard: int, block: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        resp = self._json(
+            "GET",
+            uri,
+            "/internal/fragment/block/data",
+            query={
+                "index": index,
+                "field": field,
+                "view": view,
+                "shard": shard,
+                "block": block,
+            },
+        )
+        return (
+            np.array(resp.get("rows", []), np.uint64),
+            np.array(resp.get("cols", []), np.uint64),
+        )
+
+    def send_block_deltas(
+        self,
+        uri: str,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        sets: Tuple[np.ndarray, np.ndarray],
+        clears: Tuple[np.ndarray, np.ndarray],
+    ) -> None:
+        body = {
+            "index": index,
+            "field": field,
+            "view": view,
+            "shard": shard,
+            "sets": {"rows": sets[0].tolist(), "cols": sets[1].tolist()},
+            "clears": {"rows": clears[0].tolist(), "cols": clears[1].tolist()},
+        }
+        self._do(
+            "POST", uri, "/internal/fragment/block/deltas", json.dumps(body).encode()
+        )
+
+    # -- fragment streaming for resize (http/client.go:742) ----------------
+
+    def retrieve_fragment(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> bytes:
+        return self._do(
+            "GET",
+            uri,
+            "/internal/fragment/data",
+            query={"index": index, "field": field, "view": view, "shard": shard},
+        )
+
+    # -- translate replication (http/translator.go:44) ---------------------
+
+    def fragment_inventory(self, uri: str, index: str) -> List[Tuple[str, str, int]]:
+        resp = self._json("GET", uri, f"/internal/index/{index}/fragments")
+        return [(f, v, int(s)) for f, v, s in resp.get("frags", [])]
+
+    def translate_keys_remote(
+        self, uri: str, index: str, field: Optional[str], keys: Sequence[str]
+    ) -> List[int]:
+        """Ask the coordinator to allocate ids for keys (single-writer)."""
+        body = {"index": index, "keys": list(keys)}
+        if field:
+            body["field"] = field
+        resp = self._json(
+            "POST", uri, "/internal/translate/keys", json.dumps(body).encode()
+        )
+        if resp.get("error"):
+            raise ClientError(resp["error"])
+        return [int(i) for i in resp["ids"]]
+
+    def translate_entries(
+        self, uri: str, index: str, field: Optional[str], offset: int
+    ) -> Tuple[List[Tuple[int, str]], int]:
+        q = {"index": index, "offset": offset}
+        if field:
+            q["field"] = field
+        resp = self._json("GET", uri, "/internal/translate/data", query=q)
+        return [(int(i), k) for i, k in resp["entries"]], int(resp["offset"])

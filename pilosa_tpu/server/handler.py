@@ -1,0 +1,365 @@
+"""HTTP handler: public REST routes + internal internode routes.
+
+Reference: /root/reference/http/handler.go:276-318 route table —
+public:   /status /schema /index/{i} /index/{i}/query
+          /index/{i}/field/{f} /index/{i}/field/{f}/import /export
+internal: /internal/index/{i}/query /internal/cluster/message
+          /internal/fragment/{blocks,block/data,data}
+          /internal/translate/data /internal/shards/max
+
+stdlib ThreadingHTTPServer; JSON request/response bodies (PQL queries may
+also arrive as raw text, matching the reference's text/plain handling)."""
+
+from __future__ import annotations
+
+import json
+import re
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pilosa_tpu.exec.executor import ExecError, NotFoundError
+from pilosa_tpu.server import wire
+from pilosa_tpu.server.api import ApiError, DisabledError
+
+_ROUTES: List[Tuple[str, re.Pattern, str]] = []
+
+
+def route(method: str, pattern: str):
+    rx = re.compile("^" + pattern + "$")
+
+    def deco(fn):
+        _ROUTES.append((method, rx, fn.__name__))
+        return fn
+
+    return deco
+
+
+class Handler(BaseHTTPRequestHandler):
+    server_version = "pilosa-tpu/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # quiet default request logging; NodeServer.logger gets errors only
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def node(self):
+        return self.server.node_server
+
+    @property
+    def api(self):
+        return self.server.node_server.api
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _json_body(self) -> Any:
+        data = self._body()
+        return json.loads(data) if data else {}
+
+    def _reply(self, obj: Any, code: int = 200, raw: Optional[bytes] = None,
+               content_type: str = "application/json") -> None:
+        body = raw if raw is not None else json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, msg: str, code: int = 400) -> None:
+        self._reply({"error": msg}, code=code)
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        self.query = {
+            k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()
+        }
+        for m, rx, fn_name in _ROUTES:
+            if m != method:
+                continue
+            match = rx.match(parsed.path)
+            if match:
+                try:
+                    getattr(self, fn_name)(**match.groupdict())
+                except (NotFoundError,) as e:
+                    self._error(str(e), 404)
+                except DisabledError as e:
+                    self._error(str(e), 503)
+                except (ExecError, ApiError, ValueError, KeyError) as e:
+                    self._error(str(e), 400)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    self.node.logger(traceback.format_exc())
+                    self._error(f"internal error: {e}", 500)
+                return
+        self._error(f"no route for {method} {parsed.path}", 404)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -- public routes -----------------------------------------------------
+
+    @route("GET", "/status")
+    def get_status(self):
+        self._reply(self.api.status())
+
+    @route("GET", "/schema")
+    def get_schema(self):
+        self._reply({"indexes": self.api.schema()})
+
+    @route("POST", "/schema")
+    def post_schema(self):
+        self.api.apply_schema(self._json_body().get("indexes", []))
+        self._reply({})
+
+    @route("GET", "/hosts")
+    def get_hosts(self):
+        self._reply(self.api.hosts())
+
+    @route("POST", "/index/(?P<index>[^/]+)")
+    def post_index(self, index: str):
+        opts = self._json_body().get("options", {})
+        self.api.create_index(
+            index,
+            keys=opts.get("keys", False),
+            track_existence=opts.get("trackExistence", True),
+        )
+        self._reply({"success": True})
+
+    @route("DELETE", "/index/(?P<index>[^/]+)")
+    def delete_index(self, index: str):
+        self.api.delete_index(index)
+        self._reply({"success": True})
+
+    @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)")
+    def post_field(self, index: str, field: str):
+        opts = self._json_body().get("options", {})
+        # accept the reference's camelCase public option names
+        from pilosa_tpu.server.api import _field_options_from_json
+        from dataclasses import asdict
+
+        self.api.create_field(index, field, options=asdict(_field_options_from_json(opts)))
+        self._reply({"success": True})
+
+    @route("DELETE", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)")
+    def delete_field(self, index: str, field: str):
+        self.api.delete_field(index, field)
+        self._reply({"success": True})
+
+    @route("POST", "/index/(?P<index>[^/]+)/query")
+    def post_query(self, index: str):
+        body = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        shards = None
+        if ctype == "application/json":
+            d = json.loads(body) if body else {}
+            pql = d.get("query", "")
+            shards = d.get("shards")
+        else:
+            pql = body.decode("utf-8")
+            if "shards" in self.query:
+                shards = [int(s) for s in self.query["shards"].split(",")]
+        results = self.api.query(index, pql, shards=shards)
+        self._reply({"results": [wire.result_to_public_json(r) for r in results]})
+
+    @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
+    def post_import(self, index: str, field: str):
+        d = self._json_body()
+        rows = d.get("rowKeys") or d.get("rows") or []
+        cols = d.get("colKeys") or d.get("cols") or []
+        self.api.import_bits(
+            index, field, rows, cols,
+            clear=d.get("clear", False),
+            timestamps=d.get("timestamps"),
+        )
+        self._reply({})
+
+    @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-value")
+    def post_import_value(self, index: str, field: str):
+        d = self._json_body()
+        cols = d.get("colKeys") or d.get("cols") or []
+        self.api.import_values(index, field, cols, d.get("values", []))
+        self._reply({})
+
+    @route("GET", "/export")
+    def get_export(self):
+        index = self.query["index"]
+        field = self.query["field"]
+        shard = int(self.query["shard"]) if "shard" in self.query else None
+        csv = self.api.export_csv(index, field, shard)
+        self._reply(None, raw=csv.encode(), content_type="text/csv")
+
+    @route("GET", "/internal/shards/max")
+    def get_max_shards(self):
+        self._reply({"standard": self.api.max_shards()})
+
+    @route("GET", "/index/(?P<index>[^/]+)/shard-nodes")
+    def get_shard_nodes(self, index: str):
+        self._reply(self.api.shard_nodes(index, int(self.query["shard"])))
+
+    # -- internal routes ---------------------------------------------------
+
+    @route("POST", "/internal/index/(?P<index>[^/]+)/query")
+    def post_internal_query(self, index: str):
+        d = self._json_body()
+        try:
+            results = self.api.query(
+                index,
+                d.get("query", ""),
+                shards=d.get("shards"),
+                remote=d.get("remote", True),
+            )
+        except (ExecError, ApiError) as e:
+            self._reply({"error": str(e)})
+            return
+        self._reply({"results": [wire.encode_result(r) for r in results]})
+
+    @route("POST", "/internal/cluster/message")
+    def post_cluster_message(self):
+        self._reply(self.api.receive_message(self._json_body()))
+
+    @route("POST", "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
+    def post_internal_import(self, index: str, field: str):
+        d = self._json_body()
+        self.api.import_bits(
+            index, field, d.get("rows", []), d.get("cols", []),
+            clear=d.get("clear", False),
+            timestamps=d.get("timestamps"),
+            local_only=True,
+        )
+        self._reply({})
+
+    @route("POST", "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-value")
+    def post_internal_import_value(self, index: str, field: str):
+        d = self._json_body()
+        self.api.import_values(
+            index, field, d.get("cols", []), d.get("values", []), local_only=True
+        )
+        self._reply({})
+
+    def _fragment(self):
+        idx = self.node.holder.index(self.query["index"])
+        if idx is None:
+            raise NotFoundError(f"index not found: {self.query['index']}")
+        f = idx.field(self.query["field"])
+        if f is None:
+            raise NotFoundError(f"field not found: {self.query['field']}")
+        v = f.views.get(self.query.get("view", "standard"))
+        if v is None:
+            return None
+        return v.fragment_if_exists(int(self.query["shard"]))
+
+    @route("GET", "/internal/fragment/blocks")
+    def get_fragment_blocks(self):
+        frag = self._fragment()
+        sums = frag.block_checksums() if frag is not None else {}
+        self._reply({"blocks": {str(k): v.hex() for k, v in sums.items()}})
+
+    @route("GET", "/internal/fragment/block/data")
+    def get_block_data(self):
+        frag = self._fragment()
+        if frag is None:
+            self._reply({"rows": [], "cols": []})
+            return
+        rows, cols = frag.block_pairs(int(self.query["block"]))
+        self._reply({"rows": rows.tolist(), "cols": cols.tolist()})
+
+    @route("POST", "/internal/fragment/block/deltas")
+    def post_block_deltas(self):
+        d = self._json_body()
+        idx = self.node.holder.index(d["index"])
+        if idx is None:
+            raise NotFoundError(f"index not found: {d['index']}")
+        f = idx.field(d["field"])
+        if f is None:
+            raise NotFoundError(f"field not found: {d['field']}")
+        v = f._view_create(d.get("view", "standard"))
+        frag = v.fragment(int(d["shard"]))
+        frag.apply_deltas(
+            (
+                np.array(d["sets"]["rows"], np.uint64),
+                np.array(d["sets"]["cols"], np.uint64),
+            ),
+            (
+                np.array(d["clears"]["rows"], np.uint64),
+                np.array(d["clears"]["cols"], np.uint64),
+            ),
+        )
+        self._reply({})
+
+    @route("GET", "/internal/fragment/data")
+    def get_fragment_data(self):
+        frag = self._fragment()
+        if frag is None:
+            self._error("fragment not found", 404)
+            return
+        self._reply(None, raw=frag.to_bytes(), content_type="application/octet-stream")
+
+    @route("POST", "/internal/translate/keys")
+    def post_translate_keys(self):
+        d = self._json_body()
+        idx = self.node.holder.index(d["index"])
+        if idx is None:
+            raise NotFoundError(f"index not found: {d['index']}")
+        store = idx.translate_store
+        if d.get("field"):
+            f = idx.field(d["field"])
+            if f is None:
+                raise NotFoundError(f"field not found: {d['field']}")
+            store = f.translate_store
+        coord = self.node.cluster.coordinator()
+        if coord is not None and coord.id != self.node.node.id:
+            self._reply({"error": "not the translation primary"})
+            return
+        self._reply({"ids": store.translate_keys(d.get("keys", []))})
+
+    @route("GET", "/internal/index/(?P<index>[^/]+)/fragments")
+    def get_fragment_inventory(self, index: str):
+        idx = self.node.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        frags = []
+        for f in idx.fields(include_hidden=True):
+            for vname, v in f.views.items():
+                for shard in sorted(v.fragments):
+                    frags.append([f.name, vname, shard])
+        self._reply({"frags": frags})
+
+    @route("GET", "/internal/translate/data")
+    def get_translate_data(self):
+        idx = self.node.holder.index(self.query["index"])
+        if idx is None:
+            raise NotFoundError(f"index not found: {self.query['index']}")
+        store = idx.translate_store
+        if "field" in self.query:
+            f = idx.field(self.query["field"])
+            if f is None:
+                raise NotFoundError(f"field not found: {self.query['field']}")
+            store = f.translate_store
+        entries, offset = store.entries_since(int(self.query.get("offset", 0)))
+        self._reply({"entries": entries, "offset": offset})
+
+
+class NodeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def make_http_server(node_server, host: str, port: int) -> NodeHTTPServer:
+    srv = NodeHTTPServer((host, port), Handler)
+    srv.node_server = node_server
+    return srv

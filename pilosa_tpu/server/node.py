@@ -1,0 +1,343 @@
+"""NodeServer: composition root for one cluster node.
+
+Reference: /root/reference/server.go — Server owns holder + cluster +
+executor + background loops (anti-entropy :514, runtime metrics :813) and
+dispatches received broadcast messages (:569). Bootstrap is the
+server/server.go SetupServer path.
+
+TPU-native membership: the mesh is STATIC configuration (a list of node
+ids/URIs), the JAX-distributed-runtime model, instead of SWIM gossip —
+liveness is detected by HTTP /status probes (the reference also
+belt-and-suspenders probes over HTTP, cluster.go:1724-1752). Elasticity is
+checkpoint-based resharding driven by `resize_to` rather than live
+streaming under a coordinator FSM (SURVEY.md hard-part #5)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from pilosa_tpu.cluster.topology import (
+    STATE_NORMAL,
+    Cluster,
+    JumpHasher,
+    Node,
+)
+from pilosa_tpu.cluster import antientropy
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.distributed import DistributedExecutor
+from pilosa_tpu.server.client import ClientError, InternalClient
+
+
+class NodeServer:
+    def __init__(
+        self,
+        data_dir: Optional[str],
+        node_id: str,
+        *,
+        bind: str = "localhost:0",
+        replica_n: int = 1,
+        hasher=None,
+        cluster_name: str = "cluster0",
+        anti_entropy_interval: float = 0.0,  # 0 = manual sync only
+        logger=None,
+    ):
+        self.data_dir = data_dir
+        self.node = Node(id=node_id, uri="")
+        self.bind = bind
+        self.cluster = Cluster(
+            nodes=[self.node], replica_n=replica_n, hasher=hasher or JumpHasher()
+        )
+        self.cluster_name = cluster_name
+        self.state = STATE_NORMAL
+        self.holder = Holder(data_dir)
+        self.client = InternalClient()
+        self.executor = DistributedExecutor(
+            self.holder, lambda: self.cluster, self.client, node_id
+        )
+        self.anti_entropy_interval = anti_entropy_interval
+        self.logger = logger or (lambda msg: None)
+        self._httpd = None
+        self._http_thread = None
+        self._ae_thread = None
+        self._probe_thread = None
+        self._closing = threading.Event()
+        self._down_ids: set = set()
+
+        from pilosa_tpu.server.api import API
+
+        self.api = API(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "NodeServer":
+        self.holder.open()
+        from pilosa_tpu.server.handler import make_http_server
+
+        host, port = self.bind.rsplit(":", 1)
+        self._httpd = make_http_server(self, host, int(port))
+        actual_port = self._httpd.server_address[1]
+        self.node.uri = f"http://{host}:{actual_port}"
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"http-{self.node.id}", daemon=True
+        )
+        self._http_thread.start()
+        if self.anti_entropy_interval > 0:
+            self._ae_thread = threading.Thread(
+                target=self._anti_entropy_loop, daemon=True
+            )
+            self._ae_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._closing.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.holder.close()
+
+    # -- topology ----------------------------------------------------------
+
+    def set_topology(self, nodes: List[Node], replica_n: Optional[int] = None) -> None:
+        """Install the static cluster membership (all nodes must agree; the
+        test/bootstrap harness calls this after every node has bound)."""
+        self.cluster = Cluster(
+            nodes=[Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator) for n in nodes],
+            replica_n=replica_n if replica_n is not None else self.cluster.replica_n,
+            partition_n=self.cluster.partition_n,
+            hasher=self.cluster.hasher,
+            state=STATE_NORMAL,
+        )
+        # keep self.node identity in sync with the membership entry
+        mine = self.cluster.node_by_id(self.node.id)
+        if mine is not None:
+            mine.uri = self.node.uri
+            self.node = mine
+        self.wire_translation()
+
+    def wire_translation(self) -> None:
+        """Install single-writer key translation: the coordinator's stores
+        stay writable; every other node's stores forward allocations to the
+        coordinator and catch up from its append log (reference:
+        boltdb/translate.go single-writer + holder.go:785-880 follower)."""
+        coord = self.cluster.coordinator() or (
+            self.cluster.nodes[0] if self.cluster.nodes else None
+        )
+        if coord is None:
+            return
+        is_primary = coord.id == self.node.id
+        for idx in self.holder.indexes():
+            if idx.keys:
+                self._wire_store(idx.translate_store, coord, is_primary, idx.name, None)
+            for f in idx.fields(include_hidden=True):
+                if f.options.keys:
+                    self._wire_store(
+                        f.translate_store, coord, is_primary, idx.name, f.name
+                    )
+
+    def _wire_store(self, store, coord, is_primary: bool, index: str, field) -> None:
+        if is_primary:
+            store.read_only = False
+            store.forward_fn = None
+            store.catchup_fn = None
+            return
+        if not hasattr(store, "_repl_offset"):
+            store._repl_offset = 0
+        store.read_only = True
+        store.forward_fn = lambda keys: self.client.translate_keys_remote(
+            coord.uri, index, field, keys
+        )
+
+        def catchup():
+            entries, off = self.client.translate_entries(
+                coord.uri, index, field, store._repl_offset
+            )
+            store.apply_entries(entries)
+            store._repl_offset = off
+
+        store.catchup_fn = catchup
+
+    def apply_cluster_status(self, msg: dict) -> None:
+        self.set_topology(
+            [Node.from_json(n) for n in msg["nodes"]],
+            replica_n=msg.get("replicaN"),
+        )
+        self.state = msg.get("state", self.state)
+
+    def set_node_state(self, node_id: str, state: str) -> None:
+        n = self.cluster.node_by_id(node_id)
+        if n is not None:
+            n.state = state
+        if state == "DOWN":
+            self._down_ids.add(node_id)
+        else:
+            self._down_ids.discard(node_id)
+        self.state = self.cluster.determine_state(self._down_ids)
+
+    def probe_peers(self) -> Dict[str, bool]:
+        """One failure-detection pass: /status every peer
+        (reference: confirmNodeDown, cluster.go:1724)."""
+        alive = {}
+        for n in self.cluster.nodes:
+            if n.id == self.node.id:
+                alive[n.id] = True
+                continue
+            try:
+                self.client.status(n.uri, timeout=2.0)
+                alive[n.id] = True
+                self.set_node_state(n.id, "READY")
+            except ClientError:
+                alive[n.id] = False
+                self.set_node_state(n.id, "DOWN")
+        return alive
+
+    # -- anti-entropy (holder.go:911 SyncHolder) ---------------------------
+
+    def _anti_entropy_loop(self) -> None:
+        while not self._closing.wait(self.anti_entropy_interval):
+            try:
+                self.sync_holder()
+            except Exception as e:
+                self.logger(f"anti-entropy: {e}")
+
+    def sync_holder(self) -> int:
+        """One full anti-entropy pass: for every local fragment whose shard
+        this node PRIMARY-owns, reconcile all replicas via block checksums
+        + majority-vote merge (fragment.go:2861 syncFragment). Returns the
+        number of fragments that needed repair."""
+        if self.cluster.replica_n <= 1 or len(self.cluster.nodes) <= 1:
+            return 0
+        repaired = 0
+        for idx in self.holder.indexes():
+            for f in idx.fields(include_hidden=True):
+                for vname, v in list(f.views.items()):
+                    for shard in sorted(v.fragments):
+                        owners = self.cluster.shard_nodes(idx.name, shard)
+                        if not owners or owners[0].id != self.node.id:
+                            continue  # only the primary drives the sync
+                        replicas = [n for n in owners[1:] if n.state != "DOWN"]
+                        if not replicas:
+                            continue
+                        if self._sync_fragment(idx, f, vname, shard, replicas):
+                            repaired += 1
+        return repaired
+
+    def _sync_fragment(self, idx, f, view: str, shard: int, replicas) -> bool:
+        frag = f.views[view].fragment_if_exists(shard)
+        if frag is None:
+            return False
+        local_sums = frag.block_checksums()
+        peer_sums = []
+        live = []
+        for n in replicas:
+            try:
+                peer_sums.append(
+                    {
+                        int(k): bytes.fromhex(hx)
+                        for k, hx in self.client.fragment_blocks(
+                            n.uri, idx.name, f.name, view, shard
+                        ).items()
+                    }
+                )
+                live.append(n)
+            except ClientError:
+                continue
+        if not live:
+            return False
+        diff: set = set()
+        for ps in peer_sums:
+            diff.update(antientropy.diff_blocks(local_sums, ps))
+        if not diff:
+            return False
+        for bid in sorted(diff):
+            blocks = [frag.block_pairs(bid)]
+            for n in live:
+                blocks.append(
+                    self.client.block_data(n.uri, idx.name, f.name, view, shard, bid)
+                )
+            sets, clears = antientropy.merge_block(bid, blocks)
+            frag.apply_deltas(sets[0], clears[0])
+            for i, n in enumerate(live, start=1):
+                if len(sets[i][0]) or len(clears[i][0]):
+                    self.client.send_block_deltas(
+                        n.uri, idx.name, f.name, view, shard, sets[i], clears[i]
+                    )
+        return True
+
+    # -- resize (checkpoint-based resharding; cluster.go:1447 analog) ------
+
+    def resize_to(
+        self,
+        new_nodes: List[Node],
+        replica_n: Optional[int] = None,
+        old_nodes: Optional[List[Node]] = None,
+    ) -> int:
+        """Checkpoint-based resize: diff fragment placement old->new,
+        stream fragments this node must acquire, then install the new
+        topology locally. Each node runs this against the same `new_nodes`
+        list (the bootstrap/ops layer coordinates the order); a JOINING node
+        passes `old_nodes` (the membership it is joining) since its own
+        cluster view is just itself. Returns fragments fetched."""
+        from pilosa_tpu.cluster.topology import Frag
+
+        old = self.cluster
+        if old_nodes is not None:
+            old = Cluster(
+                nodes=old_nodes,
+                replica_n=replica_n if replica_n is not None else old.replica_n,
+                partition_n=old.partition_n,
+                hasher=old.hasher,
+            )
+        new = Cluster(
+            nodes=new_nodes,
+            replica_n=replica_n if replica_n is not None else old.replica_n,
+            partition_n=old.partition_n,
+            hasher=old.hasher,
+            state=STATE_NORMAL,
+        )
+        fetched = 0
+        for idx in self.holder.indexes():
+            # cluster-wide fragment inventory: union of every old-cluster
+            # node's local fragments (a joining node has none of its own)
+            inventory = set()
+            for n in old.nodes:
+                if n.id == self.node.id:
+                    for f in idx.fields(include_hidden=True):
+                        for vname, v in f.views.items():
+                            inventory.update(
+                                (f.name, vname, s) for s in v.fragments
+                            )
+                    continue
+                try:
+                    inventory.update(
+                        self.client.fragment_inventory(n.uri, idx.name)
+                    )
+                except ClientError:
+                    continue
+            frags = [Frag(fl, vw, sh) for fl, vw, sh in sorted(inventory)]
+            if not frags:
+                continue
+            # make every inventoried shard visible to future query fan-out
+            for fl, vw, sh in inventory:
+                f = idx.field(fl)
+                if f is not None:
+                    f.remote_available_shards.add(sh)
+            sources = old.frag_sources(new, idx.name, frags)
+            for src in sources.get(self.node.id, []):
+                f = idx.field(src.field)
+                if f is None:
+                    continue
+                try:
+                    blob = self.client.retrieve_fragment(
+                        src.node.uri, idx.name, src.field, src.view, src.shard
+                    )
+                except ClientError as e:
+                    self.logger(f"resize fetch {src.index}/{src.field}: {e}")
+                    continue
+                v = f._view_create(src.view)
+                v.fragment(src.shard).from_bytes(blob)
+                fetched += 1
+        self.set_topology(new_nodes, replica_n=new.replica_n)
+        return fetched

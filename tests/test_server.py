@@ -1,0 +1,234 @@
+"""Server / cluster integration tests.
+
+Reference test model: executor_test.go + api_test.go over
+test.MustRunCluster (in-process nodes, real localhost HTTP), plus the
+clustertests fault-injection pattern (node kill -> query failover)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.testing import ClusterHarness
+
+
+def http_json(method, url, body=None, ctype="application/json"):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw and raw[:1] in (b"{", b"[") else raw
+
+
+@pytest.fixture(scope="module")
+def trio():
+    with ClusterHarness(3, replica_n=2, in_memory=True) as c:
+        yield c
+
+
+# ---------------------------------------------------------------------------
+# single node over HTTP
+# ---------------------------------------------------------------------------
+
+
+def test_single_node_http_end_to_end():
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        http_json("POST", f"{uri}/index/i1", {"options": {}})
+        http_json("POST", f"{uri}/index/i1/field/f1", {"options": {"type": "set"}})
+        # raw-PQL body form
+        r = http_json(
+            "POST", f"{uri}/index/i1/query",
+            b"Set(1, f1=10) Set(2, f1=10) Set(100000000, f1=10)",
+            ctype="text/plain",
+        )
+        assert r["results"] == [True, True, True]
+        r = http_json(
+            "POST", f"{uri}/index/i1/query", {"query": "Count(Row(f1=10))"}
+        )
+        assert r["results"] == [3]
+        r = http_json("POST", f"{uri}/index/i1/query", {"query": "Row(f1=10)"})
+        assert r["results"][0]["columns"] == [1, 2, 100000000]
+        schema = http_json("GET", f"{uri}/schema")
+        assert schema["indexes"][0]["name"] == "i1"
+        assert schema["indexes"][0]["fields"][0]["name"] == "f1"
+        status = http_json("GET", f"{uri}/status")
+        assert status["state"] == "NORMAL"
+        # bad query -> 400 with error body
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_json("POST", f"{uri}/index/i1/query", {"query": "Nope(f=1)"})
+        assert ei.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# three nodes, replica 2
+# ---------------------------------------------------------------------------
+
+
+def test_ddl_broadcast(trio):
+    trio[0].api.create_index("bcast")
+    trio[0].api.create_field("bcast", "f", {"type": "set"})
+    for s in trio.nodes:
+        assert s.holder.index("bcast") is not None
+        assert s.holder.index("bcast").field("f") is not None
+    trio[0].api.delete_index("bcast")
+    for s in trio.nodes:
+        assert s.holder.index("bcast") is None
+
+
+def test_distributed_import_and_query(trio):
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    api = trio[0].api
+    api.create_index("dist")
+    api.create_field("dist", "f", {"type": "set"})
+    # 1000 bits across 100 shards on row 0 (the clustertests shape)
+    cols = [(i % 100) * SHARD_WIDTH + i for i in range(1000)]
+    api.import_bits("dist", "f", [0] * len(cols), cols)
+
+    for s in trio.nodes:  # any node answers with the cluster-wide count
+        (cnt,) = s.api.query("dist", "Count(Row(f=0))")
+        assert cnt == 1000
+
+    # each shard is materialized on exactly replica_n nodes
+    shard_copies = 0
+    for s in trio.nodes:
+        idx = s.holder.index("dist")
+        f = idx.field("f")
+        v = f.view("standard")
+        shard_copies += len(v.fragments) if v else 0
+    assert shard_copies == 100 * 2
+
+
+def test_distributed_set_and_topn(trio):
+    api = trio[1].api  # drive from a non-coordinator node
+    api.create_index("q")
+    api.create_field("q", "f", {"type": "set"})
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    far = 7 * SHARD_WIDTH + 123
+    (r1,) = api.query("q", f"Set({far}, f=5)")
+    assert r1 is True
+    (r2,) = api.query("q", "Set(1, f=5) Set(2, f=5) Set(1, f=9)")[0:1]
+    for s in trio.nodes:
+        (cnt,) = s.api.query("q", "Count(Row(f=5))")
+        assert cnt == 3, s.node.id
+    (pairs,) = trio[2].api.query("q", "TopN(f, n=2)")
+    assert [(p.id, p.count) for p in pairs] == [(5, 3), (9, 1)]
+
+
+def test_distributed_keys(trio):
+    api = trio[0].api
+    api.create_index("keyed", keys=True)
+    api.create_field("keyed", "color", {"type": "set", "keys": True})
+    api.query("keyed", 'Set("alice", color="red")')
+    api.query("keyed", 'Set("bob", color="red")')
+    (row,) = trio[1].api.query("keyed", 'Row(color="red")')
+    # node1 did not translate: key data lives on the coordinator's stores…
+    # …but the query was driven through node1's executor with node1's stores.
+    # Each node owns its own translation (static mesh: same writes reach all
+    # nodes' stores through the routed Set calls only when node owns shard).
+    assert row.count() == 2
+
+
+def test_distributed_bsi_sum(trio):
+    api = trio[0].api
+    api.create_index("bsi")
+    api.create_field("bsi", "amount", {"type": "int", "min": 0, "max": 100000})
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    cols = [5, SHARD_WIDTH + 9, 3 * SHARD_WIDTH + 2]
+    vals = [100, 250, 37]
+    api.import_values("bsi", "amount", cols, vals)
+    (vc,) = trio[2].api.query("bsi", "Sum(field=amount)")
+    assert (vc.value, vc.count) == (387, 3)
+    (row,) = trio[1].api.query("bsi", "Row(amount > 99)")
+    assert row.count() == 2
+
+
+def test_query_failover_after_node_down():
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    with ClusterHarness(3, replica_n=2, in_memory=True) as c:
+        api = c[0].api
+        api.create_index("ha")
+        api.create_field("ha", "f", {"type": "set"})
+        cols = [(i % 20) * SHARD_WIDTH + i for i in range(200)]
+        api.import_bits("ha", "f", [0] * len(cols), cols)
+        (cnt,) = api.query("ha", "Count(Row(f=0))")
+        assert cnt == 200
+
+        c.stop_node(2)  # fault injection: hard-stop a replica-owning node
+        (cnt,) = c[0].api.query("ha", "Count(Row(f=0))")
+        assert cnt == 200
+        (cnt,) = c[1].api.query("ha", "Count(Row(f=0))")
+        assert cnt == 200
+
+
+def test_anti_entropy_repairs_drift():
+    with ClusterHarness(2, replica_n=2, in_memory=True) as c:
+        api = c[0].api
+        api.create_index("ae")
+        api.create_field("ae", "f", {"type": "set"})
+        api.import_bits("ae", "f", [0, 0, 1], [1, 2, 3])
+
+        # inject drift: silently add a bit on node1 only (local_only import)
+        c[1].api.import_bits("ae", "f", [0], [999], local_only=True)
+        n0 = c[0].api.query("ae", "Count(Row(f=0))", remote=True)[0]
+        n1 = c[1].api.query("ae", "Count(Row(f=0))", remote=True)[0]
+        assert (n0, n1) == (2, 3)
+
+        # both nodes run their primary-driven sync pass
+        c[0].sync_holder()
+        c[1].sync_holder()
+        n0 = c[0].api.query("ae", "Count(Row(f=0))", remote=True)[0]
+        n1 = c[1].api.query("ae", "Count(Row(f=0))", remote=True)[0]
+        # majority of 2 replicas = 1 vote -> union: both converge to 3
+        assert (n0, n1) == (3, 3)
+
+
+def test_probe_peers_marks_down():
+    with ClusterHarness(2, in_memory=True) as c:
+        assert c[0].probe_peers() == {"node0": True, "node1": True}
+        c.stop_node(1)
+        alive = c[0].probe_peers()
+        assert alive["node1"] is False
+        assert c[0].cluster.node_by_id("node1").state == "DOWN"
+
+
+def test_resize_add_node():
+    from pilosa_tpu.cluster.topology import Node
+    from pilosa_tpu.server.node import NodeServer
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    with ClusterHarness(2, replica_n=1, in_memory=True) as c:
+        api = c[0].api
+        api.create_index("grow")
+        api.create_field("grow", "f", {"type": "set"})
+        cols = [(i % 16) * SHARD_WIDTH + i for i in range(160)]
+        api.import_bits("grow", "f", [0] * len(cols), cols)
+
+        # boot a third node and stream its fragments over
+        n2 = NodeServer(None, "node2").start()
+        try:
+            members = [
+                Node(id=s.node.id, uri=s.node.uri) for s in [c[0], c[1], n2]
+            ]
+            # new node needs the schema before it can receive fragments
+            n2.api.apply_schema(c[0].api.schema())
+            old_members = [Node(id=s.node.id, uri=s.node.uri) for s in [c[0], c[1]]]
+            fetched = n2.resize_to(members, old_nodes=old_members)
+            assert fetched > 0
+            c[0].resize_to(members)
+            c[1].resize_to(members)
+            # announce availability to the new node by re-syncing topology
+            for s in [c[0], c[1], n2]:
+                (cnt,) = s.api.query("grow", "Count(Row(f=0))")
+                assert cnt == 160, s.node.id
+        finally:
+            n2.stop()
