@@ -1,0 +1,3 @@
+"""CLI package (reference: /root/reference/cmd/ + ctl/)."""
+
+from pilosa_tpu.cli.main import main  # noqa: F401

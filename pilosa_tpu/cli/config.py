@@ -1,0 +1,175 @@
+"""Config schema + TOML/env/flag merge.
+
+Reference: /root/reference/server/config.go:48-157 (the TOML schema) and
+cmd/root.go:94-131 setAllConfig — precedence flags > env (PILOSA_*) > TOML
+file > defaults. Same precedence here with the PILOSA_TPU_ env prefix.
+`pilosa-tpu config` dumps the effective TOML (ctl/config.go);
+`generate-config` emits defaults (ctl/generate_config.go:41)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+@dataclass
+class ClusterConfig:
+    # static membership: list of "node_id@http://host:port" entries; empty
+    # means single-node (reference: cluster.hosts + disabled)
+    hosts: List[str] = field(default_factory=list)
+    replicas: int = 1
+    coordinator: bool = False
+
+
+@dataclass
+class AntiEntropyConfig:
+    interval: float = 0.0  # seconds; 0 disables the loop
+
+
+@dataclass
+class MetricConfig:
+    service: str = "none"  # none | expvar | prometheus
+    poll_interval: float = 30.0
+
+
+@dataclass
+class TracingConfig:
+    enabled: bool = False
+    sample_rate: float = 1.0
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa-tpu"
+    bind: str = "localhost:10101"
+    node_id: str = ""  # default: derived from bind
+    log_path: str = ""  # empty = stderr
+    verbose: bool = False
+    long_query_time: float = 0.0  # seconds; 0 disables slow-query logging
+    max_writes_per_request: int = 5000
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    metric: MetricConfig = field(default_factory=MetricConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+
+    # -- sources -----------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        path: Optional[str] = None,
+        env: Optional[dict] = None,
+        overrides: Optional[dict] = None,
+    ) -> "Config":
+        """defaults <- TOML file <- PILOSA_TPU_* env <- explicit overrides."""
+        cfg = cls()
+        if path:
+            with open(path, "rb") as f:
+                cfg._apply_dict(tomllib.load(f))
+        cfg._apply_env(env if env is not None else os.environ)
+        if overrides:
+            cfg._apply_dict(overrides)
+        return cfg
+
+    def _apply_dict(self, d: dict) -> None:
+        for k, v in d.items():
+            k = k.replace("-", "_")
+            if not hasattr(self, k):
+                continue
+            cur = getattr(self, k)
+            if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+                for k2, v2 in v.items():
+                    k2 = k2.replace("-", "_")
+                    if hasattr(cur, k2):
+                        setattr(cur, k2, _coerce(getattr(cur, k2), v2))
+            else:
+                setattr(self, k, _coerce(cur, v))
+
+    def _apply_env(self, env: dict) -> None:
+        for name, raw in env.items():
+            if not name.startswith(ENV_PREFIX):
+                continue
+            parts = name[len(ENV_PREFIX):].lower().split("__")
+            try:
+                if len(parts) == 1:
+                    cur = getattr(self, parts[0])
+                    setattr(self, parts[0], _coerce(cur, raw))
+                elif len(parts) == 2:
+                    sect = getattr(self, parts[0])
+                    cur = getattr(sect, parts[1])
+                    setattr(sect, parts[1], _coerce(cur, raw))
+            except AttributeError:
+                continue
+
+    # -- dump --------------------------------------------------------------
+
+    def to_toml(self) -> str:
+        out = []
+        flat = {
+            "data-dir": self.data_dir,
+            "bind": self.bind,
+            "node-id": self.node_id,
+            "log-path": self.log_path,
+            "verbose": self.verbose,
+            "long-query-time": self.long_query_time,
+            "max-writes-per-request": self.max_writes_per_request,
+        }
+        for k, v in flat.items():
+            out.append(f"{k} = {_toml_value(v)}")
+        for sect_name, sect in (
+            ("cluster", self.cluster),
+            ("anti-entropy", self.anti_entropy),
+            ("metric", self.metric),
+            ("tracing", self.tracing),
+        ):
+            out.append(f"\n[{sect_name}]")
+            for f_ in dataclasses.fields(sect):
+                out.append(
+                    f"{f_.name.replace('_', '-')} = "
+                    f"{_toml_value(getattr(sect, f_.name))}"
+                )
+        return "\n".join(out) + "\n"
+
+
+def _coerce(current, value):
+    if isinstance(current, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(current, int) and not isinstance(current, bool):
+        return int(value)
+    if isinstance(current, float):
+        return float(value)
+    if isinstance(current, list):
+        if isinstance(value, str):
+            return [x.strip() for x in value.split(",") if x.strip()]
+        return list(value)
+    return value
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    return f'"{v}"'
+
+
+def parse_hosts(hosts: List[str]):
+    """'node_id@http://host:port' entries -> [(id, uri)]."""
+    out = []
+    for h in hosts:
+        if "@" in h:
+            nid, uri = h.split("@", 1)
+        else:
+            uri = h if h.startswith("http") else f"http://{h}"
+            nid = uri.split("//", 1)[-1].replace(":", "-")
+        out.append((nid, uri))
+    return out

@@ -1,0 +1,319 @@
+"""CLI: server / import / export / inspect / check / config subcommands.
+
+Reference: /root/reference/cmd/ (cobra tree: root.go:28, server.go:60) and
+ctl/ (ImportCommand csv pipeline ctl/import.go:82-392, ExportCommand
+ctl/export.go:53, CheckCommand offline integrity ctl/check.go:47-133,
+InspectCommand ctl/inspect.go:49, GenerateConfigCommand
+ctl/generate_config.go:41). argparse instead of cobra/viper; same surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import urllib.request
+from typing import List, Optional
+
+from pilosa_tpu.cli.config import Config, parse_hosts
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu", description="TPU-native distributed bitmap index"
+    )
+    p.add_argument("--config", "-c", help="path to TOML config file")
+    sub = p.add_subparsers(dest="command")
+
+    sp = sub.add_parser("server", help="run a cluster node")
+    sp.add_argument("--data-dir", "-d")
+    sp.add_argument("--bind", "-b")
+    sp.add_argument("--node-id")
+    sp.add_argument("--cluster-hosts", help="comma-separated id@uri entries")
+    sp.add_argument("--replicas", type=int)
+    sp.add_argument("--anti-entropy-interval", type=float)
+    sp.add_argument("--verbose", action="store_true", default=None)
+
+    ip = sub.add_parser("import", help="bulk-import CSV rows (row,col[,ts])")
+    ip.add_argument("--host", default="http://localhost:10101")
+    ip.add_argument("--index", "-i", required=True)
+    ip.add_argument("--field", "-f", required=True)
+    ip.add_argument("--batch-size", type=int, default=100_000)
+    ip.add_argument("--clear", action="store_true")
+    ip.add_argument("--create", action="store_true", help="create index/field")
+    ip.add_argument("--field-type", default="set")
+    ip.add_argument("--field-keys", action="store_true")
+    ip.add_argument("--index-keys", action="store_true")
+    ip.add_argument("paths", nargs="*", help="CSV files ('-' or empty = stdin)")
+
+    ep = sub.add_parser("export", help="export a field as CSV")
+    ep.add_argument("--host", default="http://localhost:10101")
+    ep.add_argument("--index", "-i", required=True)
+    ep.add_argument("--field", "-f", required=True)
+    ep.add_argument("--output", "-o", help="output path (default stdout)")
+
+    np_ = sub.add_parser("inspect", help="dump fragment info from a data dir")
+    np_.add_argument("data_dir")
+    np_.add_argument("--index")
+    np_.add_argument("--field")
+
+    cp = sub.add_parser("check", help="offline integrity check of data files")
+    cp.add_argument("paths", nargs="+", help=".snap / .wal files or data dirs")
+
+    sub.add_parser("config", help="print the effective configuration")
+    sub.add_parser("generate-config", help="print default configuration")
+    return p
+
+
+def _load_config(args) -> Config:
+    overrides = {}
+    for attr, key in (
+        ("data_dir", "data_dir"),
+        ("bind", "bind"),
+        ("node_id", "node_id"),
+        ("verbose", "verbose"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            overrides[key] = v
+    cluster = {}
+    if getattr(args, "cluster_hosts", None):
+        cluster["hosts"] = args.cluster_hosts
+    if getattr(args, "replicas", None) is not None:
+        cluster["replicas"] = args.replicas
+    if cluster:
+        overrides["cluster"] = cluster
+    if getattr(args, "anti_entropy_interval", None) is not None:
+        overrides["anti_entropy"] = {"interval": args.anti_entropy_interval}
+    return Config.load(path=args.config, overrides=overrides)
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+
+def cmd_server(cfg: Config, wait: bool = True):
+    from pilosa_tpu.cluster.topology import Node
+    from pilosa_tpu.server.node import NodeServer
+
+    data_dir = os.path.expanduser(cfg.data_dir) if cfg.data_dir else None
+    hosts = parse_hosts(cfg.cluster.hosts)
+    node_id = cfg.node_id
+    if not node_id:
+        # derive the same id parse_hosts would give this bind address, so a
+        # '--cluster-hosts host:port,...' entry naming us matches our id
+        my_uri = cfg.bind if cfg.bind.startswith("http") else f"http://{cfg.bind}"
+        matched = [nid for nid, uri in hosts if uri == my_uri]
+        node_id = matched[0] if matched else cfg.bind.replace(":", "-")
+    srv = NodeServer(
+        data_dir,
+        node_id,
+        bind=cfg.bind,
+        replica_n=cfg.cluster.replicas,
+        anti_entropy_interval=cfg.anti_entropy.interval,
+        logger=lambda m: print(m, file=sys.stderr),
+    )
+    srv.start()
+    if hosts:
+        members = [Node(id=nid, uri=uri) for nid, uri in hosts]
+        if not any(nid == node_id for nid, _ in hosts):
+            members.append(Node(id=node_id, uri=srv.node.uri))
+        members[0].is_coordinator = True
+        srv.set_topology(members, replica_n=cfg.cluster.replicas)
+    print(f"pilosa-tpu node {node_id} listening on {srv.node.uri}", file=sys.stderr)
+    if wait:
+        stop = []
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                signal.pause()
+        finally:
+            srv.stop()
+    return srv
+
+
+def _iter_csv_rows(paths: List[str]):
+    files = paths or ["-"]
+    for path in files:
+        fh = sys.stdin if path == "-" else open(path)
+        try:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(",")
+                if len(parts) < 2:
+                    raise ValueError(f"bad csv line: {line!r}")
+                yield parts[0], parts[1], (parts[2] if len(parts) > 2 else None)
+        finally:
+            if path != "-":
+                fh.close()
+
+
+def _post_json(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else {}
+
+
+def cmd_import(args) -> int:
+    def maybe_int(s):
+        try:
+            return int(s)
+        except ValueError:
+            return s  # string key
+
+    if args.create:
+        _post_json(
+            f"{args.host}/index/{args.index}",
+            {"options": {"keys": args.index_keys}},
+        )
+        _post_json(
+            f"{args.host}/index/{args.index}/field/{args.field}",
+            {"options": {"type": args.field_type, "keys": args.field_keys}},
+        )
+    batch_rows, batch_cols, batch_ts, n = [], [], [], 0
+    is_value = args.field_type == "int"
+
+    def flush():
+        nonlocal batch_rows, batch_cols, batch_ts
+        if not batch_cols:
+            return
+        if is_value:
+            _post_json(
+                f"{args.host}/index/{args.index}/field/{args.field}/import-value",
+                {"cols": batch_cols, "values": [int(r) for r in batch_rows]},
+            )
+        else:
+            body = {"rows": batch_rows, "cols": batch_cols}
+            if any(t is not None for t in batch_ts):
+                body["timestamps"] = batch_ts
+            if args.clear:
+                body["clear"] = True
+            _post_json(
+                f"{args.host}/index/{args.index}/field/{args.field}/import", body
+            )
+        batch_rows, batch_cols, batch_ts = [], [], []
+
+    for row, col, ts in _iter_csv_rows(args.paths):
+        batch_rows.append(maybe_int(row))
+        batch_cols.append(maybe_int(col))
+        batch_ts.append(ts)
+        n += 1
+        if len(batch_cols) >= args.batch_size:
+            flush()
+    flush()
+    print(f"imported {n} records", file=sys.stderr)
+    return 0
+
+
+def cmd_export(args) -> int:
+    url = f"{args.host}/export?index={args.index}&field={args.field}"
+    with urllib.request.urlopen(url, timeout=120) as resp:
+        data = resp.read()
+    if args.output:
+        with open(args.output, "wb") as f:
+            f.write(data)
+    else:
+        sys.stdout.write(data.decode())
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(args.data_dir).open()
+    try:
+        for idx in h.indexes():
+            if args.index and idx.name != args.index:
+                continue
+            for f in idx.fields(include_hidden=True):
+                if args.field and f.name != args.field:
+                    continue
+                for vname, v in f.views.items():
+                    for shard in sorted(v.fragments):
+                        frag = v.fragments[shard]
+                        rows, _ = frag.pairs()
+                        n_rows = len(frag.row_ids())
+                        print(
+                            f"{idx.name}/{f.name}/{vname}/shard={shard}: "
+                            f"rows={n_rows} bits={len(rows)} op_n={frag._op_n}"
+                        )
+    finally:
+        h.close()
+    return 0
+
+
+def cmd_check(paths: List[str]) -> int:
+    """Offline integrity check (reference: ctl/check.go:47-133)."""
+    from pilosa_tpu.core import wal as walmod
+
+    failed = 0
+    todo: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                todo.extend(
+                    os.path.join(root, fn)
+                    for fn in files
+                    if fn.endswith((".snap", ".wal"))
+                )
+        else:
+            todo.append(p)
+    for p in todo:
+        try:
+            if p.endswith(".snap"):
+                shard, n_bits, rows = walmod.read_snapshot(p)
+                total = sum(rb.count() for rb in rows.values())
+                print(f"{p}: ok shard={shard} rows={len(rows)} bits={total}")
+            elif p.endswith(".wal"):
+                n_ops, status, detail = walmod.check_wal(p)
+                if status == "corrupt":
+                    raise ValueError(f"{detail} (after {n_ops} valid ops)")
+                note = f" ({detail}, discarded on replay)" if status == "torn" else ""
+                print(f"{p}: ok ops={n_ops}{note}")
+            else:
+                print(f"{p}: skipped (unknown extension)")
+        except Exception as e:
+            print(f"{p}: CORRUPT: {e}")
+            failed += 1
+    return 1 if failed else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 2
+    if args.command == "server":
+        cmd_server(_load_config(args))
+        return 0
+    if args.command == "import":
+        return cmd_import(args)
+    if args.command == "export":
+        return cmd_export(args)
+    if args.command == "inspect":
+        return cmd_inspect(args)
+    if args.command == "check":
+        return cmd_check(args.paths)
+    if args.command == "config":
+        sys.stdout.write(_load_config(args).to_toml())
+        return 0
+    if args.command == "generate-config":
+        sys.stdout.write(Config().to_toml())
+        return 0
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

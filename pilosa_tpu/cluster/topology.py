@@ -225,6 +225,19 @@ class Cluster:
                     break
         return out
 
+    def shards_by_all_owners(
+        self, index: str, shards: Sequence[int]
+    ) -> Dict[str, List[int]]:
+        """Every live owner (replicas included) per shard — the WRITE
+        fan-out grouping (executor.go:2142 write replication), vs
+        shards_by_node's first-owner read grouping."""
+        out: Dict[str, List[int]] = {}
+        for s in shards:
+            for n in self.shard_nodes(index, s):
+                if n.state != NODE_STATE_DOWN:
+                    out.setdefault(n.id, []).append(s)
+        return out
+
     # -- resize math (cluster.go:784-870) ----------------------------------
 
     def frags_by_host(

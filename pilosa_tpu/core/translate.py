@@ -196,7 +196,14 @@ class TranslateStore:
         return key
 
     def keys_for_ids(self, ids: Sequence[int]) -> List[Optional[str]]:
-        return [self.key_for_id(i) for i in ids]
+        # catch up from the primary at most ONCE per batch, then serve the
+        # whole batch from the local map
+        if self.catchup_fn is not None and any(i not in self._by_id for i in ids):
+            try:
+                self.catchup_fn()
+            except Exception:
+                pass
+        return [self._by_id.get(i) for i in ids]
 
     def max_id(self) -> int:
         return self._next_id - 1

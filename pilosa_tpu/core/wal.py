@@ -118,6 +118,42 @@ class WalWriter:
 
 def replay_wal(path: str) -> Iterator[Tuple[int, np.ndarray]]:
     """Yield (op, positions) records; stops cleanly at a torn/corrupt tail."""
+    for op, positions in _walk_wal(path):
+        yield op, positions
+
+
+def check_wal(path: str) -> Tuple[int, str, str]:
+    """Integrity walk for `pilosa-tpu check`: returns (n_valid_ops, status,
+    detail). status is one of:
+    - "ok":   every byte is a valid record
+    - "torn": the tail is an INCOMPLETE record (short header or short
+              payload with a valid header) — the normal kill-9-mid-append
+              case the replay path tolerates by design
+    - "corrupt": a complete-looking record fails its magic or CRC check —
+              data damage replay would silently discard"""
+    n_ops = 0
+    pos = 0
+    for op, positions in _walk_wal(path):
+        n_ops += 1
+        pos += _REC_HDR.size + len(positions) * 8
+    size = os.path.getsize(path) if os.path.exists(path) else 0
+    rest = size - pos
+    if rest == 0:
+        return n_ops, "ok", ""
+    with open(path, "rb") as f:
+        f.seek(pos)
+        tail = f.read(_REC_HDR.size)
+    if len(tail) < _REC_HDR.size:
+        return n_ops, "torn", f"{rest}-byte partial header at tail"
+    magic, op, n, crc = _REC_HDR.unpack(tail)
+    if magic != WAL_MAGIC:
+        return n_ops, "corrupt", f"bad record magic at offset {pos}"
+    if rest < _REC_HDR.size + n * 8:
+        return n_ops, "torn", f"partial payload at tail ({rest} bytes)"
+    return n_ops, "corrupt", f"CRC mismatch at offset {pos}"
+
+
+def _walk_wal(path: str) -> Iterator[Tuple[int, np.ndarray]]:
     if not os.path.exists(path):
         return
     with open(path, "rb") as f:

@@ -74,14 +74,18 @@ class DistributedExecutor(Executor):
         return n.uri
 
     def _fan_out(
-        self, idx: Index, c: Call, shards: Optional[Sequence[int]]
+        self, idx: Index, c: Call, shards: Optional[Sequence[int]], write: bool = False
     ) -> List[Any]:
-        """Run call `c` on every owner node over its shard subset; returns
-        the list of partial results (local partial included). Failed nodes'
-        shards are re-mapped to surviving replicas (executor.go:2497)."""
+        """Run call `c` over the cluster's shards; returns the list of
+        partial results (local partial included). Reads go to the first
+        live owner per shard with failover re-mapping (executor.go:2497);
+        writes go to EVERY live replica owner (executor.go:2142)."""
         cluster = self._cluster()
         all_shards = self._shards_for(idx, shards, c)
-        remaining = dict(cluster.shards_by_node(idx.name, all_shards))
+        if write:
+            remaining = dict(cluster.shards_by_all_owners(idx.name, all_shards))
+        else:
+            remaining = dict(cluster.shards_by_node(idx.name, all_shards))
         partials: List[Any] = []
         failed: set = set()
         attempts = 0
@@ -95,6 +99,10 @@ class DistributedExecutor(Executor):
                     partials.append(self._node_partial(idx, c, node_id, node_shards))
                 except RemoteError:
                     failed.add(node_id)
+                    if write:
+                        # replicas already targeted; drift repairs via
+                        # anti-entropy rather than re-mapping
+                        continue
                     # re-map this node's shards to the next live replica
                     for s in node_shards:
                         owners = [
@@ -234,7 +242,9 @@ class DistributedExecutor(Executor):
         if name == "TopN":
             return self._execute_topn_distributed(idx, c, shards, opt)
         if name in self._FANOUT_CALLS:
-            partials = self._fan_out(idx, c, shards)
+            partials = self._fan_out(
+                idx, c, shards, write=name in ("ClearRow", "Store")
+            )
             return self._reduce(name, c, partials)
         return super()._execute_call(idx, c, shards, opt)
 

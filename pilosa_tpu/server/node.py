@@ -213,7 +213,12 @@ class NodeServer:
         for idx in self.holder.indexes():
             for f in idx.fields(include_hidden=True):
                 for vname, v in list(f.views.items()):
-                    for shard in sorted(v.fragments):
+                    # include shards known cluster-wide but absent locally:
+                    # a replica may hold a fragment the primary missed (e.g.
+                    # a write that partially failed) — the primary must pull
+                    # it, not skip it
+                    shards = set(v.fragments) | set(f.remote_available_shards)
+                    for shard in sorted(shards):
                         owners = self.cluster.shard_nodes(idx.name, shard)
                         if not owners or owners[0].id != self.node.id:
                             continue  # only the primary drives the sync
@@ -225,9 +230,8 @@ class NodeServer:
         return repaired
 
     def _sync_fragment(self, idx, f, view: str, shard: int, replicas) -> bool:
-        frag = f.views[view].fragment_if_exists(shard)
-        if frag is None:
-            return False
+        # materialize the local fragment if only replicas hold it
+        frag = f.views[view].fragment(shard)
         local_sums = frag.block_checksums()
         peer_sums = []
         live = []

@@ -1,0 +1,179 @@
+"""CLI + config tests (reference model: cmd/*_test.go, ctl/*_test.go)."""
+
+import io
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cli.config import Config, parse_hosts
+from pilosa_tpu.cli.main import cmd_check, main
+
+
+# ---------------------------------------------------------------------------
+# config precedence (cmd/root.go:94 setAllConfig)
+# ---------------------------------------------------------------------------
+
+
+def test_config_defaults():
+    cfg = Config()
+    assert cfg.bind == "localhost:10101"
+    assert cfg.cluster.replicas == 1
+
+
+def test_config_toml_env_flag_precedence(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        'bind = "localhost:7001"\nverbose = true\n'
+        "[cluster]\nreplicas = 3\n"
+        'hosts = ["n0@http://a:1", "n1@http://b:2"]\n'
+    )
+    cfg = Config.load(str(p), env={})
+    assert cfg.bind == "localhost:7001"
+    assert cfg.cluster.replicas == 3
+    assert cfg.verbose is True
+
+    # env overrides toml
+    cfg = Config.load(str(p), env={"PILOSA_TPU_BIND": "localhost:7002",
+                                   "PILOSA_TPU_CLUSTER__REPLICAS": "2"})
+    assert cfg.bind == "localhost:7002"
+    assert cfg.cluster.replicas == 2
+
+    # explicit overrides beat env
+    cfg = Config.load(
+        str(p),
+        env={"PILOSA_TPU_BIND": "localhost:7002"},
+        overrides={"bind": "localhost:7003"},
+    )
+    assert cfg.bind == "localhost:7003"
+
+
+def test_config_toml_roundtrip():
+    cfg = Config()
+    cfg.cluster.hosts = ["n0@http://a:1"]
+    dumped = cfg.to_toml()
+    import tomllib
+
+    parsed = tomllib.loads(dumped)
+    assert parsed["bind"] == cfg.bind
+    assert parsed["cluster"]["hosts"] == ["n0@http://a:1"]
+    assert parsed["anti-entropy"]["interval"] == 0.0
+
+
+def test_parse_hosts():
+    assert parse_hosts(["n0@http://a:1", "b:2"]) == [
+        ("n0", "http://a:1"),
+        ("b-2", "http://b:2"),
+    ]
+
+
+def test_generate_config_command(capsys):
+    assert main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    assert 'bind = "localhost:10101"' in out
+
+
+# ---------------------------------------------------------------------------
+# import/export/check against a live in-process server
+# ---------------------------------------------------------------------------
+
+
+def test_import_export_roundtrip(tmp_path, monkeypatch, capsys):
+    from pilosa_tpu.testing import ClusterHarness
+
+    csv = tmp_path / "bits.csv"
+    csv.write_text("".join(f"{i % 3},{i * 7}\n" for i in range(100)))
+
+    with ClusterHarness(1, in_memory=True) as c:
+        host = c[0].node.uri
+        assert (
+            main(
+                [
+                    "import", "--host", host, "-i", "imp", "-f", "f",
+                    "--create", str(csv),
+                ]
+            )
+            == 0
+        )
+        (cnt,) = c[0].api.query("imp", "Count(Row(f=0))")
+        assert cnt == 34
+
+        assert main(["export", "--host", host, "-i", "imp", "-f", "f"]) == 0
+        out_lines = [
+            l for l in capsys.readouterr().out.splitlines() if l.strip()
+        ]
+        assert len(out_lines) == 100
+        assert out_lines[0].split(",") == ["0", "0"]
+
+
+def test_import_int_field(tmp_path):
+    from pilosa_tpu.testing import ClusterHarness
+
+    csv = tmp_path / "vals.csv"
+    csv.write_text("100,1\n250,2\n37,3\n")
+    with ClusterHarness(1, in_memory=True) as c:
+        host = c[0].node.uri
+        assert (
+            main(
+                [
+                    "import", "--host", host, "-i", "vals", "-f", "amt",
+                    "--create", "--field-type", "int", str(csv),
+                ]
+            )
+            == 0
+        )
+        (vc,) = c[0].api.query("vals", "Sum(field=amt)")
+        assert (vc.value, vc.count) == (387, 3)
+
+
+def test_inspect_and_check(tmp_path, capsys):
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.exec.executor import Executor
+
+    d = str(tmp_path / "data")
+    h = Holder(d).open()
+    h.create_index("i").create_field("f", FieldOptions())
+    e = Executor(h)
+    e.execute("i", "Set(1, f=2) Set(9, f=2)")
+    h.close()
+
+    assert main(["inspect", d]) == 0
+    out = capsys.readouterr().out
+    assert "i/f/standard/shard=0" in out and "bits=2" in out
+
+    assert cmd_check([d]) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out
+
+    # corrupt a wal file -> check fails
+    import glob
+
+    wals = glob.glob(f"{d}/**/*.wal", recursive=True)
+    assert wals
+    # benign torn tail (crash mid-append) -> still ok
+    with open(wals[0], "ab") as f:
+        f.write(b"\x4c\x57")  # partial header
+    assert cmd_check([wals[0]]) == 0
+    out = capsys.readouterr().out
+    assert "partial header" in out
+    # real corruption (bad magic mid-file) -> fails
+    with open(wals[0], "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    assert cmd_check([wals[0]]) == 1
+
+
+def test_server_command_boots(tmp_path):
+    from pilosa_tpu.cli.main import cmd_server
+
+    cfg = Config()
+    cfg.data_dir = str(tmp_path / "node")
+    cfg.bind = "localhost:0"
+    srv = cmd_server(cfg, wait=False)
+    try:
+        with urllib.request.urlopen(f"{srv.node.uri}/status", timeout=5) as r:
+            status = json.loads(r.read())
+        assert status["state"] == "NORMAL"
+    finally:
+        srv.stop()

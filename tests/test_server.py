@@ -170,6 +170,28 @@ def test_query_failover_after_node_down():
         assert cnt == 200
 
 
+def test_clearrow_reaches_all_replicas():
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    with ClusterHarness(3, replica_n=2, in_memory=True) as c:
+        api = c[0].api
+        api.create_index("cr")
+        api.create_field("cr", "f", {"type": "set"})
+        cols = [s * SHARD_WIDTH + 7 for s in range(12)]
+        api.import_bits("cr", "f", [3] * len(cols), cols)
+        (cleared,) = api.query("cr", "ClearRow(f=3)")
+        assert cleared is True
+        # EVERY node's local copy must be empty (no replica kept the row)
+        for s in c.nodes:
+            (cnt,) = s.api.query("cr", "Count(Row(f=3))", remote=True)
+            assert cnt == 0, s.node.id
+        # …so anti-entropy cannot resurrect the cleared bits
+        for s in c.nodes:
+            s.sync_holder()
+        (cnt,) = c[1].api.query("cr", "Count(Row(f=3))")
+        assert cnt == 0
+
+
 def test_anti_entropy_repairs_drift():
     with ClusterHarness(2, replica_n=2, in_memory=True) as c:
         api = c[0].api
