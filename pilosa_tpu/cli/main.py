@@ -264,7 +264,7 @@ def cmd_check(paths: List[str]) -> int:
                 todo.extend(
                     os.path.join(root, fn)
                     for fn in files
-                    if fn.endswith((".snap", ".wal"))
+                    if fn.endswith((".snap", ".wal", ".bitmap", ".roaring"))
                 )
         else:
             todo.append(p)
@@ -280,6 +280,16 @@ def cmd_check(paths: List[str]) -> int:
                     raise ValueError(f"{detail} (after {n_ops} valid ops)")
                 note = f" ({detail}, discarded on replay)" if status == "torn" else ""
                 print(f"{p}: ok ops={n_ops}{note}")
+            elif p.endswith((".bitmap", ".roaring")):
+                # reference-format roaring files (ctl/check.go checks .bitmap)
+                from pilosa_tpu.core import roaring_io
+
+                with open(p, "rb") as fh:
+                    info = roaring_io.inspect(fh.read())
+                print(
+                    f"{p}: ok dialect={info['dialect']} bits={info['bit_count']} "
+                    f"max={info['max_position']}"
+                )
             else:
                 print(f"{p}: skipped (unknown extension)")
         except Exception as e:

@@ -1,0 +1,133 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+The reference's host hot paths are hand-optimized Go (unsafe pointers, mmap;
+e.g. roaring/roaring.go container serialization, container_stash.go). Here
+the equivalents are C++ built with g++ at first use (no pybind11 in the
+image; plain C ABI + ctypes). Every native entry point has a numpy fallback
+in pilosa_tpu/core/roaring_io.py that doubles as the differential oracle.
+
+Set PILOSA_TPU_NO_NATIVE=1 to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_DIR, "roaring_codec.cpp")
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_DIR, f"_roaring_codec_{digest}.so")
+    if not os.path.exists(so):
+        tmp = so + f".tmp.{os.getpid()}"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)  # atomic; concurrent builders converge
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.rr_decode.restype = ctypes.c_int
+    lib.rr_decode.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.rr_encode.restype = ctypes.c_int
+    lib.rr_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.rr_free.restype = None
+    lib.rr_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _lib_or_none() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        return None
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if not _TRIED:
+            _LIB = _build_and_load()
+            _TRIED = True
+    return _LIB
+
+
+def available() -> bool:
+    return _lib_or_none() is not None
+
+
+def roaring_decode(data: bytes) -> np.ndarray:
+    """Any roaring file -> sorted uint64 positions (native, numpy fallback)."""
+    lib = _lib_or_none()
+    if lib is None:
+        from pilosa_tpu.core import roaring_io
+
+        return roaring_io.decode(data)
+    out = ctypes.POINTER(ctypes.c_uint64)()
+    n = ctypes.c_size_t()
+    err = ctypes.create_string_buffer(256)
+    rc = lib.rr_decode(data, len(data), ctypes.byref(out), ctypes.byref(n), err, 256)
+    if rc != 0:
+        from pilosa_tpu.core.roaring_io import RoaringError
+
+        raise RoaringError(err.value.decode() or "native roaring decode failed")
+    try:
+        if n.value == 0:
+            return np.empty(0, dtype=np.uint64)
+        return np.ctypeslib.as_array(out, shape=(n.value,)).astype(np.uint64, copy=True)
+    finally:
+        lib.rr_free(out)
+
+
+def roaring_encode(positions: np.ndarray) -> bytes:
+    """Sorted uint64 positions -> pilosa-dialect bytes (native, numpy fallback)."""
+    positions = np.asarray(positions, dtype=np.uint64)
+    lib = _lib_or_none()
+    if lib is None:
+        from pilosa_tpu.core import roaring_io
+
+        return roaring_io.encode(positions)
+    if len(positions):
+        positions = np.unique(positions)  # C ABI requires sorted-unique
+    buf = np.ascontiguousarray(positions)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    rc = lib.rr_encode(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(buf),
+        ctypes.byref(out),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        raise MemoryError("native roaring encode failed")
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.rr_free(out)

@@ -1,0 +1,279 @@
+// Native roaring interchange codec (pilosa dialect + official read).
+//
+// Host-side equivalent of the reference's hand-optimized Go serialization
+// (reference: roaring/roaring.go WriteTo :1046, pilosa/official iterators
+// :1262/:1180, readOfficialHeader :5315; format spec docs/architecture.md).
+// The Python oracle for this code is pilosa_tpu/core/roaring_io.py; the two
+// are differentially tested against each other.
+//
+// Build: g++ -O3 -shared -fPIC -o _roaring_codec.so roaring_codec.cpp
+// C ABI only; loaded via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 12348;
+constexpr uint32_t kOfficialCookie = 12347;
+constexpr uint32_t kOfficialCookieNoRun = 12346;
+constexpr int kTypeArray = 1;
+constexpr int kTypeBitmap = 2;
+constexpr int kTypeRun = 3;
+constexpr size_t kArrayMaxSize = 4096;
+constexpr size_t kHeaderBaseSize = 8;
+
+uint16_t rd16(const uint8_t* p) {
+  uint16_t v;
+  std::memcpy(&v, p, 2);
+  return v;
+}
+uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+uint64_t rd64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+void wr16(std::vector<uint8_t>& b, uint16_t v) {
+  b.insert(b.end(), (uint8_t*)&v, (uint8_t*)&v + 2);
+}
+void wr32(std::vector<uint8_t>& b, uint32_t v) {
+  b.insert(b.end(), (uint8_t*)&v, (uint8_t*)&v + 4);
+}
+void wr64(std::vector<uint8_t>& b, uint64_t v) {
+  b.insert(b.end(), (uint8_t*)&v, (uint8_t*)&v + 8);
+}
+
+int fail(char* err, size_t errlen, const char* msg) {
+  if (err && errlen) std::snprintf(err, errlen, "%s", msg);
+  return 1;
+}
+
+// Decode one container's low-16 values into out (appending key<<16 | low).
+int decode_container(const uint8_t* data, size_t len, int ctype, size_t offset,
+                     size_t card, bool runs_as_last, uint64_t key_hi,
+                     std::vector<uint64_t>& out, char* err, size_t errlen,
+                     size_t* consumed) {
+  switch (ctype) {
+    case kTypeArray: {
+      if (offset + 2 * card > len) return fail(err, errlen, "array container overruns buffer");
+      for (size_t i = 0; i < card; i++) out.push_back(key_hi | rd16(data + offset + 2 * i));
+      *consumed = 2 * card;
+      return 0;
+    }
+    case kTypeBitmap: {
+      if (offset + 8192 > len) return fail(err, errlen, "bitmap container overruns buffer");
+      for (size_t w = 0; w < 1024; w++) {
+        uint64_t word = rd64(data + offset + 8 * w);
+        while (word) {
+          int bit = __builtin_ctzll(word);
+          out.push_back(key_hi | (uint64_t)(w * 64 + bit));
+          word &= word - 1;
+        }
+      }
+      *consumed = 8192;
+      return 0;
+    }
+    case kTypeRun: {
+      if (offset + 2 > len) return fail(err, errlen, "run container overruns buffer");
+      size_t n_runs = rd16(data + offset);
+      if (offset + 2 + 4 * n_runs > len) return fail(err, errlen, "run container overruns buffer");
+      for (size_t r = 0; r < n_runs; r++) {
+        uint32_t start = rd16(data + offset + 2 + 4 * r);
+        uint32_t second = rd16(data + offset + 2 + 4 * r + 2);
+        uint32_t last = runs_as_last ? second : start + second;
+        if (last < start || last > 0xFFFF) return fail(err, errlen, "invalid run bounds");
+        for (uint32_t v = start; v <= last; v++) out.push_back(key_hi | (uint64_t)v);
+      }
+      *consumed = 2 + 4 * n_runs;
+      return 0;
+    }
+  }
+  return fail(err, errlen, "unknown container type");
+}
+
+int decode_pilosa(const uint8_t* data, size_t len, std::vector<uint64_t>& out,
+                  char* err, size_t errlen) {
+  if (data[2] != 0) return fail(err, errlen, "unsupported roaring file version");
+  size_t n_keys = rd32(data + 4);
+  size_t hdr_end = kHeaderBaseSize + 12 * n_keys;
+  size_t off_end = hdr_end + 4 * n_keys;
+  if (off_end > len) return fail(err, errlen, "header overruns buffer");
+  uint64_t prev_key = 0;
+  for (size_t i = 0; i < n_keys; i++) {
+    const uint8_t* h = data + kHeaderBaseSize + 12 * i;
+    uint64_t key = rd64(h);
+    int ctype = rd16(h + 8);
+    size_t card = (size_t)rd16(h + 10) + 1;
+    if (i > 0 && key <= prev_key) return fail(err, errlen, "container keys not strictly increasing");
+    prev_key = key;
+    size_t offset = rd32(data + hdr_end + 4 * i);
+    size_t consumed = 0;
+    int rc = decode_container(data, len, ctype, offset, card, /*runs_as_last=*/true,
+                              key << 16, out, err, errlen, &consumed);
+    if (rc) return rc;
+  }
+  return 0;
+}
+
+int decode_official(const uint8_t* data, size_t len, std::vector<uint64_t>& out,
+                    char* err, size_t errlen) {
+  uint32_t cookie = rd32(data);
+  size_t pos = 4;
+  size_t n_keys;
+  std::vector<bool> is_run;
+  bool have_runs = false;
+  if (cookie == kOfficialCookieNoRun) {
+    if (len < 8) return fail(err, errlen, "buffer too small");
+    n_keys = rd32(data + pos);
+    pos += 4;
+  } else {
+    have_runs = true;
+    n_keys = (cookie >> 16) + 1;
+    size_t nbytes = (n_keys + 7) / 8;
+    if (pos + nbytes > len) return fail(err, errlen, "is-run bitmap overruns buffer");
+    is_run.resize(n_keys);
+    for (size_t i = 0; i < n_keys; i++)
+      is_run[i] = (data[pos + i / 8] >> (i % 8)) & 1;
+    pos += nbytes;
+  }
+  if (n_keys > (1u << 16)) return fail(err, errlen, "more than 2^16 containers");
+  size_t hdr = pos;
+  if (pos + 4 * n_keys > len) return fail(err, errlen, "key-cardinality header overruns buffer");
+  pos += 4 * n_keys;
+  size_t off_table = 0;
+  if (!have_runs) {
+    if (pos + 4 * n_keys > len) return fail(err, errlen, "offset table overruns buffer");
+    off_table = pos;
+    pos += 4 * n_keys;
+  }
+  for (size_t i = 0; i < n_keys; i++) {
+    uint64_t key = rd16(data + hdr + 4 * i);
+    size_t card = (size_t)rd16(data + hdr + 4 * i + 2) + 1;
+    int ctype;
+    if (have_runs && is_run[i]) ctype = kTypeRun;
+    else if (card <= kArrayMaxSize) ctype = kTypeArray;
+    else ctype = kTypeBitmap;
+    size_t offset = have_runs ? pos : (size_t)rd32(data + off_table + 4 * i);
+    size_t consumed = 0;
+    int rc = decode_container(data, len, ctype, offset, card, /*runs_as_last=*/false,
+                              key << 16, out, err, errlen, &consumed);
+    if (rc) return rc;
+    if (have_runs) pos = offset + consumed;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode any roaring file into malloc'd sorted uint64 positions.
+// Returns 0 on success; nonzero writes a message into err.
+int rr_decode(const uint8_t* data, size_t len, uint64_t** out_positions,
+              size_t* out_n, char* err, size_t errlen) {
+  *out_positions = nullptr;
+  *out_n = 0;
+  if (len < 8) return fail(err, errlen, "buffer too small");
+  uint32_t cookie = rd32(data);
+  std::vector<uint64_t> out;
+  int rc;
+  if ((cookie & 0xFFFF) == kMagic) rc = decode_pilosa(data, len, out, err, errlen);
+  else if (cookie == kOfficialCookieNoRun || (cookie & 0xFFFF) == kOfficialCookie)
+    rc = decode_official(data, len, out, err, errlen);
+  else return fail(err, errlen, "unknown roaring cookie");
+  if (rc) return rc;
+  uint64_t* buf = (uint64_t*)std::malloc(out.size() * 8 + 8);
+  if (!buf) return fail(err, errlen, "out of memory");
+  std::memcpy(buf, out.data(), out.size() * 8);
+  *out_positions = buf;
+  *out_n = out.size();
+  return 0;
+}
+
+// Encode sorted, deduplicated uint64 positions into a pilosa-dialect file.
+int rr_encode(const uint64_t* positions, size_t n, uint8_t** out, size_t* out_len) {
+  *out = nullptr;
+  *out_len = 0;
+  // Group by high-48 key.
+  struct Group { uint64_t key; size_t start, n; };
+  std::vector<Group> groups;
+  for (size_t i = 0; i < n;) {
+    uint64_t key = positions[i] >> 16;
+    size_t j = i;
+    while (j < n && (positions[j] >> 16) == key) j++;
+    groups.push_back({key, i, j - i});
+    i = j;
+  }
+  size_t n_keys = groups.size();
+  std::vector<uint8_t> desc, offs, payload;
+  size_t offset = kHeaderBaseSize + 16 * n_keys;
+  for (auto& g : groups) {
+    const uint64_t* p = positions + g.start;
+    // run analysis
+    size_t n_runs = 1;
+    for (size_t i = 1; i < g.n; i++)
+      if ((uint16_t)p[i] != (uint16_t)p[i - 1] + 1) n_runs++;
+    size_t size_run = 2 + 4 * n_runs;
+    size_t size_array = 2 * g.n;
+    int ctype;
+    std::vector<uint8_t> body;
+    if (size_run < size_array && size_run < 8192) {
+      ctype = kTypeRun;
+      wr16(body, (uint16_t)n_runs);
+      uint16_t start = (uint16_t)p[0], prev = (uint16_t)p[0];
+      for (size_t i = 1; i <= g.n; i++) {
+        uint16_t cur = (i < g.n) ? (uint16_t)p[i] : 0;
+        if (i == g.n || cur != (uint16_t)(prev + 1)) {
+          wr16(body, start);
+          wr16(body, prev);
+          start = cur;
+        }
+        prev = cur;
+      }
+    } else if (g.n <= kArrayMaxSize) {
+      ctype = kTypeArray;
+      body.reserve(2 * g.n);
+      for (size_t i = 0; i < g.n; i++) wr16(body, (uint16_t)p[i]);
+    } else {
+      ctype = kTypeBitmap;
+      body.assign(8192, 0);
+      for (size_t i = 0; i < g.n; i++) {
+        uint16_t low = (uint16_t)p[i];
+        body[low / 8] |= (uint8_t)(1u << (low % 8));
+      }
+    }
+    wr64(desc, g.key);
+    wr16(desc, (uint16_t)ctype);
+    wr16(desc, (uint16_t)(g.n - 1));
+    wr32(offs, (uint32_t)offset);
+    offset += body.size();
+    payload.insert(payload.end(), body.begin(), body.end());
+  }
+  std::vector<uint8_t> file;
+  file.reserve(offset);
+  wr16(file, (uint16_t)kMagic);
+  file.push_back(0);  // version
+  file.push_back(0);  // flags
+  wr32(file, (uint32_t)n_keys);
+  file.insert(file.end(), desc.begin(), desc.end());
+  file.insert(file.end(), offs.begin(), offs.end());
+  file.insert(file.end(), payload.begin(), payload.end());
+  uint8_t* buf = (uint8_t*)std::malloc(file.size() + 1);
+  if (!buf) return 1;
+  std::memcpy(buf, file.data(), file.size());
+  *out = buf;
+  *out_len = file.size();
+  return 0;
+}
+
+void rr_free(void* p) { std::free(p); }
+
+}  // extern "C"

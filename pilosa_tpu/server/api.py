@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import io
 import json
+import re
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -31,6 +32,17 @@ class ApiError(Exception):
     pass
 
 
+_VIEW_NAME_RE = re.compile(r"[a-z][a-z0-9_]{0,63}")
+
+
+def _validate_view_name(view: str) -> None:
+    """View names become path components (view.go naming: standard,
+    standard_YYYYMMDDHH, bsig_<field>); anything else is rejected so
+    caller-supplied names can't traverse out of the data directory."""
+    if not _VIEW_NAME_RE.fullmatch(view):
+        raise ApiError(f"invalid view name: {view!r}")
+
+
 class DisabledError(ApiError):
     """Operation not allowed in the current cluster state
     (reference: ErrClusterDoesNotOwnShard / apiMethodNotAllowedError)."""
@@ -41,7 +53,7 @@ class DisabledError(ApiError):
 # internal traffic.
 _WRITE_METHODS = {
     "create_index", "delete_index", "create_field", "delete_field",
-    "import_bits", "import_values", "apply_schema",
+    "import_bits", "import_values", "import_roaring", "apply_schema",
 }
 
 
@@ -286,6 +298,84 @@ class API:
                 )
         if not local_only:
             self._announce_shard(idx.name, f.name, shard)
+
+    def import_roaring(
+        self,
+        index: str,
+        field: str,
+        shard: int,
+        data: bytes,
+        clear: bool = False,
+        view: Optional[str] = None,
+        local_only: bool = False,
+    ) -> int:
+        """Zero-parse bulk ingest: a serialized roaring bitmap (pilosa
+        dialect or official spec, core/roaring_io.py) whose bit positions are
+        fragment positions row*SHARD_WIDTH + col%SHARD_WIDTH, unioned (or
+        cleared) in one batch and fanned out to every shard owner
+        (reference: api.go:368 ImportRoaring, fragment.go:2255).
+        Returns the max changed-bit count across the owners reached."""
+        from pilosa_tpu import native
+        from pilosa_tpu.core.field import VIEW_STANDARD
+
+        from pilosa_tpu.core.field import FIELD_TYPE_SET, FIELD_TYPE_TIME
+
+        self._validate("import_roaring", write=True)
+        idx, f = self._index_field(index, field)
+        if f.options.type not in (FIELD_TYPE_SET, FIELD_TYPE_TIME):
+            # the mutex one-row-per-column invariant and the BSI bit-plane
+            # layout both need the parsing import paths (api.go:386 applies
+            # the same restriction)
+            raise ApiError(
+                f"cannot import roaring into {f.options.type} field {field!r}"
+            )
+        view = view or VIEW_STANDARD
+        _validate_view_name(view)
+        changed = 0
+        owners = self.cluster.shard_nodes(idx.name, shard)
+        for n in [self.server.node] if local_only else owners:
+            if n.id == self.server.node.id:
+                positions = native.roaring_decode(data)
+                frag = f._view_create(view).fragment(shard)
+                if clear:
+                    _, local_changed = frag.import_positions(None, positions)
+                else:
+                    local_changed, _ = frag.import_positions(positions, None)
+                changed = max(changed, local_changed)
+                if len(positions) and not clear:
+                    cols = np.unique(positions % SHARD_WIDTH) + np.uint64(
+                        shard * SHARD_WIDTH
+                    )
+                    idx.track_columns(cols)
+            else:
+                changed = max(
+                    changed,
+                    self.server.client.import_roaring(
+                        n.uri, index, field, shard, data, clear=clear, view=view
+                    ),
+                )
+        if not local_only:
+            self._announce_shard(index, field, shard)
+        return changed
+
+    def export_roaring(
+        self, index: str, field: str, shard: int, view: Optional[str] = None
+    ) -> bytes:
+        """Serialize one fragment as a pilosa-dialect roaring file (the
+        interchange inverse of import_roaring)."""
+        from pilosa_tpu import native
+        from pilosa_tpu.core.field import VIEW_STANDARD
+
+        self._validate("export_roaring")
+        idx, f = self._index_field(index, field)
+        if view is not None:
+            _validate_view_name(view)
+        v = f.view(view or VIEW_STANDARD)
+        frag = v.fragment_if_exists(shard) if v is not None else None
+        if frag is None:
+            return native.roaring_encode(np.empty(0, dtype=np.uint64))
+        rows, cols = frag.pairs()
+        return native.roaring_encode(rows * np.uint64(SHARD_WIDTH) + cols)
 
     def _announce_shard(self, index: str, field: str, shard: int) -> None:
         """Tell every node the shard now exists so query fan-out covers it

@@ -149,6 +149,32 @@ class InternalClient:
             json.dumps(body).encode(),
         )
 
+    def import_roaring(
+        self,
+        uri: str,
+        index: str,
+        field: str,
+        shard: int,
+        data: bytes,
+        clear: bool = False,
+        view: Optional[str] = None,
+    ) -> int:
+        """Forward a serialized roaring bitmap to a shard owner; remote=1
+        stops the receiver re-fanning out (reference: http/client.go
+        ImportRoaring). Returns the owner's changed-bit count."""
+        params = ["remote=1"]
+        if clear:
+            params.append("clear=1")
+        if view:
+            params.append(f"view={view}")
+        resp = self._json(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import-roaring/{shard}?" + "&".join(params),
+            data,
+        )
+        return int((resp or {}).get("changed", 0))
+
     # -- fragment sync (http/client.go:842-933) ----------------------------
 
     def fragment_blocks(

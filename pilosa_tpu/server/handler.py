@@ -195,6 +195,34 @@ class Handler(BaseHTTPRequestHandler):
         self.api.import_values(index, field, cols, d.get("values", []))
         self._reply({})
 
+    @route(
+        "POST",
+        "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>[0-9]+)",
+    )
+    def post_import_roaring(self, index: str, field: str, shard: str):
+        """Zero-parse roaring ingest; body is a serialized roaring bitmap
+        (reference route: http/handler.go import-roaring)."""
+        changed = self.api.import_roaring(
+            index,
+            field,
+            int(shard),
+            self._body(),
+            clear=self.query.get("clear", "") in ("1", "true"),
+            view=self.query.get("view"),
+            local_only=self.query.get("remote", "") in ("1", "true"),
+        )
+        self._reply({"changed": changed})
+
+    @route(
+        "GET",
+        "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/export-roaring/(?P<shard>[0-9]+)",
+    )
+    def get_export_roaring(self, index: str, field: str, shard: str):
+        data = self.api.export_roaring(
+            index, field, int(shard), view=self.query.get("view")
+        )
+        self._reply(None, raw=data, content_type="application/octet-stream")
+
     @route("GET", "/export")
     def get_export(self):
         index = self.query["index"]

@@ -72,6 +72,12 @@ class NodeServer:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "NodeServer":
+        # Warm the native codec off the request path: the first call may
+        # compile the C++ extension (seconds), which must not land on an
+        # import-roaring request.
+        from pilosa_tpu import native
+
+        native.available()
         self.holder.open()
         from pilosa_tpu.server.handler import make_http_server
 

@@ -1,0 +1,197 @@
+"""Roaring interchange codec: round-trips, official format, native vs numpy.
+
+Differential strategy mirrors the reference's fuzz harness (roaring/fuzzer.go
+compares roaring against a naive position-set model): random position sets
+round-trip through every codec pairing, and the C++ codec is checked
+bit-for-bit against the numpy oracle.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.core import roaring_io
+
+
+def random_positions(rng, kind):
+    if kind == "empty":
+        return np.empty(0, dtype=np.uint64)
+    if kind == "sparse":
+        return np.unique(rng.integers(0, 1 << 40, size=rng.integers(1, 200), dtype=np.uint64))
+    if kind == "dense":  # forces bitmap containers
+        base = rng.integers(0, 1 << 30, dtype=np.uint64) << np.uint64(16)
+        lows = np.unique(rng.integers(0, 1 << 16, size=9000, dtype=np.uint64))
+        return base | lows
+    if kind == "runs":  # forces run containers
+        base = rng.integers(0, 1 << 20, dtype=np.uint64) << np.uint64(16)
+        out = []
+        cur = 0
+        for _ in range(10):
+            cur += int(rng.integers(1, 500))
+            ln = int(rng.integers(50, 400))
+            out.append(np.arange(cur, min(cur + ln, 1 << 16), dtype=np.uint64))
+            cur += ln
+        return base | np.unique(np.concatenate(out))
+    if kind == "multikey":
+        parts = [random_positions(rng, k) for k in ("sparse", "dense", "runs")]
+        return np.unique(np.concatenate(parts))
+    raise AssertionError(kind)
+
+
+KINDS = ["empty", "sparse", "dense", "runs", "multikey"]
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_python_round_trip(kind):
+    rng = np.random.default_rng(hash(kind) % 2**32)
+    pos = random_positions(rng, kind)
+    data = roaring_io.encode(pos)
+    got = roaring_io.decode(data)
+    np.testing.assert_array_equal(got, pos)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_native_matches_python(kind):
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    rng = np.random.default_rng(hash(kind) % 2**32 + 1)
+    pos = random_positions(rng, kind)
+    py_bytes = roaring_io.encode(pos)
+    nat_bytes = native.roaring_encode(pos)
+    assert py_bytes == nat_bytes  # byte-identical encoders
+    np.testing.assert_array_equal(native.roaring_decode(py_bytes), pos)
+    np.testing.assert_array_equal(roaring_io.decode(nat_bytes), pos)
+
+
+def test_fuzz_differential():
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        kind = KINDS[rng.integers(0, len(KINDS))]
+        pos = random_positions(rng, kind)
+        data = roaring_io.encode(pos)
+        np.testing.assert_array_equal(roaring_io.decode(data), pos)
+        if native.available():
+            assert native.roaring_encode(pos) == data
+            np.testing.assert_array_equal(native.roaring_decode(data), pos)
+
+
+def encode_official_norun(groups):
+    """Hand-rolled official RoaringFormatSpec (cookie 12346) writer."""
+    out = bytearray()
+    out += struct.pack("<II", roaring_io.OFFICIAL_COOKIE_NORUN, len(groups))
+    for key, lows in groups:
+        out += struct.pack("<HH", key, len(lows) - 1)
+    off = len(out) + 4 * len(groups)
+    payloads = []
+    for _, lows in groups:
+        if len(lows) <= roaring_io.ARRAY_MAX_SIZE:
+            payload = np.asarray(lows, dtype="<u2").tobytes()
+        else:
+            bits = np.zeros(1 << 16, dtype=np.uint8)
+            bits[np.asarray(lows)] = 1
+            payload = np.packbits(bits, bitorder="little").tobytes()
+        out += struct.pack("<I", off)
+        payloads.append(payload)
+        off += len(payload)
+    return bytes(out) + b"".join(payloads)
+
+
+def encode_official_runs(groups):
+    """Official cookie 12347: count in hi16, is-run bitset, (start,len) runs,
+    containers packed sequentially (the layout the reference's reader expects,
+    roaring.go:1180-1213)."""
+    n = len(groups)
+    out = bytearray()
+    out += struct.pack("<I", roaring_io.OFFICIAL_COOKIE | ((n - 1) << 16))
+    bitset = bytearray((n + 7) // 8)
+    for i, (_, _, is_run) in enumerate(groups):
+        if is_run:
+            bitset[i // 8] |= 1 << (i % 8)
+    out += bytes(bitset)
+    for key, lows, _ in groups:
+        out += struct.pack("<HH", key, len(lows) - 1)
+    for key, lows, is_run in groups:
+        lows = np.asarray(lows, dtype=np.int64)
+        if is_run:
+            brk = np.nonzero(np.diff(lows) != 1)[0]
+            starts = np.concatenate(([lows[0]], lows[brk + 1]))
+            lasts = np.concatenate((lows[brk], [lows[-1]]))
+            out += struct.pack("<H", len(starts))
+            for s, l in zip(starts, lasts):
+                out += struct.pack("<HH", int(s), int(l - s))  # (start, length)
+        elif len(lows) <= roaring_io.ARRAY_MAX_SIZE:
+            out += lows.astype("<u2").tobytes()
+        else:
+            bits = np.zeros(1 << 16, dtype=np.uint8)
+            bits[lows] = 1
+            out += np.packbits(bits, bitorder="little").tobytes()
+    return bytes(out)
+
+
+def test_official_norun_decode():
+    rng = np.random.default_rng(11)
+    dense = np.unique(rng.integers(0, 1 << 16, size=9000, dtype=np.uint64))
+    groups = [(3, np.array([1, 5, 9], dtype=np.uint64)), (7, dense)]
+    data = encode_official_norun(groups)
+    expect = np.concatenate([(np.uint64(k) << np.uint64(16)) | g for k, g in groups])
+    for decode in (roaring_io.decode, native.roaring_decode):
+        np.testing.assert_array_equal(decode(data), expect)
+
+
+def test_official_runs_decode():
+    run_lows = np.arange(100, 400, dtype=np.uint64)
+    arr_lows = np.array([2, 4, 6, 10000], dtype=np.uint64)
+    groups = [(1, arr_lows, False), (2, run_lows, True)]
+    data = encode_official_runs(groups)
+    expect = np.concatenate(
+        [(np.uint64(k) << np.uint64(16)) | g for k, g, _ in groups]
+    )
+    for decode in (roaring_io.decode, native.roaring_decode):
+        np.testing.assert_array_equal(decode(data), expect)
+
+
+def test_container_type_choice():
+    # sparse -> array, dense -> bitmap, contiguous -> run
+    arr = roaring_io.encode(np.arange(0, 100, 2, dtype=np.uint64))
+    assert struct.unpack_from("<H", arr, 16)[0] == roaring_io.TYPE_ARRAY
+    run = roaring_io.encode(np.arange(0, 5000, dtype=np.uint64))
+    assert struct.unpack_from("<H", run, 16)[0] == roaring_io.TYPE_RUN
+    rng = np.random.default_rng(3)
+    dense = np.unique(rng.integers(0, 1 << 16, size=20000, dtype=np.uint64))
+    assert len(dense) > 4096
+    bmp = roaring_io.encode(dense)
+    assert struct.unpack_from("<H", bmp, 16)[0] == roaring_io.TYPE_BITMAP
+
+
+def test_errors():
+    with pytest.raises(roaring_io.RoaringError):
+        roaring_io.decode(b"\x00" * 4)
+    with pytest.raises(roaring_io.RoaringError):
+        roaring_io.decode(struct.pack("<I", 9999) + b"\x00" * 8)
+    # truncated pilosa file: claims one container, no header
+    bad = struct.pack("<HBB", roaring_io.MAGIC, 0, 0) + struct.pack("<I", 5)
+    with pytest.raises(roaring_io.RoaringError):
+        roaring_io.decode(bad)
+    if native.available():
+        with pytest.raises(roaring_io.RoaringError):
+            native.roaring_decode(bad)
+
+
+def test_op_log_tail_ignored():
+    # bytes after the last container are the op log; decode must not choke
+    pos = np.array([1, 2, 3, 70000], dtype=np.uint64)
+    data = roaring_io.encode(pos) + b"\xde\xad\xbe\xef" * 10
+    np.testing.assert_array_equal(roaring_io.decode(data), pos)
+    if native.available():
+        np.testing.assert_array_equal(native.roaring_decode(data), pos)
+
+
+def test_inspect():
+    pos = np.array([0, 5, 100000], dtype=np.uint64)
+    info = roaring_io.inspect(roaring_io.encode(pos))
+    assert info["dialect"] == "pilosa"
+    assert info["bit_count"] == 3
+    assert info["max_position"] == 100000
+    assert info["container_count"] == 2

@@ -5,6 +5,7 @@ test.MustRunCluster (in-process nodes, real localhost HTTP), plus the
 clustertests fault-injection pattern (node kill -> query failover)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -254,3 +255,120 @@ def test_resize_add_node():
                 assert cnt == 160, s.node.id
         finally:
             n2.stop()
+
+
+# ---------------------------------------------------------------------------
+# roaring interchange over HTTP (api.go:368 ImportRoaring analog)
+# ---------------------------------------------------------------------------
+
+
+def test_import_export_roaring_http():
+    from pilosa_tpu.core import roaring_io
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        http_json("POST", f"{uri}/index/ri", {"options": {}})
+        http_json("POST", f"{uri}/index/ri/field/rf", {"options": {"type": "set"}})
+        # rows 0 and 3, various cols, shard 2
+        pos = np.array(
+            [0 * SHARD_WIDTH + 5, 0 * SHARD_WIDTH + 9, 3 * SHARD_WIDTH + 5],
+            dtype=np.uint64,
+        )
+        body = roaring_io.encode(pos)
+        r = http_json("POST", f"{uri}/index/ri/field/rf/import-roaring/2", body,
+                      ctype="application/octet-stream")
+        assert r["changed"] == 3
+        base = 2 * SHARD_WIDTH
+        r = http_json("POST", f"{uri}/index/ri/query", {"query": "Row(rf=0)"})
+        assert r["results"][0]["columns"] == [base + 5, base + 9]
+        r = http_json("POST", f"{uri}/index/ri/query", {"query": "Count(Row(rf=3))"})
+        assert r["results"] == [1]
+        # export round-trips
+        raw = http_json("GET", f"{uri}/index/ri/field/rf/export-roaring/2")
+        np.testing.assert_array_equal(roaring_io.decode(raw), pos)
+        # clear=1 removes bits
+        clear_body = roaring_io.encode(pos[:1])
+        http_json(
+            "POST",
+            f"{uri}/index/ri/field/rf/import-roaring/2?clear=1",
+            clear_body,
+            ctype="application/octet-stream",
+        )
+        r = http_json("POST", f"{uri}/index/ri/query", {"query": "Row(rf=0)"})
+        assert r["results"][0]["columns"] == [base + 9]
+
+
+def test_import_roaring_replicates(trio):
+    from pilosa_tpu.core import roaring_io
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    uri = trio[0].node.uri
+    http_json("POST", f"{uri}/index/rrep", {"options": {}})
+    http_json("POST", f"{uri}/index/rrep/field/rrf", {"options": {"type": "set"}})
+    pos = np.arange(100, dtype=np.uint64)  # row 0, cols 0..99, shard 7
+    body = roaring_io.encode(pos)
+    http_json("POST", f"{uri}/index/rrep/field/rrf/import-roaring/7", body,
+              ctype="application/octet-stream")
+    # both replicas hold the fragment locally
+    owners = trio[0].cluster.shard_nodes("rrep", 7)
+    held = 0
+    for srv in trio.nodes:
+        if srv.node.id not in {n.id for n in owners}:
+            continue
+        f = srv.holder.index("rrep").field("rrf")
+        v = f.view()
+        frag = v.fragment_if_exists(7) if v else None
+        if frag is not None and frag.row_count(0) == 100:
+            held += 1
+    assert held == len(owners) == 2
+    # and any node answers the query
+    for srv in trio.nodes:
+        r = http_json(
+            "POST", f"{srv.node.uri}/index/rrep/query",
+            {"query": "Count(Row(rrf=0))"},
+        )
+        assert r["results"] == [100]
+
+
+def test_import_roaring_rejects_mutex_and_int():
+    from pilosa_tpu.core import roaring_io
+
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        http_json("POST", f"{uri}/index/mi", {"options": {}})
+        http_json("POST", f"{uri}/index/mi/field/mf", {"options": {"type": "mutex"}})
+        http_json(
+            "POST", f"{uri}/index/mi/field/if",
+            {"options": {"type": "int", "min": 0, "max": 100}},
+        )
+        body = roaring_io.encode(np.array([1, 2], dtype=np.uint64))
+        for fname in ("mf", "if"):
+            try:
+                http_json(
+                    "POST", f"{uri}/index/mi/field/{fname}/import-roaring/0",
+                    body, ctype="application/octet-stream",
+                )
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+
+
+def test_import_roaring_rejects_bad_view_name():
+    from pilosa_tpu.core import roaring_io
+
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        http_json("POST", f"{uri}/index/vv", {"options": {}})
+        http_json("POST", f"{uri}/index/vv/field/vf", {"options": {"type": "set"}})
+        body = roaring_io.encode(np.array([1], dtype=np.uint64))
+        for bad in ("..%2F..%2Fpwn", "%2Ftmp%2Fpwn", "a%2Fb"):
+            try:
+                http_json(
+                    "POST",
+                    f"{uri}/index/vv/field/vf/import-roaring/0?view={bad}",
+                    body, ctype="application/octet-stream",
+                )
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
