@@ -5,12 +5,22 @@ a v5e-64. This single-chip bench runs the same query shape at 1B columns
 (954 shards x 2^20 cols) — the per-chip slice of the 64-chip target — as one
 fused device reduction (no CPU bitmap math on the query path).
 
+Measurement notes:
+- Each timed iteration XORs a fresh per-iteration salt into one operand, so
+  no dispatch/result cache (XLA or the hosted-TPU tunnel) can satisfy a
+  repeat execution without recomputing.
+- A batch of BATCH salted queries is dispatched per timed window and synced
+  once with a host read; per-query latency = window / BATCH. This amortizes
+  host<->device round-trip latency (the tunneled single-chip dev setup has
+  ~65 ms RTT that would otherwise swamp sub-ms device compute, and a real
+  deployment pipelines queries the same way).
+
 The reference publishes no absolute numbers (BASELINE.md: "published: {}"),
 so vs_baseline is measured on the spot: the same popcount(a & b) computed
-with vectorized numpy (16-bit LUT) on the host CPU — the reference's
-execution model (per-shard CPU bitmap math) with Python/HTTP overheads
-removed, i.e. a generous stand-in for the Go engine. vs_baseline = CPU p50 /
-TPU p50 (higher = faster than baseline).
+with vectorized numpy (16-bit LUT / AVX bitwise_count) on the host CPU — the
+reference's execution model (per-shard CPU bitmap math) with Python/HTTP
+overheads removed, i.e. a generous stand-in for the Go engine. vs_baseline =
+CPU per-query / TPU per-query (higher = faster than baseline).
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -21,11 +31,14 @@ import time
 
 import numpy as np
 
+BATCH = 16
+WINDOWS = 8
+
 
 def main():
     import jax
+    import jax.numpy as jnp
 
-    from pilosa_tpu.parallel.mesh import count_and_stacked
     from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 
     n_cols = 1_000_000_000
@@ -40,17 +53,29 @@ def main():
 
     a = jax.device_put(a_h)
     b = jax.device_put(b_h)
-    # warmup / compile
-    expect = int(count_and_stacked(a, b))
 
-    iters = 30
-    times = []
-    for _ in range(iters):
+    @jax.jit
+    def count_and_salted(a, b, salt):
+        x = jnp.bitwise_and(jnp.bitwise_xor(a, salt), b)
+        return jnp.sum(jax.lax.population_count(x), dtype=jnp.uint32)
+
+    # warmup / compile; salt=0 gives the unsalted ground truth
+    expect = int(count_and_salted(a, b, np.uint32(0)))
+
+    salt_i = 1
+    window_ms = []
+    for _ in range(WINDOWS):
         t0 = time.perf_counter()
-        out = count_and_stacked(a, b)
-        out.block_until_ready()
-        times.append((time.perf_counter() - t0) * 1000)
-    tpu_p50 = float(np.median(times))
+        acc = 0
+        outs = []
+        for _ in range(BATCH):
+            outs.append(count_and_salted(a, b, np.uint32(salt_i)))
+            salt_i += 1
+        acc = int(outs[-1])  # host read syncs the stream
+        t1 = time.perf_counter()
+        assert acc > 0
+        window_ms.append((t1 - t0) * 1000 / BATCH)
+    tpu_q = float(np.median(window_ms))
 
     # CPU comparator: vectorized numpy popcount over the same data.
     if hasattr(np, "bitwise_count"):
@@ -66,16 +91,16 @@ def main():
         t0 = time.perf_counter()
         got = cpu_count()
         cpu_times.append((time.perf_counter() - t0) * 1000)
-    cpu_p50 = float(np.median(cpu_times))
+    cpu_q = float(np.median(cpu_times))
     assert got == expect, (got, expect)
 
     print(
         json.dumps(
             {
-                "metric": "count_intersect_1b_cols_p50_ms",
-                "value": round(tpu_p50, 3),
+                "metric": "count_intersect_1b_cols_per_query_ms",
+                "value": round(tpu_q, 3),
                 "unit": "ms",
-                "vs_baseline": round(cpu_p50 / tpu_p50, 2),
+                "vs_baseline": round(cpu_q / tpu_q, 2),
             }
         )
     )

@@ -49,6 +49,10 @@ TYPE_RUN = 3
 
 ARRAY_MAX_SIZE = 4096  # reference: roaring/roaring.go:1940
 HEADER_BASE_SIZE = 8
+# Official spec: run-cookie files carry an offset header iff they have at
+# least this many containers. (The Go reference ignores it and misparses
+# such files — newOfficialRoaringIterator reads sequentially; we honor it.)
+NO_OFFSET_THRESHOLD = 4
 
 _U16 = np.dtype("<u2")
 _U32 = np.dtype("<u4")
@@ -152,8 +156,8 @@ def _decode_container(
         starts = pairs[0::2].astype(np.int64)
         seconds = pairs[1::2].astype(np.int64)
         lengths = (seconds - starts + 1) if runs_as_last else (seconds + 1)
-        if np.any(lengths <= 0):
-            raise RoaringError("negative-length run")
+        if np.any(lengths <= 0) or np.any(starts + lengths - 1 > 0xFFFF):
+            raise RoaringError("invalid run bounds")
         return _expand_runs(starts, lengths)
     raise RoaringError(f"unknown container type {ctype}")
 
@@ -181,8 +185,11 @@ def _decode_official(data: bytes) -> np.ndarray:
     keys = hdr[0::2].astype(np.uint64)
     cards = hdr[1::2].astype(np.int64) + 1
     offsets: Optional[np.ndarray] = None
-    if run_bitset is None:
-        # no-run dialect always carries an offset table
+    if run_bitset is None or n_keys >= NO_OFFSET_THRESHOLD:
+        # offset table present: always for the no-run dialect, and for the
+        # run dialect at >= NO_OFFSET_THRESHOLD containers (official spec)
+        if pos + 4 * n_keys > len(data):
+            raise RoaringError("offset table overruns buffer")
         offsets = np.frombuffer(data, dtype=_U32, count=n_keys, offset=pos).astype(np.int64)
         pos += 4 * n_keys
     out: List[np.ndarray] = []

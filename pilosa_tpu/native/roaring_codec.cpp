@@ -148,8 +148,12 @@ int decode_official(const uint8_t* data, size_t len, std::vector<uint64_t>& out,
   size_t hdr = pos;
   if (pos + 4 * n_keys > len) return fail(err, errlen, "key-cardinality header overruns buffer");
   pos += 4 * n_keys;
+  // Offset table: always for the no-run dialect, and for the run dialect at
+  // >= NO_OFFSET_THRESHOLD(4) containers (official spec; the Go reference
+  // reads those files sequentially and misparses them — we honor the table).
+  bool have_offsets = !have_runs || n_keys >= 4;
   size_t off_table = 0;
-  if (!have_runs) {
+  if (have_offsets) {
     if (pos + 4 * n_keys > len) return fail(err, errlen, "offset table overruns buffer");
     off_table = pos;
     pos += 4 * n_keys;
@@ -161,12 +165,12 @@ int decode_official(const uint8_t* data, size_t len, std::vector<uint64_t>& out,
     if (have_runs && is_run[i]) ctype = kTypeRun;
     else if (card <= kArrayMaxSize) ctype = kTypeArray;
     else ctype = kTypeBitmap;
-    size_t offset = have_runs ? pos : (size_t)rd32(data + off_table + 4 * i);
+    size_t offset = have_offsets ? (size_t)rd32(data + off_table + 4 * i) : pos;
     size_t consumed = 0;
     int rc = decode_container(data, len, ctype, offset, card, /*runs_as_last=*/false,
                               key << 16, out, err, errlen, &consumed);
     if (rc) return rc;
-    if (have_runs) pos = offset + consumed;
+    if (!have_offsets) pos = offset + consumed;
   }
   return 0;
 }

@@ -316,9 +316,11 @@ class API:
         (reference: api.go:368 ImportRoaring, fragment.go:2255).
         Returns the max changed-bit count across the owners reached."""
         from pilosa_tpu import native
-        from pilosa_tpu.core.field import VIEW_STANDARD
-
-        from pilosa_tpu.core.field import FIELD_TYPE_SET, FIELD_TYPE_TIME
+        from pilosa_tpu.core.field import (
+            FIELD_TYPE_SET,
+            FIELD_TYPE_TIME,
+            VIEW_STANDARD,
+        )
 
         self._validate("import_roaring", write=True)
         idx, f = self._index_field(index, field)

@@ -22,10 +22,24 @@ def force_cpu(n_devices: int = 8) -> None:
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
 
+    import dataclasses
+
     import jax
     from jax._src import xla_bridge as xb
 
-    for name in list(xb._backend_factories):
+    def _refuse(name):
+        def factory(*a, **kw):
+            raise RuntimeError(f"backend {name!r} disabled by force_cpu()")
+
+        return factory
+
+    for name, reg in list(xb._backend_factories.items()):
         if name != "cpu":
-            xb._backend_factories.pop(name)
+            # Keep the platform *registered* (known_platforms() must still
+            # list e.g. "tpu", or importing jax.experimental.pallas/checkify
+            # fails at lowering-rule registration) but make its factory
+            # refuse, so nothing can dial the TPU tunnel.
+            xb._backend_factories[name] = dataclasses.replace(
+                reg, factory=_refuse(name), fail_quietly=True
+            )
     jax.config.update("jax_platforms", "cpu")

@@ -99,9 +99,8 @@ def encode_official_norun(groups):
 
 
 def encode_official_runs(groups):
-    """Official cookie 12347: count in hi16, is-run bitset, (start,len) runs,
-    containers packed sequentially (the layout the reference's reader expects,
-    roaring.go:1180-1213)."""
+    """Official cookie 12347: count in hi16, is-run bitset, (start,len) runs;
+    offset table present iff >= NO_OFFSET_THRESHOLD containers (spec)."""
     n = len(groups)
     out = bytearray()
     out += struct.pack("<I", roaring_io.OFFICIAL_COOKIE | ((n - 1) << 16))
@@ -112,22 +111,29 @@ def encode_official_runs(groups):
     out += bytes(bitset)
     for key, lows, _ in groups:
         out += struct.pack("<HH", key, len(lows) - 1)
+    payloads = []
     for key, lows, is_run in groups:
         lows = np.asarray(lows, dtype=np.int64)
         if is_run:
             brk = np.nonzero(np.diff(lows) != 1)[0]
             starts = np.concatenate(([lows[0]], lows[brk + 1]))
             lasts = np.concatenate((lows[brk], [lows[-1]]))
-            out += struct.pack("<H", len(starts))
+            body = struct.pack("<H", len(starts))
             for s, l in zip(starts, lasts):
-                out += struct.pack("<HH", int(s), int(l - s))  # (start, length)
+                body += struct.pack("<HH", int(s), int(l - s))  # (start, length)
         elif len(lows) <= roaring_io.ARRAY_MAX_SIZE:
-            out += lows.astype("<u2").tobytes()
+            body = lows.astype("<u2").tobytes()
         else:
             bits = np.zeros(1 << 16, dtype=np.uint8)
             bits[lows] = 1
-            out += np.packbits(bits, bitorder="little").tobytes()
-    return bytes(out)
+            body = np.packbits(bits, bitorder="little").tobytes()
+        payloads.append(body)
+    if n >= roaring_io.NO_OFFSET_THRESHOLD:
+        off = len(out) + 4 * n
+        for body in payloads:
+            out += struct.pack("<I", off)
+            off += len(body)
+    return bytes(out) + b"".join(payloads)
 
 
 def test_official_norun_decode():
@@ -150,6 +156,36 @@ def test_official_runs_decode():
     )
     for decode in (roaring_io.decode, native.roaring_decode):
         np.testing.assert_array_equal(decode(data), expect)
+
+
+def test_official_runs_with_offset_table():
+    # >= 4 containers: spec-compliant files carry an offset header even in
+    # the run dialect; both decoders must honor it
+    groups = [
+        (1, np.array([2, 4, 6], dtype=np.uint64), False),
+        (2, np.arange(10, 500, dtype=np.uint64), True),
+        (5, np.array([100], dtype=np.uint64), False),
+        (9, np.arange(0, 65536, dtype=np.uint64), True),
+    ]
+    data = encode_official_runs(groups)
+    expect = np.concatenate(
+        [(np.uint64(k) << np.uint64(16)) | g for k, g, _ in groups]
+    )
+    for decode in (roaring_io.decode, native.roaring_decode):
+        np.testing.assert_array_equal(decode(data), expect)
+
+
+def test_run_bounds_rejected():
+    # official run (start=0xFFFC, length=10) overruns the 16-bit space:
+    # both codecs must reject rather than bleed into the next key
+    out = bytearray()
+    out += struct.pack("<I", roaring_io.OFFICIAL_COOKIE | (0 << 16))
+    out += bytes([0x01])  # is-run bitset: container 0 is a run
+    out += struct.pack("<HH", 0, 10)  # key 0, cardinality 11
+    out += struct.pack("<H", 1) + struct.pack("<HH", 0xFFFC, 10)
+    for decode in (roaring_io.decode, native.roaring_decode):
+        with pytest.raises(roaring_io.RoaringError):
+            decode(bytes(out))
 
 
 def test_container_type_choice():
