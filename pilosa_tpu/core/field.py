@@ -18,6 +18,12 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from pilosa_tpu.core import timeq
+from pilosa_tpu.core.cache import (  # single source of truth: core/cache.py
+    CACHE_TYPE_LRU,
+    CACHE_TYPE_NONE,
+    CACHE_TYPE_RANKED,
+    DEFAULT_CACHE_SIZE,
+)
 from pilosa_tpu.core.view import VIEW_BSI_PREFIX, VIEW_STANDARD, View
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -27,11 +33,14 @@ FIELD_TYPE_TIME = "time"
 FIELD_TYPE_MUTEX = "mutex"
 FIELD_TYPE_BOOL = "bool"
 
-CACHE_TYPE_RANKED = "ranked"
-CACHE_TYPE_LRU = "lru"
-CACHE_TYPE_NONE = "none"
-
-DEFAULT_CACHE_SIZE = 50_000  # reference: field.go:48
+FIELD_TYPES = (
+    FIELD_TYPE_SET,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_TIME,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_BOOL,
+)
+CACHE_TYPES = (CACHE_TYPE_RANKED, CACHE_TYPE_LRU, CACHE_TYPE_NONE)
 
 FALSE_ROW_ID = 0  # reference: falseRowID/trueRowID, fragment.go:86-87
 TRUE_ROW_ID = 1
@@ -102,6 +111,10 @@ class Field:
             None if path is None else os.path.join(path, ".keys.translate")
         )
 
+        if options.type not in FIELD_TYPES:
+            raise ValueError(f"invalid field type {options.type!r}")
+        if options.cache_type not in CACHE_TYPES:
+            raise ValueError(f"invalid cache type {options.cache_type!r}")
         if options.type == FIELD_TYPE_INT:
             if options.min == 0 and options.max == 0:
                 options.max = 2**31 - 1  # mirror of reference default range
@@ -171,8 +184,17 @@ class Field:
             v = self.views.get(name)
             if v is None:
                 is_mutex = self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL)
+                # BSI views hold bit planes, not rankable rows: no cache
+                # (the reference only caches standard/time views)
+                is_bsi = name.startswith(VIEW_BSI_PREFIX)
                 v = View(
-                    name, self.index, self.name, self._view_path(name), mutex=is_mutex
+                    name,
+                    self.index,
+                    self.name,
+                    self._view_path(name),
+                    mutex=is_mutex,
+                    cache_type=CACHE_TYPE_NONE if is_bsi else self.options.cache_type,
+                    cache_size=self.options.cache_size,
                 ).open()
                 self.views[name] = v
             return v

@@ -27,6 +27,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import jax
 import numpy as np
 
+from pilosa_tpu.core import cache as cachemod
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.rowstore import RowBits
 from pilosa_tpu.ops import bitmap as ob
@@ -59,6 +60,8 @@ class Fragment:
         *,
         mutex: bool = False,
         max_op_n: int = DEFAULT_MAX_OP_N,
+        cache_type: str = cachemod.CACHE_TYPE_RANKED,
+        cache_size: int = cachemod.DEFAULT_CACHE_SIZE,
     ):
         self.path = path  # None => purely in-memory (test harness)
         self.index = index
@@ -67,6 +70,8 @@ class Fragment:
         self.shard = shard
         self.mutex = mutex
         self.max_op_n = max_op_n
+        # row-rank cache for TopN (reference: fragment.go:131 f.cache)
+        self.cache = cachemod.make_cache(cache_type, cache_size)
 
         self._mu = threading.RLock()
         self._rows: Dict[int, RowBits] = {}
@@ -90,10 +95,15 @@ class Fragment:
     def wal_path(self) -> Optional[str]:
         return None if self.path is None else self.path + ".wal"
 
+    @property
+    def cache_path(self) -> Optional[str]:
+        return None if self.path is None else self.path + ".cache"
+
     def open(self) -> "Fragment":
         with self._mu:
             if self._open:
                 return self
+            replayed = 0
             if self.path is not None:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 if os.path.exists(self.snap_path):
@@ -110,9 +120,23 @@ class Fragment:
                         positions if op == walmod.OP_CLEAR else np.empty(0, np.uint64),
                     )
                     self._op_n += len(positions)
+                    replayed += 1
                 self._wal = walmod.WalWriter(self.wal_path)
             if self._mutex_map is not None:
                 self._rebuild_mutex_map()
+            if self.cache.cache_type != cachemod.CACHE_TYPE_NONE:
+                # The .cache sidecar is only trusted when no WAL ops were
+                # replayed: snapshot() and close() flush it, so replayed
+                # records mean mutations landed after the last flush and
+                # the sidecar is stale. Counts are exact host metadata, so
+                # the rebuild is always available.
+                loaded = (
+                    replayed == 0
+                    and self.cache_path is not None
+                    and cachemod.read_cache(self.cache_path, self.cache)
+                )
+                if not loaded and self._rows:
+                    self.recalculate_cache()
             self._open = True
             return self
 
@@ -121,8 +145,28 @@ class Fragment:
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
+            self.flush_cache()
             self._dev.clear()
             self._open = False
+
+    def flush_cache(self) -> None:
+        """Persist the rank cache sidecar (reference: holder.go:506
+        monitorCacheFlush ticker / cache.go:291 WriteTo)."""
+        with self._mu:
+            if (
+                self.cache_path is not None
+                and self.cache.cache_type != cachemod.CACHE_TYPE_NONE
+            ):
+                cachemod.write_cache(self.cache_path, self.cache)
+
+    def recalculate_cache(self) -> None:
+        """Rebuild the cache from exact per-row counts
+        (reference: api.go RecalculateCaches)."""
+        with self._mu:
+            self.cache.clear()
+            self.cache.bulk_add(
+                (row_id, rb.count()) for row_id, rb in self._rows.items()
+            )
 
     def _rebuild_mutex_map(self) -> None:
         self._mutex_map = {}
@@ -242,8 +286,10 @@ class Fragment:
     def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
         # The single mutation funnel: every write path (including WAL replay,
         # clears from Store/ClearRow, bulk clear imports) flows through here,
-        # so the mutex vector is maintained here and nowhere else.
+        # so the mutex vector and the rank cache are maintained here and
+        # nowhere else.
         n_set = n_clear = 0
+        touched = set()
         if len(to_set):
             rows = (to_set // SHARD_WIDTH).astype(np.int64)
             cols = (to_set % SHARD_WIDTH).astype(np.uint32)
@@ -253,6 +299,7 @@ class Fragment:
                     rb = self._rows[int(row_id)] = RowBits(SHARD_WIDTH)
                 row_cols = cols[rows == row_id]
                 n_set += rb.add(row_cols)
+                touched.add(int(row_id))
                 self._dev.pop(int(row_id), None)
                 if self._mutex_map is not None:
                     for c in row_cols:
@@ -265,11 +312,15 @@ class Fragment:
                 row_cols = cols[rows == row_id]
                 if rb is not None:
                     n_clear += rb.discard(row_cols)
+                    touched.add(int(row_id))
                     self._dev.pop(int(row_id), None)
                 if self._mutex_map is not None:
                     for c in row_cols:
                         if self._mutex_map.get(int(c)) == int(row_id):
                             del self._mutex_map[int(c)]
+        for row_id in touched:
+            rb = self._rows.get(row_id)
+            self.cache.add(row_id, rb.count() if rb is not None else 0)
         return n_set, n_clear
 
     def _wal_append(self, op: int, positions: np.ndarray) -> None:
@@ -626,3 +677,6 @@ class Fragment:
             if self._wal is not None:
                 self._wal.truncate()
             self._op_n = 0
+            # keep the sidecar in lockstep with the (now-empty) WAL: open()
+            # only trusts it when no WAL ops need replay
+            self.flush_cache()

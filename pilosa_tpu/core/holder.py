@@ -77,6 +77,26 @@ class Holder:
             if idx.path is not None:
                 shutil.rmtree(idx.path, ignore_errors=True)
 
+    def fragments(self):
+        """Every open fragment (indexes -> fields -> views -> fragments)."""
+        for idx in self.indexes():
+            for f in idx.fields(include_hidden=True):
+                for v in list(f.views.values()):
+                    for frag in list(v.fragments.values()):
+                        yield frag
+
+    def flush_caches(self) -> None:
+        """Persist every fragment's rank cache (reference: holder.go:506
+        monitorCacheFlush ticker)."""
+        for frag in self.fragments():
+            frag.flush_cache()
+
+    def recalculate_caches(self) -> None:
+        """Rebuild every fragment's rank cache from exact row counts
+        (reference: api.go RecalculateCaches / recalculate-caches message)."""
+        for frag in self.fragments():
+            frag.recalculate_cache()
+
     def schema(self) -> List[dict]:
         """Schema description (reference: holder Schema / http /schema)."""
         out = []

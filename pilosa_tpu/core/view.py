@@ -29,6 +29,8 @@ class View:
         *,
         mutex: bool = False,
         max_op_n: int = 10_000,
+        cache_type: str = "ranked",
+        cache_size: int = 50_000,
     ):
         self.name = name
         self.index = index
@@ -36,6 +38,8 @@ class View:
         self.path = path  # directory holding fragments/; None => in-memory
         self.mutex = mutex
         self.max_op_n = max_op_n
+        self.cache_type = cache_type
+        self.cache_size = cache_size
         self._mu = threading.RLock()
         self.fragments: Dict[int, Fragment] = {}
 
@@ -75,6 +79,8 @@ class View:
                     shard,
                     mutex=self.mutex,
                     max_op_n=self.max_op_n,
+                    cache_type=self.cache_type,
+                    cache_size=self.cache_size,
                 ).open()
                 self.fragments[shard] = frag
             return frag

@@ -765,7 +765,22 @@ class Executor:
         if ids:
             row_ids = [int(i) for i in ids]
         else:
-            row_ids = frag.row_ids()
+            # Candidate pool = the fragment's rank cache (the reference's
+            # approximation contract: rows evicted from the cache are not
+            # TopN candidates; fragment.go:1570 top reads f.cache.Top()).
+            # Cache counts are exact here (updated on every mutation), so
+            # the unfiltered path needs no device pass at all.
+            cached = frag.cache.top()
+            if src is None:
+                out = [
+                    Pair(id=rid, count=cnt)
+                    for rid, cnt in cached
+                    if cnt >= threshold
+                ]
+                if n and len(out) > n * 2:
+                    out = out[: n * 2]
+                return out
+            row_ids = [rid for rid, _ in cached]
         if not row_ids:
             return []
         counts = frag.row_counts(row_ids, src)

@@ -426,6 +426,13 @@ class API:
                 )
         return out.getvalue()
 
+    def recalculate_caches(self) -> None:
+        """Rebuild all rank caches cluster-wide
+        (reference: api.go:1307 RecalculateCaches + its broadcast)."""
+        self._validate("recalculate_caches")
+        self.holder.recalculate_caches()
+        self._broadcast({"type": "recalculate-caches"})
+
     # -- cluster info ------------------------------------------------------
 
     def status(self) -> dict:
@@ -490,7 +497,7 @@ class API:
         elif t == "node-state":
             self.server.set_node_state(msg["node"], msg["state"])
         elif t == "recalculate-caches":
-            pass  # caches recompute lazily
+            self.holder.recalculate_caches()
         else:
             raise ApiError(f"unknown cluster message type {t!r}")
         return {"ok": True}

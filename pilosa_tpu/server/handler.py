@@ -223,6 +223,11 @@ class Handler(BaseHTTPRequestHandler):
         )
         self._reply(None, raw=data, content_type="application/octet-stream")
 
+    @route("POST", "/recalculate-caches")
+    def post_recalculate_caches(self):
+        self.api.recalculate_caches()
+        self._reply({})
+
     @route("GET", "/export")
     def get_export(self):
         index = self.query["index"]

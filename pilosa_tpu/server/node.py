@@ -41,6 +41,7 @@ class NodeServer:
         hasher=None,
         cluster_name: str = "cluster0",
         anti_entropy_interval: float = 0.0,  # 0 = manual sync only
+        cache_flush_interval: float = 60.0,  # 0 = flush on close only
         logger=None,
     ):
         self.data_dir = data_dir
@@ -57,10 +58,12 @@ class NodeServer:
             self.holder, lambda: self.cluster, self.client, node_id
         )
         self.anti_entropy_interval = anti_entropy_interval
+        self.cache_flush_interval = cache_flush_interval
         self.logger = logger or (lambda msg: None)
         self._httpd = None
         self._http_thread = None
         self._ae_thread = None
+        self._cache_thread = None
         self._probe_thread = None
         self._closing = threading.Event()
         self._down_ids: set = set()
@@ -94,7 +97,21 @@ class NodeServer:
                 target=self._anti_entropy_loop, daemon=True
             )
             self._ae_thread.start()
+        if self.cache_flush_interval > 0 and self.data_dir is not None:
+            self._cache_thread = threading.Thread(
+                target=self._cache_flush_loop, daemon=True
+            )
+            self._cache_thread.start()
         return self
+
+    def _cache_flush_loop(self) -> None:
+        """Persist rank caches periodically (reference: holder.go:506
+        monitorCacheFlush, 1-minute ticker)."""
+        while not self._closing.wait(self.cache_flush_interval):
+            try:
+                self.holder.flush_caches()
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self.logger(f"cache flush: {e}")
 
     def stop(self) -> None:
         self._closing.set()

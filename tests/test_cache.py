@@ -1,0 +1,201 @@
+"""Rank-cache semantics + persistence + TopN integration.
+
+Reference test model: cache_test.go (ranked/lru bounds), fragment cache
+persistence (.cache files), api RecalculateCaches."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import cache as cachemod
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.field import FieldOptions
+
+
+def test_rank_cache_orders_and_bounds():
+    c = cachemod.RankCache(max_size=3)
+    c.bulk_add([(1, 10), (2, 30), (3, 20), (4, 5), (5, 40)])
+    assert [rid for rid, _ in c.top()] == [5, 2, 3]
+    assert len(c) == 3
+    # evicted row is gone even if re-queried
+    assert c.get(4) == 0
+    # count update reorders
+    c.add(3, 99)
+    assert c.top()[0] == (3, 99)
+    # zero count evicts
+    c.add(3, 0)
+    assert c.get(3) == 0
+
+
+def test_rank_cache_tie_break_lowest_id():
+    c = cachemod.RankCache()
+    c.bulk_add([(9, 7), (2, 7), (5, 7)])
+    assert [rid for rid, _ in c.top()] == [2, 5, 9]
+
+
+def test_lru_cache_evicts_oldest():
+    c = cachemod.LRUCache(max_size=2)
+    c.add(1, 10)
+    c.add(2, 20)
+    c.add(1, 11)  # touch 1
+    c.add(3, 30)  # evicts 2
+    assert c.get(2) == 0
+    assert sorted(c.ids()) == [1, 3]
+
+
+def test_no_cache_noop():
+    c = cachemod.make_cache("none")
+    c.add(1, 5)
+    assert c.top() == [] and len(c) == 0
+
+
+def test_cache_file_round_trip(tmp_path):
+    c = cachemod.RankCache()
+    c.bulk_add([(7, 70), (8, 80)])
+    p = str(tmp_path / "x.cache")
+    cachemod.write_cache(p, c)
+    c2 = cachemod.RankCache()
+    assert cachemod.read_cache(p, c2)
+    assert c2.top() == [(8, 80), (7, 70)]
+    # corrupt file is rejected, cache untouched
+    with open(p, "wb") as f:
+        f.write(b"garbage!")
+    c3 = cachemod.RankCache()
+    assert not cachemod.read_cache(p, c3)
+
+
+def test_fragment_maintains_cache(tmp_path):
+    frag = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0).open()
+    frag.bulk_import(np.array([1, 1, 1, 2], dtype=np.uint64),
+                     np.array([10, 11, 12, 10], dtype=np.uint64))
+    assert frag.cache.top() == [(1, 3), (2, 1)]
+    frag.clear_bit(1, 11)
+    assert frag.cache.get(1) == 2
+    # persists through close/reopen via the .cache sidecar
+    frag.close()
+    frag2 = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0).open()
+    assert frag2.cache.top() == [(1, 2), (2, 1)]
+    frag2.close()
+
+
+def test_fragment_cache_rebuilt_without_sidecar(tmp_path):
+    frag = Fragment(str(tmp_path / "1"), "i", "f", "standard", 1).open()
+    frag.bulk_import(np.array([5, 5], dtype=np.uint64),
+                     np.array([1, 2], dtype=np.uint64))
+    frag.close()
+    import os
+
+    os.remove(str(tmp_path / "1.cache"))
+    frag2 = Fragment(str(tmp_path / "1"), "i", "f", "standard", 1).open()
+    assert frag2.cache.top() == [(5, 2)]
+    frag2.close()
+
+
+def test_holder_recalculate_and_flush(tmp_path):
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i")
+    f = idx.create_field("f", FieldOptions(type="set"))
+    f.set_bit(3, 100)
+    f.set_bit(3, 200)
+    frag = f.view().fragment_if_exists(0)
+    frag.cache.clear()  # simulate drift
+    h.recalculate_caches()
+    assert frag.cache.top() == [(3, 2)]
+    h.flush_caches()
+    import os
+
+    assert os.path.exists(frag.cache_path)
+    h.close()
+
+
+def test_bsi_views_have_no_cache(tmp_path):
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i")
+    f = idx.create_field("v", FieldOptions(type="int", min=0, max=1000))
+    f.set_value(1, 42)
+    v = f.view(f.bsi_view_name())
+    frag = v.fragment_if_exists(0)
+    assert frag.cache.cache_type == "none"
+    h.close()
+
+
+@pytest.fixture()
+def topn_env():
+    from pilosa_tpu.testing import ClusterHarness
+
+    with ClusterHarness(1, in_memory=True) as c:
+        yield c[0]
+
+
+def test_topn_uses_cache_counts(topn_env):
+    srv = topn_env
+    srv.api.create_index("ti")
+    srv.api.create_field("ti", "tf", options={"type": "set", "cache_size": 100})
+    rows = np.repeat(np.arange(10, dtype=np.uint64), np.arange(1, 11))
+    cols = np.arange(len(rows), dtype=np.uint64)
+    srv.api.import_bits("ti", "tf", rows, cols)
+    res = srv.api.query("ti", "TopN(tf, n=3)")
+    pairs = res[0]
+    assert [(p.id, p.count) for p in pairs] == [(9, 10), (8, 9), (7, 8)]
+    # cache candidate pruning: evicted rows are not candidates
+    frag = srv.holder.index("ti").field("tf").view().fragment_if_exists(0)
+    assert frag is not None and len(frag.cache) == 10
+
+
+def test_topn_filtered_still_exact(topn_env):
+    srv = topn_env
+    srv.api.create_index("tj")
+    srv.api.create_field("tj", "tg", options={"type": "set"})
+    srv.api.create_field("tj", "filt", options={"type": "set"})
+    # row 1: cols 0..9 ; row 2: cols 0..4 ; filter row 0: cols 0..2
+    srv.api.import_bits("tj", "tg",
+                        np.concatenate([np.full(10, 1), np.full(5, 2)]).astype(np.uint64),
+                        np.concatenate([np.arange(10), np.arange(5)]).astype(np.uint64))
+    srv.api.import_bits("tj", "filt", np.zeros(3, dtype=np.uint64),
+                        np.arange(3, dtype=np.uint64))
+    res = srv.api.query("tj", "TopN(tg, Row(filt=0), n=2)")
+    assert [(p.id, p.count) for p in res[0]] == [(1, 3), (2, 3)]
+
+
+def test_stale_sidecar_ignored_after_wal_replay(tmp_path):
+    # sidecar flushed, then more WAL writes, then crash (no close-flush):
+    # reopen must not trust the stale sidecar
+    frag = Fragment(str(tmp_path / "2"), "i", "f", "standard", 2).open()
+    frag.set_bit(0, 5)
+    frag.flush_cache()
+    frag.set_bit(0, 6)
+    frag.set_bit(0, 7)
+    frag._wal.close()  # simulate crash: skip close()'s cache flush
+    frag._wal = None
+    frag2 = Fragment(str(tmp_path / "2"), "i", "f", "standard", 2).open()
+    assert frag2.cache.top() == [(0, 3)]
+    frag2.close()
+
+
+def test_lru_bulk_add_bounded():
+    c = cachemod.LRUCache(max_size=2)
+    c.bulk_add([(i, i + 1) for i in range(10)])
+    assert len(c) == 2
+
+
+def test_invalid_cache_type_rejected_at_creation(topn_env):
+    import urllib.error
+    import urllib.request
+    import json
+
+    uri = topn_env.node.uri
+    req = urllib.request.Request(
+        f"{uri}/index/badc", data=b"{}", method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+    body = json.dumps({"options": {"cacheType": "rankedd"}}).encode()
+    req = urllib.request.Request(
+        f"{uri}/index/badc/field/bf", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
