@@ -33,7 +33,9 @@ class AntiEntropyConfig:
 
 @dataclass
 class MetricConfig:
-    service: str = "none"  # none | expvar | prometheus
+    service: str = "expvar"  # none | expvar | prometheus | statsd
+    # (reference default: expvar, stats/stats.go:84; statsd selects the
+    # same scrape registry here — no UDP push daemon in this build)
     poll_interval: float = 30.0
 
 

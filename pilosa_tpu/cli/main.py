@@ -108,13 +108,19 @@ def cmd_server(cfg: Config, wait: bool = True):
         my_uri = cfg.bind if cfg.bind.startswith("http") else f"http://{cfg.bind}"
         matched = [nid for nid, uri in hosts if uri == my_uri]
         node_id = matched[0] if matched else cfg.bind.replace(":", "-")
+    from pilosa_tpu.utils.logger import new_logger
+
+    log_stream = open(cfg.log_path, "a") if cfg.log_path else None
     srv = NodeServer(
         data_dir,
         node_id,
         bind=cfg.bind,
         replica_n=cfg.cluster.replicas,
         anti_entropy_interval=cfg.anti_entropy.interval,
-        logger=lambda m: print(m, file=sys.stderr),
+        stats_service=cfg.metric.service,
+        metric_poll_interval=cfg.metric.poll_interval,
+        long_query_time=cfg.long_query_time,
+        logger=new_logger(verbose=cfg.verbose, stream=log_stream),
     )
     srv.start()
     if hosts:

@@ -102,10 +102,41 @@ class API:
         query: str,
         shards: Optional[Sequence[int]] = None,
         remote: bool = False,
+        headers: Optional[dict] = None,
     ) -> List[Any]:
+        """Execute PQL, with a trace span, per-query stats and slow-query
+        logging (reference: api.go:135 Query + executor spans
+        executor.go:113-115, LongQueryTime api.go:1157)."""
+        import time as _time
+
+        from pilosa_tpu.utils import tracing
+
         self._validate("query")
         opt = ExecOptions(remote=remote)
-        return self.server.executor.execute(index, query, shards=shards, opt=opt)
+        span = (
+            self.server.tracer.start_span_from_headers("api.query", headers)
+            if headers
+            else self.server.tracer.start_span("api.query")
+        )
+        t0 = _time.perf_counter()
+        with span:
+            span.set_tag("index", index)
+            span.set_tag("remote", remote)
+            try:
+                return self.server.executor.execute(
+                    index, query, shards=shards, opt=opt
+                )
+            finally:
+                dt = _time.perf_counter() - t0
+                stats = self.server.stats.with_tags(f"index:{index}")
+                stats.count("query_n")
+                stats.timing("query_ms", dt)
+                lqt = self.server.long_query_time
+                if lqt > 0 and dt > lqt:
+                    self.server.logger(
+                        f"slow query ({dt:.3f}s > {lqt:.3f}s) on {index!r}: "
+                        f"{query[:200]}"
+                    )
 
     # -- schema DDL (api.go:206-368) ---------------------------------------
 

@@ -18,6 +18,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pilosa_tpu.utils import tracing
+
 DEFAULT_TIMEOUT = 30.0
 
 
@@ -47,6 +49,12 @@ class InternalClient:
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        # propagate trace context to the peer (reference: http/client.go
+        # wraps every request with tracing.InjectHTTPHeaders)
+        span = tracing.current_span()
+        if span is not None and getattr(span, "trace_id", ""):
+            req.add_header(tracing.TRACE_HEADER, span.trace_id)
+            req.add_header(tracing.SPAN_HEADER, span.span_id)
         try:
             with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
                 return resp.read()

@@ -117,6 +117,23 @@ class Handler(BaseHTTPRequestHandler):
     def get_status(self):
         self._reply(self.api.status())
 
+    @route("GET", "/metrics")
+    def get_metrics(self):
+        """Prometheus exposition (reference: http/handler.go:282)."""
+        reg = getattr(self.node.stats, "registry", None)
+        text = reg.prometheus_text() if reg is not None else ""
+        self._reply(None, raw=text.encode(), content_type="text/plain; version=0.0.4")
+
+    @route("GET", "/debug/vars")
+    def get_debug_vars(self):
+        """expvar-style dump (reference: http/handler.go:281)."""
+        reg = getattr(self.node.stats, "registry", None)
+        self._reply(reg.snapshot() if reg is not None else {})
+
+    @route("GET", "/debug/traces")
+    def get_debug_traces(self):
+        self._reply(self.node.tracer.to_json())
+
     @route("GET", "/schema")
     def get_schema(self):
         self._reply({"indexes": self.api.schema()})
@@ -173,7 +190,7 @@ class Handler(BaseHTTPRequestHandler):
             pql = body.decode("utf-8")
             if "shards" in self.query:
                 shards = [int(s) for s in self.query["shards"].split(",")]
-        results = self.api.query(index, pql, shards=shards)
+        results = self.api.query(index, pql, shards=shards, headers=self.headers)
         self._reply({"results": [wire.result_to_public_json(r) for r in results]})
 
     @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
@@ -255,6 +272,7 @@ class Handler(BaseHTTPRequestHandler):
                 d.get("query", ""),
                 shards=d.get("shards"),
                 remote=d.get("remote", True),
+                headers=self.headers,
             )
         except (ExecError, ApiError) as e:
             self._reply({"error": str(e)})

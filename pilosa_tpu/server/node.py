@@ -14,6 +14,7 @@ streaming under a coordinator FSM (SURVEY.md hard-part #5)."""
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -42,6 +43,9 @@ class NodeServer:
         cluster_name: str = "cluster0",
         anti_entropy_interval: float = 0.0,  # 0 = manual sync only
         cache_flush_interval: float = 60.0,  # 0 = flush on close only
+        stats_service: str = "expvar",  # expvar|prometheus|statsd|none
+        metric_poll_interval: float = 0.0,  # 0 = no runtime poller
+        long_query_time: float = 0.0,  # seconds; 0 = disabled
         logger=None,
     ):
         self.data_dir = data_dir
@@ -59,11 +63,19 @@ class NodeServer:
         )
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
+        self.long_query_time = long_query_time
+        self.metric_poll_interval = metric_poll_interval
+        from pilosa_tpu.utils import stats as statsmod
+        from pilosa_tpu.utils import tracing as tracingmod
+
+        self.stats = statsmod.new_stats_client(stats_service)
+        self.tracer = tracingmod.global_tracer()
         self.logger = logger or (lambda msg: None)
         self._httpd = None
         self._http_thread = None
         self._ae_thread = None
         self._cache_thread = None
+        self._runtime_thread = None
         self._probe_thread = None
         self._closing = threading.Event()
         self._down_ids: set = set()
@@ -102,7 +114,32 @@ class NodeServer:
                 target=self._cache_flush_loop, daemon=True
             )
             self._cache_thread.start()
+        if self.metric_poll_interval > 0:
+            self._runtime_thread = threading.Thread(
+                target=self._runtime_poll_loop, daemon=True
+            )
+            self._runtime_thread.start()
         return self
+
+    def _runtime_poll_loop(self) -> None:
+        """Sample process runtime gauges (reference: server.go:813
+        monitorRuntime — goroutines/heap/GC/open-files)."""
+        import gc
+
+        import resource
+
+        while not self._closing.wait(self.metric_poll_interval):
+            try:
+                usage = resource.getrusage(resource.RUSAGE_SELF)
+                self.stats.gauge("runtime.max_rss_kb", usage.ru_maxrss)
+                self.stats.gauge("runtime.threads", threading.active_count())
+                self.stats.gauge("runtime.gc_objects", len(gc.get_objects()))
+                try:
+                    self.stats.gauge("runtime.open_files", len(os.listdir("/proc/self/fd")))
+                except OSError:
+                    pass
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self.logger(f"runtime poll: {e}")
 
     def _cache_flush_loop(self) -> None:
         """Persist rank caches periodically (reference: holder.go:506

@@ -1,0 +1,57 @@
+"""Logger interface: standard / verbose / nop.
+
+Reference: logger/logger.go — Printf/Debugf pair where Debugf is dropped
+unless verbose. Instances are callable (printf-style) so existing
+`self.logger(msg)` call sites keep working.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional, TextIO
+
+
+class Logger:
+    def __init__(self, stream: Optional[TextIO] = None, verbose: bool = False):
+        self.stream = stream if stream is not None else sys.stderr
+        self.verbose = verbose
+        self._mu = threading.Lock()
+
+    def _emit(self, msg: str, *args) -> None:
+        if args:
+            msg = msg % args
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with self._mu:
+            self.stream.write(f"{ts} {msg}\n")
+            self.stream.flush()
+
+    def printf(self, msg: str, *args) -> None:
+        self._emit(msg, *args)
+
+    def debugf(self, msg: str, *args) -> None:
+        if self.verbose:
+            self._emit(msg, *args)
+
+    __call__ = printf
+
+
+class NopLogger:
+    verbose = False
+
+    def printf(self, msg: str, *args) -> None:
+        pass
+
+    def debugf(self, msg: str, *args) -> None:
+        pass
+
+    def __call__(self, msg: str, *args) -> None:
+        pass
+
+
+NOP = NopLogger()
+
+
+def new_logger(verbose: bool = False, stream: Optional[TextIO] = None) -> Logger:
+    return Logger(stream=stream, verbose=verbose)

@@ -1,0 +1,212 @@
+"""Metrics: StatsClient interface + registry with expvar/prometheus views.
+
+Reference: stats/stats.go:31-64 StatsClient (Count/Gauge/Histogram/Set/
+Timing, WithTags child clients), chosen by config `metric.service`:
+expvar (default), prometheus (served at /metrics, prometheus/prometheus.go),
+statsd (DataDog, statsd/statsd.go), none. Tagged per-index/field children
+are used throughout the hot paths (fragment.go stats, executor.go:295).
+
+Here one thread-safe Registry backs every view: /debug/vars renders it as
+expvar-style JSON, /metrics renders prometheus text (no external push —
+statsd's UDP push model maps to "scrape the same registry"; requesting
+`statsd` selects the registry client too rather than dialing a daemon).
+`none` selects the no-op client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_HIST_KEEP = 512  # ring buffer per histogram/timing series
+
+
+def _key(name: str, tags: Tuple[str, ...]) -> Tuple[str, Tuple[str, ...]]:
+    return (name, tuple(sorted(tags)))
+
+
+class Registry:
+    """Tagged counters / gauges / histograms / sets, shared by all views."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[str, ...]], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
+        self._hists: Dict[Tuple[str, Tuple[str, ...]], List[float]] = defaultdict(list)
+        self._sets: Dict[Tuple[str, Tuple[str, ...]], set] = defaultdict(set)
+
+    def count(self, name, value, tags):
+        with self._mu:
+            self._counters[_key(name, tags)] += value
+
+    def gauge(self, name, value, tags):
+        with self._mu:
+            self._gauges[_key(name, tags)] = value
+
+    def observe(self, name, value, tags):
+        with self._mu:
+            h = self._hists[_key(name, tags)]
+            h.append(value)
+            if len(h) > _HIST_KEEP:
+                del h[: len(h) - _HIST_KEEP]
+
+    def add_to_set(self, name, value, tags):
+        with self._mu:
+            self._sets[_key(name, tags)].add(value)
+
+    # -- views -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """expvar-style JSON object (served at /debug/vars)."""
+
+        def fmt(k):
+            name, tags = k
+            return name if not tags else f"{name};{','.join(tags)}"
+
+        with self._mu:
+            out: dict = {}
+            for k, v in sorted(self._counters.items()):
+                out[fmt(k)] = v
+            for k, v in sorted(self._gauges.items()):
+                out[fmt(k)] = v
+            for k, vals in sorted(self._hists.items()):
+                if vals:
+                    s = sorted(vals)
+                    out[fmt(k)] = {
+                        "count": len(s),
+                        "min": s[0],
+                        "p50": s[len(s) // 2],
+                        "max": s[-1],
+                        "mean": sum(s) / len(s),
+                    }
+            for k, members in sorted(self._sets.items()):
+                out[fmt(k)] = len(members)
+            return out
+
+    def prometheus_text(self, prefix: str = "pilosa_tpu_") -> str:
+        """Prometheus exposition format (served at /metrics)."""
+
+        def sanitize(name):
+            return prefix + "".join(c if c.isalnum() else "_" for c in name)
+
+        def labels(tags):
+            if not tags:
+                return ""
+            pairs = []
+            for t in tags:
+                k, _, v = t.partition(":")
+                pairs.append(f'{k or "tag"}="{v or k}"')
+            return "{" + ",".join(pairs) + "}"
+
+        lines = []
+        with self._mu:
+            for (name, tags), v in sorted(self._counters.items()):
+                m = sanitize(name)
+                lines.append(f"# TYPE {m} counter")
+                lines.append(f"{m}{labels(tags)} {v}")
+            for (name, tags), v in sorted(self._gauges.items()):
+                m = sanitize(name)
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m}{labels(tags)} {v}")
+            for (name, tags), vals in sorted(self._hists.items()):
+                if not vals:
+                    continue
+                m = sanitize(name)
+                lines.append(f"# TYPE {m} summary")
+                lines.append(f"{m}_count{labels(tags)} {len(vals)}")
+                lines.append(f"{m}_sum{labels(tags)} {sum(vals)}")
+            for (name, tags), members in sorted(self._sets.items()):
+                m = sanitize(name)
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m}{labels(tags)} {len(members)}")
+        return "\n".join(lines) + "\n"
+
+
+class StatsClient:
+    """Registry-backed client (reference iface: stats/stats.go:31-64)."""
+
+    def __init__(self, registry: Optional[Registry] = None, tags: Iterable[str] = ()):
+        self.registry = registry or Registry()
+        self.tags: Tuple[str, ...] = tuple(tags)
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return StatsClient(self.registry, self.tags + tags)
+
+    def count(self, name: str, value: float = 1, rate: float = 1.0) -> None:
+        self.registry.count(name, value, self.tags)
+
+    def gauge(self, name: str, value: float) -> None:
+        self.registry.gauge(name, value, self.tags)
+
+    def histogram(self, name: str, value: float) -> None:
+        self.registry.observe(name, value, self.tags)
+
+    def set_value(self, name: str, value: str) -> None:
+        self.registry.add_to_set(name, value, self.tags)
+
+    def timing(self, name: str, seconds: float) -> None:
+        self.registry.observe(name, seconds * 1000.0, self.tags)
+
+    def timer(self, name: str):
+        """Context manager recording elapsed ms into a timing series."""
+        return _Timer(self, name)
+
+
+class _Timer:
+    def __init__(self, client: StatsClient, name: str):
+        self.client = client
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.client.timing(self.name, time.perf_counter() - self.t0)
+
+
+class NopStatsClient:
+    """metric.service = none."""
+
+    registry = None
+    tags: Tuple[str, ...] = ()
+
+    def with_tags(self, *tags: str) -> "NopStatsClient":
+        return self
+
+    def count(self, name, value=1, rate=1.0):
+        pass
+
+    def gauge(self, name, value):
+        pass
+
+    def histogram(self, name, value):
+        pass
+
+    def set_value(self, name, value):
+        pass
+
+    def timing(self, name, seconds):
+        pass
+
+    def timer(self, name):
+        return _NopTimer()
+
+
+class _NopTimer:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+def new_stats_client(service: str = "expvar"):
+    """reference: server/server.go:419 newStatsClient."""
+    if service in ("expvar", "prometheus", "statsd", ""):
+        return StatsClient()
+    if service in ("none", "nostats"):
+        return NopStatsClient()
+    raise ValueError(f"unknown metric service {service!r}")

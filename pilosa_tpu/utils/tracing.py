@@ -1,0 +1,169 @@
+"""Tracing facade: spans, context propagation over HTTP headers.
+
+Reference: tracing/tracing.go:23-72 — a global tracer with a nop default,
+spans started manually at executor/API/fragment entry points
+(executor.go:113, api.go:921), and HTTP header propagation between nodes
+(tracing/opentracing/opentracing.go:60 InjectHTTPHeaders, used by
+http/client.go).
+
+Default tracer records spans into a bounded in-memory ring (inspectable in
+tests and at /debug/traces); a nop tracer is available for zero overhead.
+Cross-node context rides the `X-Pilosa-Trace-Id` / `X-Pilosa-Span-Id`
+headers.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+# current span for the executing task/thread; entered spans install
+# themselves so nested spans and the internode client pick up the context
+_current: contextvars.ContextVar = contextvars.ContextVar("pilosa_span", default=None)
+
+
+def current_span():
+    return _current.get()
+
+TRACE_HEADER = "X-Pilosa-Trace-Id"
+SPAN_HEADER = "X-Pilosa-Span-Id"
+
+_RING = 1024
+
+
+class Span:
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id", "tags",
+                 "start", "duration", "_token")
+
+    def __init__(self, tracer, name, trace_id=None, parent_id=None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.tags: Dict[str, object] = {}
+        self.start = time.time()
+        self.duration: Optional[float] = None
+        self._token = None
+
+    def set_tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def finish(self) -> None:
+        if self.duration is None:
+            self.duration = time.time() - self.start
+            self.tracer._record(self)
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        self.finish()
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "start": self.start,
+            "durationMs": None if self.duration is None else self.duration * 1000,
+            "tags": dict(self.tags),
+        }
+
+
+class Tracer:
+    """In-memory ring-buffer tracer (the default)."""
+
+    def __init__(self, keep: int = _RING):
+        self.keep = keep
+        self._mu = threading.Lock()
+        self._spans: List[Span] = []
+
+    def start_span(self, name: str, parent: Optional[Span] = None) -> Span:
+        if parent is None:
+            parent = current_span()
+        if parent is not None and getattr(parent, "trace_id", ""):
+            return Span(self, name, trace_id=parent.trace_id, parent_id=parent.span_id)
+        return Span(self, name)
+
+    def start_span_from_headers(self, name: str, headers) -> Span:
+        trace_id = headers.get(TRACE_HEADER) if headers else None
+        parent_id = headers.get(SPAN_HEADER) if headers else None
+        s = Span(self, name, trace_id=trace_id or None, parent_id=parent_id or None)
+        return s
+
+    def _record(self, span: Span) -> None:
+        with self._mu:
+            self._spans.append(span)
+            if len(self._spans) > self.keep:
+                del self._spans[: len(self._spans) - self.keep]
+
+    def spans(self) -> List[Span]:
+        with self._mu:
+            return list(self._spans)
+
+    def to_json(self) -> List[dict]:
+        return [s.to_json() for s in self.spans()]
+
+
+class NopSpan:
+    trace_id = ""
+    span_id = ""
+
+    def set_tag(self, key, value):
+        return self
+
+    def finish(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+class NopTracer:
+    def start_span(self, name, parent=None):
+        return NopSpan()
+
+    def start_span_from_headers(self, name, headers):
+        return NopSpan()
+
+    def spans(self):
+        return []
+
+    def to_json(self):
+        return []
+
+
+def inject_http_headers(span, headers: dict) -> dict:
+    """Attach span context to an outgoing request's headers
+    (reference: opentracing.go:60)."""
+    if getattr(span, "trace_id", ""):
+        headers[TRACE_HEADER] = span.trace_id
+        headers[SPAN_HEADER] = span.span_id
+    return headers
+
+
+_global = Tracer()
+_global_lock = threading.Lock()
+
+
+def global_tracer():
+    return _global
+
+
+def set_global_tracer(tracer) -> None:
+    global _global
+    with _global_lock:
+        _global = tracer

@@ -1,0 +1,149 @@
+"""Aux subsystems: stats registry/views, logger, tracing propagation.
+
+Reference: stats/stats_test.go, logger/logger_test.go, tracing facade use in
+executor/api/client (spans at every level + HTTP header propagation)."""
+
+import io
+import json
+import urllib.request
+
+import numpy as np
+
+from pilosa_tpu.testing import ClusterHarness
+from pilosa_tpu.utils import logger as loggermod
+from pilosa_tpu.utils import stats as statsmod
+from pilosa_tpu.utils import tracing
+
+
+def http_json(method, url, body=None):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw and raw[:1] in (b"{", b"[") else raw
+
+
+# -- stats ------------------------------------------------------------------
+
+
+def test_stats_counts_gauges_tags():
+    c = statsmod.StatsClient()
+    c.count("queries")
+    c.count("queries", 2)
+    c.gauge("rows", 17)
+    tagged = c.with_tags("index:i1")
+    tagged.count("queries")
+    tagged.timing("latency", 0.25)
+    c.set_value("uniq", "a")
+    c.set_value("uniq", "a")
+    c.set_value("uniq", "b")
+    snap = c.registry.snapshot()
+    assert snap["queries"] == 3
+    assert snap["queries;index:i1"] == 1
+    assert snap["rows"] == 17
+    assert snap["uniq"] == 2
+    assert snap["latency;index:i1"]["count"] == 1
+    text = c.registry.prometheus_text()
+    assert "pilosa_tpu_queries 3" in text
+    assert 'pilosa_tpu_queries{index="i1"} 1' in text
+    assert "# TYPE pilosa_tpu_rows gauge" in text
+
+
+def test_stats_timer_and_nop():
+    c = statsmod.StatsClient()
+    with c.timer("op"):
+        pass
+    assert c.registry.snapshot()["op"]["count"] == 1
+    n = statsmod.new_stats_client("none")
+    n.count("x")
+    with n.timer("y"):
+        pass
+    assert n.with_tags("a") is n
+
+
+# -- logger -----------------------------------------------------------------
+
+
+def test_logger_verbose_gate():
+    buf = io.StringIO()
+    log = loggermod.new_logger(verbose=False, stream=buf)
+    log.printf("hello %s", "world")
+    log.debugf("secret")
+    log("callable form")
+    out = buf.getvalue()
+    assert "hello world" in out and "callable form" in out
+    assert "secret" not in out
+    vbuf = io.StringIO()
+    vlog = loggermod.new_logger(verbose=True, stream=vbuf)
+    vlog.debugf("visible")
+    assert "visible" in vbuf.getvalue()
+
+
+# -- tracing ----------------------------------------------------------------
+
+
+def test_span_nesting_and_context():
+    tr = tracing.Tracer()
+    with tr.start_span("outer") as outer:
+        assert tracing.current_span() is outer
+        with tr.start_span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tracing.current_span() is None
+    names = [s.name for s in tr.spans()]
+    assert names == ["inner", "outer"]
+    assert all(s.duration is not None for s in tr.spans())
+
+
+def test_header_injection_and_extraction():
+    tr = tracing.Tracer()
+    span = tr.start_span("client-side")
+    headers = tracing.inject_http_headers(span, {})
+    assert headers[tracing.TRACE_HEADER] == span.trace_id
+    server_span = tr.start_span_from_headers("server-side", headers)
+    assert server_span.trace_id == span.trace_id
+    assert server_span.parent_id == span.span_id
+
+
+# -- wired into the server ---------------------------------------------------
+
+
+def test_metrics_endpoints_and_cross_node_trace():
+    with ClusterHarness(2, replica_n=1, in_memory=True) as c:
+        uri = c[0].node.uri
+        http_json("POST", f"{uri}/index/mx", {"options": {}})
+        http_json("POST", f"{uri}/index/mx/field/mf", {"options": {"type": "set"}})
+        c[0].api.import_bits(
+            "mx", "mf",
+            np.zeros(4, dtype=np.uint64),
+            np.array([1, 2, 3_000_000, 5_000_000], dtype=np.uint64),
+        )
+        r = http_json("POST", f"{uri}/index/mx/query", {"query": "Count(Row(mf=0))"})
+        assert r["results"] == [4]
+        # expvar + prometheus views record the query
+        dv = http_json("GET", f"{uri}/debug/vars")
+        assert dv.get("query_n;index:mx", 0) >= 1
+        text = http_json("GET", f"{uri}/metrics").decode()
+        assert "pilosa_tpu_query_n" in text
+        # the fan-out to node 1 carries the trace id: both nodes saw spans
+        # within one trace
+        spans0 = {s["traceId"] for s in http_json("GET", f"{uri}/debug/traces")}
+        spans1 = {
+            s["traceId"]
+            for s in http_json("GET", f"{c[1].node.uri}/debug/traces")
+        }
+        assert spans0 & spans1, "trace did not propagate to the remote node"
+
+
+def test_long_query_logging():
+    captured = []
+    with ClusterHarness(1, in_memory=True) as c:
+        srv = c[0]
+        srv.long_query_time = 1e-9
+        srv.logger = lambda m: captured.append(m)
+        srv.api.create_index("lq")
+        srv.api.create_field("lq", "lf", options={"type": "set"})
+        srv.api.query("lq", "Count(Row(lf=0))")
+    assert any("slow query" in m for m in captured)
