@@ -230,6 +230,12 @@ class Fragment:
             rb = self._rows.get(row_id)
             return rb.count() if rb is not None else 0
 
+    def cache_top(self):
+        """Rank-cache snapshot taken under the fragment lock, so a concurrent
+        writer mutating the cache in _apply_positions can't tear the read."""
+        with self._mu:
+            return self.cache.top()
+
     # ------------------------------------------------------------------
     # writes — everything funnels through import_positions
     # ------------------------------------------------------------------
@@ -658,6 +664,9 @@ class Fragment:
             self._dev.clear()
             if self._mutex_map is not None:
                 self._rebuild_mutex_map()
+            # the rank cache reflects the replaced contents, and snapshot()
+            # below persists the sidecar — rebuild before it goes to disk
+            self.recalculate_cache()
             self._op_n = self.max_op_n + 1  # force snapshot on next write
             if self.path is not None:
                 self.snapshot()

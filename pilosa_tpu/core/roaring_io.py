@@ -183,6 +183,10 @@ def _decode_official(data: bytes) -> np.ndarray:
     hdr = np.frombuffer(data, dtype=_U16, count=2 * n_keys, offset=pos)
     pos += 4 * n_keys
     keys = hdr[0::2].astype(np.uint64)
+    if n_keys > 1 and not np.all(keys[1:] > keys[:-1]):
+        # the decode() contract is sorted unique positions; the official
+        # format requires strictly increasing container keys
+        raise RoaringError("container keys not strictly increasing")
     cards = hdr[1::2].astype(np.int64) + 1
     offsets: Optional[np.ndarray] = None
     if run_bitset is None or n_keys >= NO_OFFSET_THRESHOLD:

@@ -770,7 +770,7 @@ class Executor:
             # TopN candidates; fragment.go:1570 top reads f.cache.Top()).
             # Cache counts are exact here (updated on every mutation), so
             # the unfiltered path needs no device pass at all.
-            cached = frag.cache.top()
+            cached = frag.cache_top()
             if src is None:
                 out = [
                     Pair(id=rid, count=cnt)

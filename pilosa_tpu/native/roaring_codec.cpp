@@ -158,8 +158,11 @@ int decode_official(const uint8_t* data, size_t len, std::vector<uint64_t>& out,
     off_table = pos;
     pos += 4 * n_keys;
   }
+  uint64_t prev_key = 0;
   for (size_t i = 0; i < n_keys; i++) {
     uint64_t key = rd16(data + hdr + 4 * i);
+    if (i > 0 && key <= prev_key) return fail(err, errlen, "container keys not strictly increasing");
+    prev_key = key;
     size_t card = (size_t)rd16(data + hdr + 4 * i + 2) + 1;
     int ctype;
     if (have_runs && is_run[i]) ctype = kTypeRun;

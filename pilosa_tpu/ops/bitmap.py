@@ -150,7 +150,9 @@ def count_and(a, b) -> jnp.ndarray:
     """Fused popcount(a & b) — Count(Intersect(...)) without materializing
     the intersection (reference: intersectionCount, roaring.go:3121).
     All-axes uint32 sum; see count convention above."""
-    if _USE_PALLAS:
+    # the pallas kernel flattens both operands independently, so it only
+    # handles identically-shaped operands; broadcasting falls back to jnp
+    if _USE_PALLAS and getattr(a, "shape", None) == getattr(b, "shape", None):
         return _pallas().count_and(a, b)
     return _count_and_jnp(a, b)
 
@@ -177,7 +179,7 @@ def _count_andnot_jnp(a, b) -> jnp.ndarray:
 
 
 def count_andnot(a, b) -> jnp.ndarray:
-    if _USE_PALLAS:
+    if _USE_PALLAS and getattr(a, "shape", None) == getattr(b, "shape", None):
         return _pallas().count_andnot(a, b)
     return _count_andnot_jnp(a, b)
 

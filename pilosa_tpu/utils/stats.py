@@ -91,13 +91,17 @@ class Registry:
         def sanitize(name):
             return prefix + "".join(c if c.isalnum() else "_" for c in name)
 
+        def esc(v):
+            # label-value escaping per the exposition format spec
+            return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
         def labels(tags):
             if not tags:
                 return ""
             pairs = []
             for t in tags:
                 k, _, v = t.partition(":")
-                pairs.append(f'{k or "tag"}="{v or k}"')
+                pairs.append(f'{k or "tag"}="{esc(v or k)}"')
             return "{" + ",".join(pairs) + "}"
 
         lines = []
