@@ -1,0 +1,169 @@
+"""Budgeted LRU cache for device-resident (HBM) arrays.
+
+The reference bounds storage residency with mmap + explicit resource caps
+(/root/reference/roaring.go:1437 RemapRoaringStorage, syswrap/mmap.go map
+count caps): hot data lives in the page cache, cold data is a page fault
+away. On TPU the analog is HBM residency: every row/stack a query touches
+is device_put into HBM and should stay there while hot — but HBM is a fixed
+budget, so residency must be *bounded* and cold entries must fall back to
+the host store (a rebuild away, as a page fault is in the reference).
+
+One process-global DeviceCache instance backs:
+- Fragment per-row device arrays (core/fragment.py row_device), and
+- View-level multi-shard row stacks (core/view.py row_stack),
+so the budget is enforced jointly across all fragments and stacks.
+
+Keys are (owner, *rest) tuples where `owner` is a per-object token from
+`new_owner_token()`; `invalidate_owner` drops everything an object cached
+(fragment close / replace-from-stream).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Hashable, Set, Tuple
+
+_DEFAULT_BUDGET_MB = 4096
+
+
+def _env_budget_bytes() -> int:
+    mb = os.environ.get("PILOSA_TPU_HBM_BUDGET_MB")
+    try:
+        mb = int(mb) if mb else _DEFAULT_BUDGET_MB
+    except ValueError:
+        mb = _DEFAULT_BUDGET_MB
+    return mb * 1024 * 1024
+
+
+_token_lock = threading.Lock()
+_token_next = 0
+
+
+def new_owner_token() -> int:
+    """Process-unique owner id (object identity is not reuse-safe)."""
+    global _token_next
+    with _token_lock:
+        _token_next += 1
+        return _token_next
+
+
+def _nbytes(arr) -> int:
+    nb = getattr(arr, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    import numpy as np
+
+    return int(np.asarray(arr).nbytes)
+
+
+class DeviceCache:
+    """LRU key -> device array map with a byte budget.
+
+    A single entry larger than the whole budget is still admitted (the query
+    needs it to run) but is evicted as soon as anything else is inserted —
+    the budget bounds *steady-state* residency.
+    """
+
+    def __init__(self, budget_bytes: int | None = None):
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[Tuple, object]" = OrderedDict()
+        self._sizes: Dict[Tuple, int] = {}
+        self._by_owner: Dict[Hashable, Set[Tuple]] = {}
+        self._bytes = 0
+        self.budget_bytes = (
+            budget_bytes if budget_bytes is not None else _env_budget_bytes()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- core --------------------------------------------------------------
+
+    def get(self, key: Tuple):
+        with self._mu:
+            arr = self._entries.get(key)
+            if arr is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return arr
+
+    def put(self, key: Tuple, arr) -> None:
+        nb = _nbytes(arr)
+        with self._mu:
+            if key in self._entries:
+                self._drop_locked(key)
+            self._entries[key] = arr
+            self._sizes[key] = nb
+            self._by_owner.setdefault(key[0], set()).add(key)
+            self._bytes += nb
+            self._evict_locked(keep=key)
+
+    def get_or_build(self, key: Tuple, build: Callable[[], object]):
+        arr = self.get(key)
+        if arr is None:
+            arr = build()
+            self.put(key, arr)
+        return arr
+
+    def invalidate(self, key: Tuple) -> None:
+        with self._mu:
+            if key in self._entries:
+                self._drop_locked(key)
+
+    def invalidate_owner(self, owner: Hashable) -> None:
+        with self._mu:
+            for key in list(self._by_owner.get(owner, ())):
+                self._drop_locked(key)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._entries.clear()
+            self._sizes.clear()
+            self._by_owner.clear()
+            self._bytes = 0
+
+    # -- internals ---------------------------------------------------------
+
+    def _drop_locked(self, key: Tuple) -> None:
+        self._entries.pop(key, None)
+        self._bytes -= self._sizes.pop(key, 0)
+        owner_keys = self._by_owner.get(key[0])
+        if owner_keys is not None:
+            owner_keys.discard(key)
+            if not owner_keys:
+                del self._by_owner[key[0]]
+
+    def _evict_locked(self, keep: Tuple) -> None:
+        while self._bytes > self.budget_bytes and len(self._entries) > 1:
+            key = next(iter(self._entries))
+            if key == keep:
+                # the just-inserted entry is the only way to finish the
+                # current query; evict around it
+                self._entries.move_to_end(key)
+                key = next(iter(self._entries))
+                if key == keep:
+                    break
+            self._drop_locked(key)
+            self.evictions += 1
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+# Process-global instance shared by fragments and views. Tests may swap the
+# budget (set_budget) or replace the instance outright.
+DEVICE_CACHE = DeviceCache()
+
+
+def set_budget(budget_bytes: int) -> None:
+    DEVICE_CACHE.budget_bytes = budget_bytes

@@ -29,6 +29,7 @@ import numpy as np
 
 from pilosa_tpu.core import cache as cachemod
 from pilosa_tpu.core import wal as walmod
+from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core.rowstore import RowBits
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.ops import bsi as obsi
@@ -75,12 +76,22 @@ class Fragment:
 
         self._mu = threading.RLock()
         self._rows: Dict[int, RowBits] = {}
-        self._dev: Dict[int, jax.Array] = {}  # device row cache
+        # Device residency goes through the process-global budgeted LRU
+        # (core/devcache.py): per-row arrays under _token, multi-row stacks
+        # under _stack_token (stacks are invalidated wholesale on mutation).
+        self._token = new_owner_token()
+        self._stack_token = new_owner_token()
+        # Monotonic mutation counter; cross-fragment caches (view row stacks)
+        # validate against it.
+        self.version = 0
         self._wal: Optional[walmod.WalWriter] = None
         self._op_n = 0
         # mutex fields: col -> owning row (reference keeps a mutex vector,
         # fragment.go:670 handleMutex)
         self._mutex_map: Optional[Dict[int, int]] = {} if mutex else None
+        # optional owner hook fired after any mutation (the View registers
+        # one to drop its cross-shard stacks covering this fragment)
+        self.on_mutate = None
         self._open = False
 
     # ------------------------------------------------------------------
@@ -146,7 +157,8 @@ class Fragment:
                 self._wal.close()
                 self._wal = None
             self.flush_cache()
-            self._dev.clear()
+            DEVICE_CACHE.invalidate_owner(self._token)
+            DEVICE_CACHE.invalidate_owner(self._stack_token)
             self._open = False
 
     def flush_cache(self) -> None:
@@ -205,19 +217,27 @@ class Fragment:
             return rb.to_positions() if rb is not None else np.empty(0, np.uint32)
 
     def row_device(self, row_id: int) -> jax.Array:
-        """Device-resident dense row; cached until the row mutates."""
+        """Device-resident dense row; cached (budgeted LRU) until the row
+        mutates."""
         with self._mu:
-            arr = self._dev.get(row_id)
-            if arr is None:
-                arr = jax.device_put(self.row_words(row_id))
-                self._dev[row_id] = arr
-            return arr
+            return DEVICE_CACHE.get_or_build(
+                (self._token, row_id),
+                lambda: jax.device_put(self.row_words(row_id)),
+            )
 
     def rows_device(self, row_ids: Iterable[int]) -> jax.Array:
-        """Stacked [k, W] device matrix for the given rows."""
-        import jax.numpy as jnp
-
-        return jnp.stack([self.row_device(r) for r in row_ids])
+        """Stacked [k, W] device matrix for the given rows; the stack is
+        cached as one budgeted entry (one transfer, not k)."""
+        ids = tuple(row_ids)
+        with self._mu:
+            return DEVICE_CACHE.get_or_build(
+                (self._stack_token, ids),
+                lambda: jax.device_put(
+                    np.stack([self.row_words(r) for r in ids])
+                    if ids
+                    else np.empty((0, SHARD_WIDTH // 32), np.uint32)
+                ),
+            )
 
     def contains(self, row_id: int, col: int) -> bool:
         with self._mu:
@@ -306,7 +326,6 @@ class Fragment:
                 row_cols = cols[rows == row_id]
                 n_set += rb.add(row_cols)
                 touched.add(int(row_id))
-                self._dev.pop(int(row_id), None)
                 if self._mutex_map is not None:
                     for c in row_cols:
                         self._mutex_map[int(c)] = int(row_id)
@@ -319,7 +338,6 @@ class Fragment:
                 if rb is not None:
                     n_clear += rb.discard(row_cols)
                     touched.add(int(row_id))
-                    self._dev.pop(int(row_id), None)
                 if self._mutex_map is not None:
                     for c in row_cols:
                         if self._mutex_map.get(int(c)) == int(row_id):
@@ -327,6 +345,13 @@ class Fragment:
         for row_id in touched:
             rb = self._rows.get(row_id)
             self.cache.add(row_id, rb.count() if rb is not None else 0)
+            DEVICE_CACHE.invalidate((self._token, row_id))
+        if touched:
+            # multi-row stacks may contain any touched row; drop them all
+            DEVICE_CACHE.invalidate_owner(self._stack_token)
+            self.version += 1
+            if self.on_mutate is not None:
+                self.on_mutate()
         return n_set, n_clear
 
     def _wal_append(self, op: int, positions: np.ndarray) -> None:
@@ -661,7 +686,11 @@ class Fragment:
             )
         with self._mu:
             self._rows = rows
-            self._dev.clear()
+            DEVICE_CACHE.invalidate_owner(self._token)
+            DEVICE_CACHE.invalidate_owner(self._stack_token)
+            self.version += 1
+            if self.on_mutate is not None:
+                self.on_mutate()
             if self._mutex_map is not None:
                 self._rebuild_mutex_map()
             # the rank cache reflects the replaced contents, and snapshot()

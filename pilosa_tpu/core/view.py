@@ -12,8 +12,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core.fragment import Fragment
-from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
@@ -42,6 +43,8 @@ class View:
         self.cache_size = cache_size
         self._mu = threading.RLock()
         self.fragments: Dict[int, Fragment] = {}
+        # owner token for cross-shard row stacks in the global device cache
+        self._stack_token = new_owner_token()
 
     def open(self) -> "View":
         """Load existing fragments from disk (view.go:120 openFragments)."""
@@ -82,6 +85,12 @@ class View:
                     cache_type=self.cache_type,
                     cache_size=self.cache_size,
                 ).open()
+                # any write to a covered fragment invalidates this view's
+                # cross-shard stacks (version keys would miss anyway; this
+                # frees the stale HBM immediately instead of waiting on LRU)
+                frag.on_mutate = lambda: DEVICE_CACHE.invalidate_owner(
+                    self._stack_token
+                )
                 self.fragments[shard] = frag
             return frag
 
@@ -91,6 +100,79 @@ class View:
     def available_shards(self) -> List[int]:
         with self._mu:
             return sorted(self.fragments)
+
+    # -- stacked operands for the compiled query path ----------------------
+    #
+    # A "stack" is one row materialized across a shard list as a dense
+    # uint32[S, W] device array (shard-axis sharded under an active mesh).
+    # Stacks are cached in the global budgeted device cache, keyed by the
+    # fragments' mutation versions — a write to any covered fragment makes
+    # the key miss and the stack rebuild lazily.
+
+    def _stack_key(self, kind: str, ident, shards: tuple) -> tuple:
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        versions = tuple(
+            f.version if (f := self.fragments.get(s)) is not None else -1
+            for s in shards
+        )
+        return (self._stack_token, kind, ident, shards, versions, pmesh.mesh_epoch())
+
+    def row_stack(self, row_id: int, shards) -> Optional[object]:
+        """uint32[S, W] device stack of one row over `shards`, or None when
+        no listed shard has a fragment (the row is wholly absent)."""
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        shards = tuple(shards)
+        with self._mu:
+            frags = [self.fragments.get(s) for s in shards]
+        if all(f is None for f in frags):
+            return None
+        key = self._stack_key("row", row_id, shards)
+
+        def build():
+            rows = [
+                f.row_words(row_id)
+                if f is not None
+                else np.zeros(WORDS_PER_ROW, np.uint32)
+                for f in frags
+            ]
+            return pmesh.put_stack(np.stack(rows))
+
+        return DEVICE_CACHE.get_or_build(key, build)
+
+    def plane_stack(self, row_ids, shards) -> Optional[object]:
+        """uint32[D, S, W] device stack (BSI planes × shards), or None when
+        no listed shard has a fragment."""
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        row_ids = tuple(row_ids)
+        shards = tuple(shards)
+        with self._mu:
+            frags = [self.fragments.get(s) for s in shards]
+        if all(f is None for f in frags):
+            return None
+        key = self._stack_key("planes", row_ids, shards)
+
+        def build():
+            if not row_ids:  # bit_depth 0: empty plane axis
+                planes = np.zeros((0, len(frags), WORDS_PER_ROW), np.uint32)
+            else:
+                zeros = np.zeros(WORDS_PER_ROW, np.uint32)
+                planes = np.stack(
+                    [
+                        np.stack(
+                            [
+                                f.row_words(r) if f is not None else zeros
+                                for f in frags
+                            ]
+                        )
+                        for r in row_ids
+                    ]
+                )
+            return pmesh.put_stack(planes)
+
+        return DEVICE_CACHE.get_or_build(key, build)
 
     # -- fan-down helpers (view.go:367-474) --------------------------------
 

@@ -13,6 +13,7 @@ with collectives; both share the per-shard lowering here.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -25,11 +26,24 @@ from pilosa_tpu.core.field import (
     FIELD_TYPE_TIME,
     Field,
 )
+from pilosa_tpu.core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.exec import translation
+from pilosa_tpu.exec.plan import (
+    PLeaf,
+    PNary,
+    PNode,
+    PRangeBetween,
+    PRangeCmp,
+    PRangeEQ,
+    PShift,
+    PZero,
+    StackedPlan,
+    Unsupported,
+)
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
@@ -107,6 +121,317 @@ class GroupCount:
 
 
 _COND_OP_NAME = {EQ: "eq", NEQ: "neq", LT: "lt", LTE: "lte", GT: "gt", GTE: "gte"}
+
+# Stacked (compiled mesh) query path: on by default; PILOSA_TPU_STACKED=0
+# forces the per-shard fallback everywhere (debugging aid).
+_STACKED_ENABLED = os.environ.get("PILOSA_TPU_STACKED", "1") in ("1", "true")
+
+
+class _StackedLowering:
+    """Lower a PQL bitmap call tree to a compiled plan over stacked
+    [S, W] operands (exec/plan.py).
+
+    Mirrors the per-shard lowering's semantic checks exactly — semantic
+    errors raise ExecError (propagated to the caller identically on either
+    path); shapes with no stacked form raise plan.Unsupported, which makes
+    the executor fall back to the per-shard loop. Absent rows/views lower
+    to PZero (all-zero stacks behave identically to the serial path's None:
+    zero bits in, zero bits out)."""
+
+    def __init__(self, ex: "Executor", idx: Index, shards: List[int]):
+        self.ex = ex
+        self.idx = idx
+        self.shards = list(shards)
+        self.operands: List[Any] = []
+        self.scalars: List[int] = []
+        self._call_memo: Dict[int, PNode] = {}
+        self._leaf_memo: Dict[Tuple, Any] = {}
+
+    # -- operand registration ---------------------------------------------
+
+    def _stack_guard(self, view, mult: int = 1) -> None:
+        """Refuse stacked lowering when densifying would blow memory: a view
+        materialized in few of many shards (dense stacks would be mostly
+        zeros the serial path never touches), or a stack bigger than a
+        quarter of the device budget."""
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+        from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+        n = len(self.shards)
+        if n >= 64:
+            present = sum(
+                1 for s in self.shards if view.fragment_if_exists(s) is not None
+            )
+            if present and present * 8 < n:
+                raise Unsupported("sparse view: stacked form would densify")
+        if n * WORDS_PER_ROW * 4 * max(mult, 1) > DEVICE_CACHE.budget_bytes // 4:
+            raise Unsupported("stack exceeds device budget")
+
+    def _view_leaf(self, view, row_id: int) -> PNode:
+        key = ("row", id(view), row_id)
+        node = self._leaf_memo.get(key)
+        if node is None:
+            self._stack_guard(view)
+            arr = view.row_stack(row_id, self.shards)
+            if arr is None:
+                node = PZero()
+            else:
+                self.operands.append(arr)
+                node = PLeaf(len(self.operands) - 1)
+            self._leaf_memo[key] = node
+        return node
+
+    def _plane_slot(self, view, bit_depth: int) -> Optional[int]:
+        key = ("planes", id(view), bit_depth)
+        if key not in self._leaf_memo:
+            self._stack_guard(view, mult=bit_depth)
+            arr = view.plane_stack(
+                range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth), self.shards
+            )
+            if arr is None:
+                self._leaf_memo[key] = None
+            else:
+                self.operands.append(arr)
+                self._leaf_memo[key] = len(self.operands) - 1
+        return self._leaf_memo[key]
+
+    def _scalar(self, v: int) -> int:
+        self.scalars.append(int(v))
+        return len(self.scalars) - 1
+
+    # -- call lowering ------------------------------------------------------
+
+    def lower(self, c: Call) -> PNode:
+        node = self._call_memo.get(id(c))
+        if node is None:
+            node = self._lower(c)
+            self._call_memo[id(c)] = node
+        return node
+
+    def _lower(self, c: Call) -> PNode:
+        name = c.name
+        if name in ("Row", "Range"):
+            return self._lower_row(c)
+        if name == "Intersect":
+            if not c.children:
+                raise ExecError("empty Intersect query is currently not supported")
+            ch = tuple(self.lower(x) for x in c.children)
+            if any(isinstance(x, PZero) for x in ch):
+                return PZero()
+            return ch[0] if len(ch) == 1 else PNary("and", ch)
+        if name in ("Union", "Xor"):
+            ch = tuple(
+                x
+                for x in (self.lower(x) for x in c.children)
+                if not isinstance(x, PZero)
+            )
+            if not ch:
+                return PZero()
+            if len(ch) == 1:
+                return ch[0]
+            return PNary("or" if name == "Union" else "xor", ch)
+        if name == "Difference":
+            if not c.children:
+                return PZero()
+            ch = tuple(self.lower(x) for x in c.children)
+            if isinstance(ch[0], PZero):
+                return PZero()
+            rest = tuple(x for x in ch[1:] if not isinstance(x, PZero))
+            if not rest:
+                return ch[0]
+            return PNary("andnot", (ch[0],) + rest)
+        if name == "Not":
+            if not self.idx.track_existence:
+                raise ExecError("Not() query requires existence tracking to be enabled")
+            if len(c.children) != 1:
+                raise ExecError("Not() requires a single bitmap input")
+            exists = self._existence_leaf()
+            if isinstance(exists, PZero):
+                return PZero()
+            child = self.lower(c.children[0])
+            if isinstance(child, PZero):
+                return exists
+            return PNary("andnot", (exists, child))
+        if name == "All":
+            return self._existence_leaf()
+        if name == "Shift":
+            if len(c.children) != 1:
+                raise ExecError("Shift() requires a single bitmap input")
+            n = c.int_arg("n")
+            n = 1 if n is None else n
+            child = self.lower(c.children[0])
+            if isinstance(child, PZero):
+                return PZero()
+            return PShift(child, n, self._prev_idx())
+        raise Unsupported(name)
+
+    def _existence_leaf(self) -> PNode:
+        ef = self.idx.existence_field()
+        if ef is None:
+            raise ExecError("existence field not available")
+        v = ef.view(VIEW_STANDARD)
+        if v is None:
+            return PZero()
+        return self._view_leaf(v, 0)
+
+    def _prev_idx(self) -> Tuple[int, ...]:
+        """Stack index of shard_id-1 per stack position (-1 = absent),
+        padded out to the mesh-padded stack length."""
+        from pilosa_tpu.parallel.mesh import padded_shards
+
+        pos = {s: i for i, s in enumerate(self.shards)}
+        out = [pos.get(s - 1, -1) for s in self.shards]
+        out += [-1] * (padded_shards(len(self.shards)) - len(self.shards))
+        return tuple(out)
+
+    def _lower_row(self, c: Call) -> PNode:
+        ex, idx = self.ex, self.idx
+        if c.has_conditions():
+            return self._lower_row_bsi(c)
+        field_name = ex._field_arg_name(c)
+        f = ex._field_of(idx, field_name)
+        row_id = c.args.get(field_name)
+        if isinstance(row_id, bool):
+            if f.options.type != FIELD_TYPE_BOOL:
+                raise ExecError("Row() bool value requires a bool field")
+            row_id = 1 if row_id else 0
+        if not isinstance(row_id, int):
+            if isinstance(row_id, str):
+                raise ExecError(
+                    f"string row key {row_id!r} requires field keys (translation)"
+                )
+            raise ExecError("Row() must specify a row")
+        if f.options.type == FIELD_TYPE_BOOL and row_id not in (0, 1):
+            raise ExecError("Row() bool field expects row 0 or 1")
+
+        from_arg = c.args.get("from")
+        to_arg = c.args.get("to")
+        if from_arg is None and to_arg is None:
+            v = f.view(VIEW_STANDARD)
+            if v is None:
+                return PZero()
+            return self._view_leaf(v, row_id)
+
+        if f.options.type != FIELD_TYPE_TIME:
+            raise ExecError(f"field {field_name} is not a time field")
+        quantum = f.options.time_quantum
+        from_t = timeq.parse_time(from_arg) if from_arg is not None else None
+        to_t = timeq.parse_time(to_arg) if to_arg is not None else None
+        if from_t is None or to_t is None:
+            lo, hi = ex._field_time_bounds(f)
+            if lo is None:
+                return PZero()
+            from_t = from_t or lo
+            to_t = to_t or hi
+        leaves = []
+        for vname in timeq.views_by_time_range(VIEW_STANDARD, from_t, to_t, quantum):
+            v = f.view(vname)
+            if v is None:
+                continue
+            leaf = self._view_leaf(v, row_id)
+            if not isinstance(leaf, PZero):
+                leaves.append(leaf)
+        if not leaves:
+            return PZero()
+        return leaves[0] if len(leaves) == 1 else PNary("or", tuple(leaves))
+
+    def _lower_row_bsi(self, c: Call) -> PNode:
+        """Stacked BSI condition row: same sign/saturation decomposition as
+        Fragment.range_op/range_between (fragment.py), emitted as plan
+        nodes over [D, S, W] plane stacks."""
+        ex, idx = self.ex, self.idx
+        conds = c.condition_args()
+        if len(c.args) != 1 or len(conds) != 1:
+            raise ExecError("Row(): exactly one condition required")
+        field_name, cond = next(iter(conds.items()))
+        f = ex._field_of(idx, field_name)
+        if f.options.type != FIELD_TYPE_INT:
+            raise ExecError(f"field {field_name} is not an int field")
+        o = f.options
+        bsiv = f.view(f.bsi_view_name())
+        if bsiv is None:
+            return PZero()
+        exists = self._view_leaf(bsiv, BSI_EXISTS_BIT)
+        if isinstance(exists, PZero):
+            return PZero()
+        sign = self._view_leaf(bsiv, BSI_SIGN_BIT)
+        planes = self._plane_slot(bsiv, o.bit_depth)
+        if planes is None:
+            return PZero()
+
+        if cond.op == NEQ and cond.value is None:  # != null
+            return exists
+        if cond.op == BETWEEN:
+            lo, hi = cond.int_pair()
+            blo, bhi, out_of_range = f.base_value_between(lo, hi)
+            if out_of_range:
+                return PZero()
+            if lo <= o.min and hi >= o.max:
+                return exists
+            return self._between(exists, sign, planes, blo, bhi)
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise ExecError("Row(): conditions only support integer values")
+        value = cond.value
+        op = _COND_OP_NAME[cond.op]
+        base_value, out_of_range = f.base_value(op, value)
+        if out_of_range and cond.op != NEQ:
+            return PZero()
+        if (
+            (cond.op == LT and value > o.max)
+            or (cond.op == LTE and value >= o.max)
+            or (cond.op == GT and value < o.min)
+            or (cond.op == GTE and value <= o.min)
+        ):
+            return exists
+        if out_of_range and cond.op == NEQ:
+            return exists
+        return self._range_op(exists, sign, planes, op, base_value)
+
+    @staticmethod
+    def _pos_neg(exists: PNode, sign: PNode) -> Tuple[PNode, PNode]:
+        return PNary("andnot", (exists, sign)), PNary("and", (exists, sign))
+
+    def _range_op(self, exists, sign, planes: int, op: str, predicate: int) -> PNode:
+        upred = self._scalar(abs(predicate))
+        positives, negatives = self._pos_neg(exists, sign)
+        if op in ("eq", "neq"):
+            base = negatives if predicate < 0 else positives
+            eq = PRangeEQ(base, planes, upred)
+            if op == "eq":
+                return eq
+            return PNary("andnot", (exists, eq))
+        if op in ("lt", "lte"):
+            allow_eq = op == "lte"
+            if predicate > 0 or (predicate == 0 and allow_eq):
+                pos = PRangeCmp("lt", positives, planes, upred, allow_eq)
+                return PNary("or", (negatives, pos))
+            if predicate == 0:  # strict < 0
+                return negatives
+            return PRangeCmp("gt", negatives, planes, upred, allow_eq)
+        if op in ("gt", "gte"):
+            allow_eq = op == "gte"
+            if predicate > 0 or (predicate == 0 and allow_eq):
+                return PRangeCmp("gt", positives, planes, upred, allow_eq)
+            if predicate == 0:  # strict > 0
+                return PRangeCmp("gt", positives, planes, upred, False)
+            neg = PRangeCmp("lt", negatives, planes, upred, allow_eq)
+            return PNary("or", (positives, neg))
+        raise ExecError(f"invalid range op {op!r}")
+
+    def _between(self, exists, sign, planes: int, pmin: int, pmax: int) -> PNode:
+        positives, negatives = self._pos_neg(exists, sign)
+        if pmin >= 0:
+            return PRangeBetween(
+                positives, planes, self._scalar(abs(pmin)), self._scalar(abs(pmax))
+            )
+        if pmax < 0:
+            return PRangeBetween(
+                negatives, planes, self._scalar(abs(pmax)), self._scalar(abs(pmin))
+            )
+        pos = PRangeCmp("lt", positives, planes, self._scalar(abs(pmax)), True)
+        neg = PRangeCmp("lt", negatives, planes, self._scalar(abs(pmin)), True)
+        return PNary("or", (pos, neg))
 
 
 class Executor:
@@ -219,8 +544,51 @@ class Executor:
         n += sum(self._count_shifts(v) for v in c.args.values() if isinstance(v, Call))
         return n
 
+    def _lower_stacked(self, idx: Index, c: Call, shard_list) -> Optional[StackedPlan]:
+        """Try to lower a bitmap call tree to one compiled stacked plan
+        (exec/plan.py; VERDICT round-1 task: the mesh IS the executor).
+        Returns None when the call shape has no stacked form — the caller
+        falls back to the per-shard loop. Semantic ExecErrors propagate."""
+        if not _STACKED_ENABLED or not shard_list:
+            return None
+        shard_list = list(shard_list)
+        # Shift reads the PREVIOUS shard's child bits for its carry
+        # (serial path: _bitmap_call_shard(shard-1)); when the caller asked
+        # for an explicit shard subset, those predecessors may hold data but
+        # be absent from the list. Append them to the stack (depth-k shifts
+        # need k predecessors); output trimming excludes them.
+        k = self._count_shifts(c)
+        if k:
+            present = set(shard_list)
+            extra = []
+            for s in shard_list:
+                for p in range(max(0, s - k), s):
+                    if p not in present:
+                        present.add(p)
+                        extra.append(p)
+            aug = shard_list + sorted(extra)
+        else:
+            aug = shard_list
+        low = _StackedLowering(self, idx, aug)
+        try:
+            root = low.lower(c)
+        except Unsupported:
+            return None
+        if not low.operands:
+            return None  # nothing materialized anywhere: trivial fallback
+        return StackedPlan(root, low.operands, low.scalars, len(shard_list))
+
     def _execute_bitmap_call(self, idx: Index, c: Call, shards) -> Row:
         shard_list = self._shards_for(idx, shards)
+        sp = self._lower_stacked(idx, c, shard_list)
+        if sp is not None:
+            stack = np.asarray(sp.rows())
+            segments = {}
+            for i, shard in enumerate(shard_list):
+                if stack[i].any():
+                    # copy: a slice view would pin the whole [S, W] stack
+                    segments[shard] = stack[i].copy()
+            return Row(segments)
         segments = {}
         memo: dict = {}
         for shard in shard_list:
@@ -470,6 +838,10 @@ class Executor:
         if len(c.children) != 1:
             raise ExecError("Count() only accepts a single bitmap input")
         shard_list = self._shards_for(idx, shards)
+        sp = self._lower_stacked(idx, c.children[0], shard_list)
+        if sp is not None:
+            # one jitted dispatch over all shards + one [S] host read
+            return sp.count()
         total = 0
         memo: dict = {}
         for shard in shard_list:

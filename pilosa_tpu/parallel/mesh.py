@@ -68,6 +68,99 @@ def shard_stack(mesh: Mesh, data: np.ndarray) -> jax.Array:
     return jax.device_put(data, NamedSharding(mesh, DATA_SPEC))
 
 
+# ---------------------------------------------------------------------------
+# Active mesh: the executor's stacked query path places its [S, W] operand
+# stacks with a NamedSharding over this mesh; jit's SPMD partitioner then
+# splits the compiled plan across devices and inserts the collectives
+# (replacing the reference's node fan-out, executor.go:2460-2613). With no
+# active mesh the same code runs single-device.
+# ---------------------------------------------------------------------------
+
+_ACTIVE_MESH: Optional[Mesh] = None
+_MESH_EPOCH = 0  # bumps on every set; cache keys include it
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    global _ACTIVE_MESH, _MESH_EPOCH
+    if mesh is _ACTIVE_MESH:
+        return
+    _ACTIVE_MESH = mesh
+    _MESH_EPOCH += 1
+    # placement changed: everything cached under the old placement is
+    # unreachable (epoch-keyed) — free it now rather than waiting on LRU
+    from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+    DEVICE_CACHE.clear()
+
+
+def mesh_epoch() -> int:
+    return _MESH_EPOCH
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def activate_default_mesh() -> Optional[Mesh]:
+    """Activate a mesh over all local devices when there is more than one
+    (server boot calls this; harmless single-device no-op). Idempotent:
+    a second caller in the same process (e.g. every node of the in-process
+    cluster harness) reuses the active mesh."""
+    devices = jax.devices()
+    if len(devices) > 1:
+        if _ACTIVE_MESH is None or set(_ACTIVE_MESH.devices.flat) != set(devices):
+            set_active_mesh(make_mesh(devices))
+    return _ACTIVE_MESH
+
+
+def stack_sharding(ndim: int) -> Optional[NamedSharding]:
+    """Sharding for a query-operand stack whose axis 0 is the shard axis and
+    whose LAST axis is the word (column) axis: [S, W] row stacks get
+    P("shards", "cols"); [D, S, W] BSI plane stacks replicate the plane axis
+    and shard the trailing two. Returns None when no mesh is active."""
+    mesh = _ACTIVE_MESH
+    if mesh is None:
+        return None
+    if ndim == 2:
+        spec = P("shards", "cols")
+    elif ndim == 3:
+        spec = P(None, "shards", "cols")
+    else:
+        spec = P("shards")
+    return NamedSharding(mesh, spec)
+
+
+def padded_shards(n_shards: int) -> int:
+    """Shard-axis length after padding to the active mesh's "shards" axis
+    (device_put requires dimension divisibility; zero-padded shards are
+    semantically inert — absent rows are all-zero words)."""
+    mesh = _ACTIVE_MESH
+    if mesh is None:
+        return n_shards
+    m = mesh.shape["shards"]
+    return ((n_shards + m - 1) // m) * m
+
+
+def put_stack(data: np.ndarray) -> jax.Array:
+    """device_put a host operand stack with the active mesh's sharding (or
+    default placement when no mesh is active), zero-padding the shard axis
+    to the mesh factor.
+
+    BSI plane stacks are [D, S, W] with S on axis 1; everything else carries
+    the shard axis first and words last."""
+    sh = stack_sharding(np.ndim(data))
+    if sh is None:
+        return jax.device_put(data)
+    shard_axis = 1 if np.ndim(data) == 3 else 0
+    s = data.shape[shard_axis]
+    target = padded_shards(s)
+    if target != s:
+        pad = [(0, 0)] * data.ndim
+        pad[shard_axis] = (0, target - s)
+        data = np.pad(data, pad)
+    return jax.device_put(data, sh)
+
+
 def _query_math(data, row_a: int, row_b: int):
     """The shared single-program query math over a local [S, R, W] block.
 

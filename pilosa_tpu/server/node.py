@@ -93,6 +93,13 @@ class NodeServer:
         from pilosa_tpu import native
 
         native.available()
+        # Multi-device hosts serve the compiled query path over a device
+        # mesh: stacked plan operands get NamedSharding placement and XLA
+        # inserts the ICI collectives (parallel/mesh.py). Single-device
+        # hosts (and the CPU test harness before force_cpu(n>1)) no-op.
+        from pilosa_tpu.parallel.mesh import activate_default_mesh
+
+        activate_default_mesh()
         self.holder.open()
         from pilosa_tpu.server.handler import make_http_server
 
