@@ -307,6 +307,16 @@ class Field:
                     m = idx[vshards == shard]
                     v.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
 
+    def import_row_words(self, row_id: int, shard: int, words: np.ndarray) -> int:
+        """Word-level bulk union of one row of one shard (standard view);
+        see Fragment.import_row_words. Returns newly-set bit count."""
+        if self.options.type not in (FIELD_TYPE_SET, FIELD_TYPE_TIME, FIELD_TYPE_BOOL):
+            raise ValueError(
+                f"word-level import not supported on {self.options.type} fields"
+            )
+        std = self._view_create(VIEW_STANDARD)
+        return std.fragment(int(shard)).import_row_words(row_id, words)
+
     def import_values(self, cols: np.ndarray, values: np.ndarray) -> None:
         """Bulk BSI import (field.go:1285 importValue)."""
         cols = np.asarray(cols, dtype=np.uint64)

@@ -126,10 +126,16 @@ class Fragment:
                         )
                     self._rows = rows
                 for op, positions in walmod.replay_wal(self.wal_path):
-                    self._apply_positions(
-                        positions if op == walmod.OP_SET else np.empty(0, np.uint64),
-                        positions if op == walmod.OP_CLEAR else np.empty(0, np.uint64),
-                    )
+                    if op == walmod.OP_ROW_WORDS:
+                        self._apply_row_words(
+                            int(positions[0]),
+                            np.ascontiguousarray(positions[1:]).view(np.uint32),
+                        )
+                    else:
+                        self._apply_positions(
+                            positions if op == walmod.OP_SET else np.empty(0, np.uint64),
+                            positions if op == walmod.OP_CLEAR else np.empty(0, np.uint64),
+                        )
                     self._op_n += len(positions)
                     replayed += 1
                 self._wal = walmod.WalWriter(self.wal_path)
@@ -353,6 +359,45 @@ class Fragment:
             if self.on_mutate is not None:
                 self.on_mutate()
         return n_set, n_clear
+
+    def import_row_words(self, row_id: int, words: np.ndarray) -> int:
+        """Word-level bulk union into one row — the device-native analog of
+        the reference's zero-parse roaring import (fragment.go:2255
+        ImportRoaringBits unioning a shipped bitmap in place): callers ship
+        the row's dense uint32[W] words and they are OR'd into the store in
+        one vector op. Returns how many bits were newly set."""
+        if self._mutex_map is not None:
+            raise ValueError("word-level import is not supported on mutex fields")
+        words = np.ascontiguousarray(words, dtype=np.uint32)
+        if words.shape != (SHARD_WIDTH // 32,):
+            raise ValueError(
+                f"import_row_words: want shape ({SHARD_WIDTH // 32},), got {words.shape}"
+            )
+        with self._mu:
+            if self._wal is not None:
+                payload = np.empty(1 + words.nbytes // 8, np.uint64)
+                payload[0] = row_id
+                payload[1:] = words.view(np.uint64)
+                self._wal.append(walmod.OP_ROW_WORDS, payload)
+            added = self._apply_row_words(row_id, words)
+            self._op_n += added
+            if self._op_n > self.max_op_n:
+                self.snapshot()
+            return added
+
+    def _apply_row_words(self, row_id: int, words: np.ndarray) -> int:
+        rb = self._rows.get(row_id)
+        if rb is None:
+            rb = self._rows[row_id] = RowBits(SHARD_WIDTH)
+        added = rb.union_words(words)
+        if added:
+            self.cache.add(row_id, rb.count())
+            DEVICE_CACHE.invalidate((self._token, row_id))
+            DEVICE_CACHE.invalidate_owner(self._stack_token)
+            self.version += 1
+            if self.on_mutate is not None:
+                self.on_mutate()
+        return added
 
     def _wal_append(self, op: int, positions: np.ndarray) -> None:
         if self._wal is not None:

@@ -123,6 +123,22 @@ class RowBits:
         self._maybe_densify()
         return changed
 
+    def union_words(self, words: np.ndarray) -> int:
+        """Union a dense word vector in; returns how many bits were newly
+        set. The word-level bulk path (the reference unions whole serialized
+        bitmaps in place the same way, roaring.go:1511 ImportRoaringBits)."""
+        words = np.asarray(words, dtype=np.uint32)
+        if not words.any():
+            return 0
+        before = self.count()
+        if self.dense is None:
+            self.dense = self._to_dense()
+            self.positions = None
+        np.bitwise_or(self.dense, words, out=self.dense)
+        added = self.count() - before
+        self._maybe_sparsify()
+        return added
+
     def discard(self, gone: np.ndarray) -> int:
         """Clear the given positions; returns how many were actually cleared."""
         gone = np.asarray(gone, dtype=np.uint32)

@@ -36,6 +36,9 @@ SNAP_MAGIC = b"PTSNAP01"
 WAL_MAGIC = 0x5054574C
 OP_SET = 0
 OP_CLEAR = 1
+# Word-level row union (bulk ingest): payload[0] = row_id, payload[1:] = the
+# row's dense uint32 words viewed as uint64 — one record per imported row.
+OP_ROW_WORDS = 2
 
 _REC_HDR = struct.Struct("<IBII")
 

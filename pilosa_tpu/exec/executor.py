@@ -858,11 +858,77 @@ class Executor:
             return self._bitmap_call_shard(idx, filt, shard), True
         return None, False
 
+    _BSI_EMPTY = "empty"  # sentinel: no BSI data anywhere -> ValCount(0, 0)
+
+    def _stacked_bsi(self, idx: Index, c: Call, f: Field, shard_list):
+        """Stacked operands for a whole-field BSI aggregate (Sum/Min/Max):
+        (exists, sign, planes, filter_or_None) as padded device stacks, the
+        _BSI_EMPTY sentinel when there is trivially no data, or None to fall
+        back to the per-shard loop."""
+        if not _STACKED_ENABLED or not shard_list:
+            return None
+        bsiv = f.view(f.bsi_view_name())
+        if bsiv is None:
+            return self._BSI_EMPTY
+        filter_call = None
+        if len(c.children) == 1:
+            filter_call = c.children[0]
+        else:
+            fa = c.args.get("filter")
+            if isinstance(fa, Call):
+                filter_call = fa
+        if filter_call is not None and self._count_shifts(filter_call):
+            # Shift carries need predecessor-shard augmentation (see
+            # _lower_stacked); not worth plumbing here — fall back.
+            return None
+        low = _StackedLowering(self, idx, list(shard_list))
+        try:
+            low._stack_guard(bsiv, mult=f.options.bit_depth + 3)
+            filt = None
+            if filter_call is not None:
+                root = low.lower(filter_call)
+                if isinstance(root, PZero):
+                    return self._BSI_EMPTY
+                if not low.operands:
+                    return None
+                sp = StackedPlan(root, low.operands, low.scalars, len(shard_list))
+                filt = sp.rows_full()
+            exists = bsiv.row_stack(BSI_EXISTS_BIT, low.shards)
+            if exists is None:
+                return self._BSI_EMPTY
+            sign = bsiv.row_stack(BSI_SIGN_BIT, low.shards)
+            planes = bsiv.plane_stack(
+                range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + f.options.bit_depth),
+                low.shards,
+            )
+        except Unsupported:
+            return None
+        return exists, sign, planes, filt
+
     def _execute_sum(self, idx: Index, c: Call, shards) -> ValCount:
         field_name = c.string_arg("field") or self._field_arg_name(c)
         f = self._field_of(idx, field_name)
         if f.options.type != FIELD_TYPE_INT:
             raise ExecError(f"field {field_name} is not an int field")
+        st = self._stacked_bsi(idx, c, f, self._shards_for(idx, shards))
+        if st == self._BSI_EMPTY:
+            return ValCount(0, 0)
+        if st is not None:
+            # one jitted dispatch over all shards, exact host combine
+            exists, sign, planes, filt = st
+            from pilosa_tpu.ops import bsi as obsi
+
+            depth = f.options.bit_depth
+            cnt, pos, neg = obsi.sum_counts_stacked(
+                planes, exists, sign, exists if filt is None else filt, depth
+            )
+            count = int(np.asarray(cnt, dtype=np.uint64).sum())
+            pos = np.asarray(pos, dtype=np.uint64).reshape(depth, -1).sum(axis=1)
+            neg = np.asarray(neg, dtype=np.uint64).reshape(depth, -1).sum(axis=1)
+            total = sum(
+                (1 << i) * (int(pos[i]) - int(neg[i])) for i in range(depth)
+            )
+            return ValCount(value=total + count * f.options.base, count=count)
         bsiv = f.view(f.bsi_view_name())
         total = 0
         count = 0
@@ -884,6 +950,27 @@ class Executor:
         f = self._field_of(idx, field_name)
         if f.options.type != FIELD_TYPE_INT:
             raise ExecError(f"field {field_name} is not an int field")
+        st = self._stacked_bsi(idx, c, f, self._shards_for(idx, shards))
+        if st == self._BSI_EMPTY:
+            return ValCount(0, 0)
+        if st is not None:
+            exists, sign, planes, filt = st
+            from pilosa_tpu.ops import bsi as obsi
+
+            val, cnts, any_ = obsi.min_max_signed(
+                planes,
+                exists,
+                sign,
+                exists if filt is None else filt,
+                f.options.bit_depth,
+                is_min,
+            )
+            if not bool(any_):
+                return ValCount(0, 0)
+            return ValCount(
+                value=int(val) + f.options.base,
+                count=int(np.asarray(cnts, dtype=np.uint64).sum()),
+            )
         bsiv = f.view(f.bsi_view_name())
         best: Optional[Tuple[int, int]] = None
         if bsiv is not None:

@@ -243,3 +243,9 @@ class StackedPlan:
         STATS["evals"] += 1
         out = _eval_jit(self.root, "row", tuple(self.operands), self._scalar_args())
         return out[: self.n_shards]
+
+    def rows_full(self) -> jax.Array:
+        """Materialized result stack INCLUDING mesh-padded shards (all-zero
+        rows), for composing with other padded [S, W] stacks on device."""
+        STATS["evals"] += 1
+        return _eval_jit(self.root, "row", tuple(self.operands), self._scalar_args())

@@ -53,18 +53,48 @@ def sum_counts(planes, exists, sign, filter_words, bit_depth: int):
 
 
 @partial(jax.jit, static_argnames=("bit_depth",))
+def sum_counts_stacked(planes, exists, sign, filter_words, bit_depth: int):
+    """sum_counts over stacked operands: planes uint32[D, S, W], the rest
+    uint32[S, W]. Counts reduce over the word axis only, returning per-shard
+    partials (count[S], pos[D, S], neg[D, S]) the host sums in exact Python
+    ints — per-shard partials can never overflow uint32 (a shard holds at
+    most 2^20 bits), while a whole-stack uint32 sum could at >4B columns."""
+    consider = jnp.bitwise_and(exists, filter_words)
+    nrow = jnp.bitwise_and(sign, consider)
+    prow = jnp.bitwise_and(consider, jnp.bitwise_not(sign))
+    count = jnp.sum(_pc(consider), axis=-1, dtype=jnp.uint32)
+    if bit_depth == 0:  # static: all stored values are 0 (or base only)
+        z = jnp.zeros((0,) + count.shape, jnp.uint32)
+        return count, z, z
+    pos = jnp.stack(
+        [
+            jnp.sum(_pc(jnp.bitwise_and(planes[i], prow)), axis=-1, dtype=jnp.uint32)
+            for i in range(bit_depth)
+        ]
+    )
+    neg = jnp.stack(
+        [
+            jnp.sum(_pc(jnp.bitwise_and(planes[i], nrow)), axis=-1, dtype=jnp.uint32)
+            for i in range(bit_depth)
+        ]
+    )
+    return count, pos, neg
+
+
+@partial(jax.jit, static_argnames=("bit_depth",))
 def min_unsigned(planes, filter_words, bit_depth: int):
     """Lowest magnitude among filter columns (fragment.go:1173 minUnsigned).
 
     Returns (min_value uint32, final_filter_words). The count of columns
     attaining the min is popcount(final_filter) — computed by the caller.
+    Shape-generic: works on single rows [W] or stacked rows [S, W] (the
+    narrowing test is a global any, not a count, so it cannot overflow).
     """
     filt = filter_words
     mval = jnp.uint32(0)
     for i in reversed(range(bit_depth)):
         row = jnp.bitwise_and(filt, jnp.bitwise_not(planes[i]))
-        c = _count(row)
-        nonzero = c > 0
+        nonzero = jnp.any(row != 0)
         filt = jnp.where(nonzero, row, filt)
         mval = mval + jnp.where(nonzero, jnp.uint32(0), jnp.uint32(1) << i)
     return mval, filt
@@ -77,11 +107,41 @@ def max_unsigned(planes, filter_words, bit_depth: int):
     mval = jnp.uint32(0)
     for i in reversed(range(bit_depth)):
         row = jnp.bitwise_and(planes[i], filt)
-        c = _count(row)
-        nonzero = c > 0
+        nonzero = jnp.any(row != 0)
         filt = jnp.where(nonzero, row, filt)
         mval = mval + jnp.where(nonzero, jnp.uint32(1) << i, jnp.uint32(0))
     return mval, filt
+
+
+@partial(jax.jit, static_argnames=("bit_depth", "is_min"))
+def min_max_signed(planes, exists, sign, filter_words, bit_depth: int, is_min: bool):
+    """Global signed min/max in ONE dispatch (the fused form of
+    Fragment.min/max's sign decomposition, fragment.go:1146/1191), shape-
+    generic over [W] or stacked [S, W] operands.
+
+    Returns (value int64, per-shard attain-counts uint32[...], any bool):
+    `any` False means no considered columns. Both sign-branch ladders are
+    evaluated and selected with `where` — they are cheap elementwise passes
+    XLA fuses into one HBM sweep."""
+    consider = jnp.bitwise_and(exists, filter_words)
+    negatives = jnp.bitwise_and(consider, sign)
+    positives = jnp.bitwise_and(consider, jnp.bitwise_not(sign))
+    any_ = jnp.any(consider != 0)
+    if is_min:
+        # negatives present -> most-negative = -max magnitude among negatives
+        branch = jnp.any(negatives != 0)
+        bval, bfilt = max_unsigned(planes, negatives, bit_depth)
+        oval, ofilt = min_unsigned(planes, consider, bit_depth)
+        val = jnp.where(branch, -bval.astype(jnp.int64), oval.astype(jnp.int64))
+    else:
+        # positives present -> max among positives; else -min magnitude
+        branch = jnp.any(positives != 0)
+        bval, bfilt = max_unsigned(planes, positives, bit_depth)
+        oval, ofilt = min_unsigned(planes, consider, bit_depth)
+        val = jnp.where(branch, bval.astype(jnp.int64), -oval.astype(jnp.int64))
+    final = jnp.where(branch, bfilt, ofilt)
+    counts = jnp.sum(_pc(final), axis=-1, dtype=jnp.uint32)
+    return val, counts, any_
 
 
 # ---------------------------------------------------------------------------

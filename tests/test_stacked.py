@@ -256,3 +256,79 @@ class TestStackCacheInvalidation:
         f.set_bit(1, 2 * SHARD_WIDTH + 12345)
         after = ex.execute("stk", "Count(Row(f=1))")[0]
         assert after == before + 1
+
+
+class TestStackedBSIAggregates:
+    """Stacked Sum/Min/Max: one dispatch over all shards, exact host
+    combine; results must match the per-shard path and a naive model."""
+
+    def _mk_bsi(self, holder, n_shards=5, seed=11, lo=-300, hi=300):
+        idx = holder.create_index("agg", track_existence=True)
+        rng = np.random.default_rng(seed)
+        cols = np.unique(
+            rng.integers(0, n_shards * SHARD_WIDTH, 3000).astype(np.uint64)
+        )
+        vals = rng.integers(lo, hi + 1, len(cols)).astype(np.int64)
+        v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=lo, max=hi))
+        v.import_values(cols, vals)
+        idx.track_columns(cols)
+        # a filter row hitting ~half the columns
+        fcols = cols[rng.random(len(cols)) < 0.5]
+        f = idx.create_field("f")
+        f.import_bits(np.full(len(fcols), 1, np.uint64), fcols)
+        return idx, dict(zip(cols.tolist(), vals.tolist())), set(fcols.tolist())
+
+    def test_sum_min_max_match_naive_and_serial(self, holder, monkeypatch):
+        import pilosa_tpu.exec.executor as exmod
+
+        idx, model, filt = self._mk_bsi(holder)
+        ex = Executor(holder)
+        queries = ["Sum(field=v)", "Min(field=v)", "Max(field=v)",
+                   "Sum(Row(f=1), field=v)", "Min(Row(f=1), field=v)",
+                   "Max(Row(f=1), field=v)"]
+
+        vals_all = list(model.values())
+        vals_f = [v for c, v in model.items() if c in filt]
+        want = [
+            (sum(vals_all), len(vals_all)),
+            (min(vals_all), vals_all.count(min(vals_all))),
+            (max(vals_all), vals_all.count(max(vals_all))),
+            (sum(vals_f), len(vals_f)),
+            (min(vals_f), vals_f.count(min(vals_f))),
+            (max(vals_f), vals_f.count(max(vals_f))),
+        ]
+        planmod.reset_stats()
+        got = [ex.execute("agg", q)[0] for q in queries]
+        for q, g, w in zip(queries, got, want):
+            assert (g.value, g.count) == w, (q, (g.value, g.count), w)
+        # unfiltered aggregates are zero plan evals (direct stacks); filtered
+        # ones evaluate the filter plan once each
+        assert planmod.STATS["evals"] == 3, planmod.STATS
+
+        # serial path agrees
+        monkeypatch.setattr(exmod, "_STACKED_ENABLED", False)
+        got_serial = [ex.execute("agg", q)[0] for q in queries]
+        for q, g, s in zip(queries, got_serial, got):
+            assert (g.value, g.count) == (s.value, s.count), q
+
+    def test_sum_empty_field(self, holder):
+        idx = holder.create_index("agg2", track_existence=True)
+        idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10))
+        ex = Executor(holder)
+        for q in ("Sum(field=v)", "Min(field=v)", "Max(field=v)"):
+            r = ex.execute("agg2", q)[0]
+            assert (r.value, r.count) == (0, 0), q
+
+    def test_sum_on_mesh(self, holder):
+        idx, model, filt = self._mk_bsi(holder, n_shards=7, seed=23)
+        mesh = pmesh.make_mesh(jax.devices())
+        pmesh.set_active_mesh(mesh)
+        try:
+            ex = Executor(holder)
+            g = ex.execute("agg", "Sum(Row(f=1), field=v)")[0]
+            vals_f = [v for c, v in model.items() if c in filt]
+            assert (g.value, g.count) == (sum(vals_f), len(vals_f))
+            m = ex.execute("agg", "Min(field=v)")[0]
+            assert m.value == min(model.values())
+        finally:
+            pmesh.set_active_mesh(None)
