@@ -1335,18 +1335,23 @@ class Executor:
             if not child_rows[-1]:
                 return []
 
-        merged: Dict[Tuple[int, ...], int] = {}
-        for shard in self._shards_for(idx, shards):
-            fw = (
-                self._bitmap_call_shard(idx, filter_call, shard)
-                if filter_call is not None
-                else None
-            )
-            if filter_call is not None and fw is None:
-                continue
-            self._group_by_shard(
-                idx, child_fields, child_rows, fw, shard, merged
-            )
+        shard_list = self._shards_for(idx, shards)
+        merged = self._group_by_stacked(
+            idx, child_fields, child_rows, filter_call, shard_list
+        )
+        if merged is None:
+            merged = {}
+            for shard in shard_list:
+                fw = (
+                    self._bitmap_call_shard(idx, filter_call, shard)
+                    if filter_call is not None
+                    else None
+                )
+                if filter_call is not None and fw is None:
+                    continue
+                self._group_by_shard(
+                    idx, child_fields, child_rows, fw, shard, merged
+                )
         out = [
             GroupCount(
                 group=[
@@ -1365,6 +1370,45 @@ class Executor:
         if limit is not None:
             out = out[:limit]
         return out
+
+    def _group_by_stacked(
+        self, idx, child_fields, child_rows, filter_call, shard_list
+    ) -> Optional[Dict[Tuple[int, ...], int]]:
+        """Tally the whole GroupBy cross-product in O(depth) batched device
+        dispatches over stacked [R, S, W] operands (exec/groupby.py),
+        replacing the per-(prefix, depth) dispatch + host sync of the
+        recursive walk. Returns None to fall back to the per-shard path
+        (stacked lowering unsupported for this shape/budget)."""
+        if not _STACKED_ENABLED or not shard_list:
+            return None
+        if filter_call is not None and self._count_shifts(filter_call):
+            return None
+        low = _StackedLowering(self, idx, list(shard_list))
+        planes_list = []
+        try:
+            filt = None
+            if filter_call is not None:
+                root = low.lower(filter_call)
+                if isinstance(root, PZero) or not low.operands:
+                    return {}  # filter matches nothing anywhere
+                filt = StackedPlan(
+                    root, low.operands, low.scalars, len(shard_list)
+                ).rows_full()
+            for fname, rows in zip(child_fields, child_rows):
+                f = self._field_of(idx, fname)
+                v = f.view(VIEW_STANDARD)
+                if v is None:
+                    return {}
+                low._stack_guard(v, mult=max(len(rows), 1))
+                p = v.plane_stack(rows, low.shards)
+                if p is None:
+                    return {}
+                planes_list.append(p)
+        except Unsupported:
+            return None
+        from pilosa_tpu.exec import groupby as qgb
+
+        return qgb.group_by_device(planes_list, child_rows, filt)
 
     def _group_by_shard(
         self, idx, child_fields, child_rows, filter_words, shard, merged

@@ -332,3 +332,94 @@ class TestStackedBSIAggregates:
             assert m.value == min(model.values())
         finally:
             pmesh.set_active_mesh(None)
+
+
+class TestStackedGroupBy:
+    """Device GroupBy (exec/groupby.py): the whole cross-product tallied in
+    O(depth) batched dispatches, matching the per-shard recursive walk
+    (reference: executor.go:3063 groupByIterator)."""
+
+    def _mk_gb(self, holder, n_shards=4, seed=5, rows_a=6, rows_b=5, rows_c=3):
+        idx = holder.create_index("gb", track_existence=True)
+        rng = np.random.default_rng(seed)
+        # shared column pool spanning all shards, so row intersections
+        # across fields are dense enough to produce real groups
+        pool = np.unique(
+            rng.integers(0, n_shards * SHARD_WIDTH, 800).astype(np.uint64)
+        )
+        for name, n_rows, n_bits in (
+            ("a", rows_a, 2500), ("b", rows_b, 2500), ("c", rows_c, 1500)
+        ):
+            rows = rng.integers(0, n_rows, n_bits).astype(np.uint64)
+            cols = rng.choice(pool, n_bits)
+            f = idx.create_field(name)
+            f.import_bits(rows, cols)
+            idx.track_columns(cols)
+        return idx
+
+    def _serial(self, ex, monkeypatch, query):
+        import pilosa_tpu.exec.executor as exmod
+
+        with monkeypatch.context() as m:
+            m.setattr(exmod, "_STACKED_ENABLED", False)
+            return ex.execute("gb", query)[0]
+
+    @staticmethod
+    def _as_t(gs):
+        return [
+            (tuple((fr.field, fr.row_id) for fr in g.group), g.count) for g in gs
+        ]
+
+    @pytest.mark.parametrize(
+        "query",
+        [
+            "GroupBy(Rows(a))",
+            "GroupBy(Rows(a), Rows(b))",
+            "GroupBy(Rows(a), Rows(b), Rows(c))",
+            "GroupBy(Rows(a), Rows(b), filter=Row(c=1))",
+            "GroupBy(Rows(a), Rows(b), filter=Intersect(Row(c=0), Row(c=1)))",
+            "GroupBy(Rows(a), Rows(b), limit=3)",
+        ],
+    )
+    def test_matches_serial(self, holder, monkeypatch, query):
+        idx = self._mk_gb(holder)
+        ex = Executor(holder)
+        got = ex.execute("gb", query)[0]
+        want = self._serial(ex, monkeypatch, query)
+        assert self._as_t(got) == self._as_t(want), query
+        assert got, query  # non-trivial corpus
+
+    def test_dispatch_count_is_o_depth(self, holder):
+        from pilosa_tpu.exec import groupby as qgb
+
+        idx = self._mk_gb(holder)
+        ex = Executor(holder)
+        qgb.reset_stats()
+        groups = ex.execute("gb", "GroupBy(Rows(a), Rows(b))")[0]
+        assert len(groups) >= 20  # the walk would pay >= 1 dispatch/group
+        # depth 2, one chunk: counts0 + select0 + counts1 = 3 dispatches
+        assert qgb.STATS["evals"] == 3, qgb.STATS
+
+    def test_group_by_on_mesh(self, holder, monkeypatch):
+        idx = self._mk_gb(holder, n_shards=6, seed=9)
+        mesh = pmesh.make_mesh(jax.devices())
+        pmesh.set_active_mesh(mesh)
+        try:
+            ex = Executor(holder)
+            got = ex.execute("gb", "GroupBy(Rows(a), Rows(b), filter=Row(c=2))")[0]
+        finally:
+            pmesh.set_active_mesh(None)
+        want = self._serial(ex, monkeypatch, "GroupBy(Rows(a), Rows(b), filter=Row(c=2))")
+        assert self._as_t(got) == self._as_t(want)
+        assert got
+
+    def test_tiny_tile_chunking(self, holder, monkeypatch):
+        """Force one-prefix chunks: results identical, memory bounded."""
+        from pilosa_tpu.exec import groupby as qgb
+
+        monkeypatch.setattr(qgb, "_tile_bytes", lambda: 1)  # gmax == 1
+        idx = self._mk_gb(holder)
+        ex = Executor(holder)
+        got = ex.execute("gb", "GroupBy(Rows(a), Rows(b), Rows(c))")[0]
+        want = self._serial(ex, monkeypatch, "GroupBy(Rows(a), Rows(b), Rows(c))")
+        assert self._as_t(got) == self._as_t(want)
