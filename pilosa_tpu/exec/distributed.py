@@ -245,7 +245,13 @@ class DistributedExecutor(Executor):
             partials = self._fan_out(
                 idx, c, shards, write=name in ("ClearRow", "Store")
             )
-            return self._reduce(name, c, partials)
+            out = self._reduce(name, c, partials)
+            if isinstance(out, Row):
+                # attrs/exclusions attach on the coordinator only
+                # (reference: executeBitmapCall runs the tail on the
+                # non-remote node, executor.go:595-647)
+                out = self._finish_bitmap_row(idx, c, out, opt)
+            return out
         return super()._execute_call(idx, c, shards, opt)
 
     def _execute_write_by_column(self, idx: Index, c: Call) -> bool:

@@ -13,6 +13,7 @@ with collectives; both share the per-shard lowering here.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -46,7 +47,7 @@ from pilosa_tpu.exec.plan import (
 )
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.pql import Call, Query, parse
-from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Condition
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 DEFAULT_MIN_THRESHOLD = 1  # reference: defaultMinThreshold, executor.go
@@ -68,6 +69,33 @@ class ExecOptions:
     column_attrs: bool = False
     shards: Optional[List[int]] = None
     max_writes: int = 5000  # reference: MaxWritesPerRequest
+
+
+@dataclass
+class ColumnAttrSet:
+    """Column attributes attached to a query response when columnAttrs=true
+    (reference: ColumnAttrSet; executor.go:208 readColumnAttrSets)."""
+
+    id: int = 0
+    key: Optional[str] = None
+    attrs: Optional[Dict[str, Any]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"attrs": self.attrs or {}}
+        if self.key is not None:
+            out["key"] = self.key
+        else:
+            out["id"] = self.id
+        return out
+
+
+@dataclass
+class QueryResponse:
+    """Execute() response: per-call results plus optional column attr sets
+    (reference: QueryResponse, executor.go:113-205)."""
+
+    results: List[Any]
+    column_attr_sets: Optional[List[ColumnAttrSet]] = None
 
 
 @dataclass
@@ -452,7 +480,20 @@ class Executor:
         shards: Optional[Sequence[int]] = None,
         opt: Optional[ExecOptions] = None,
     ) -> List[Any]:
-        opt = opt or ExecOptions()
+        return self.execute_response(index_name, query, shards, opt).results
+
+    def execute_response(
+        self,
+        index_name: str,
+        query: Union[str, Query],
+        shards: Optional[Sequence[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> QueryResponse:
+        """Execute and return the full response incl. column attr sets when
+        columnAttrs=true (reference: executor.go:113-205 Execute)."""
+        # private copy: Options(columnAttrs=...) mutates opt mid-query (the
+        # reference's shared-opt behavior) and must not leak to the caller
+        opt = dataclasses.replace(opt) if opt is not None else ExecOptions()
         if isinstance(query, str):
             query = parse(query)
         idx = self.holder.index(index_name)
@@ -469,10 +510,30 @@ class Executor:
         results = []
         for call in query.calls:
             results.append(self._execute_call(idx, call, shards, opt))
+        resp = QueryResponse(results=results)
+        # Column attrs for every column in any Row result (executor.go:164;
+        # Options(columnAttrs=...) mutates opt before we get here). Columns
+        # excluded by excludeColumns have no segments, hence no attrs —
+        # same interplay as the reference.
+        if opt.column_attrs:
+            cols: set = set()
+            for r in results:
+                if isinstance(r, Row):
+                    cols.update(int(x) for x in r.columns().tolist())
+            sets = []
+            for col in sorted(cols):
+                attrs = idx.column_attr_store.attrs(col)
+                if attrs:
+                    cas = ColumnAttrSet(id=col, attrs=attrs)
+                    if idx.keys:
+                        cas.key = idx.translate_store.key_for_id(col)
+                        cas.id = 0
+                    sets.append(cas)
+            resp.column_attr_sets = sets
         # id -> key translation of results (executor.go:2786)
         if not opt.remote:
-            results = translation.translate_results(idx, query, results)
-        return results
+            resp.results = translation.translate_results(idx, query, results)
+        return resp
 
     def _shards_for(self, idx: Index, shards, call: Optional[Call] = None) -> List[int]:
         if shards is not None:
@@ -532,7 +593,7 @@ class Executor:
             return self._execute_group_by(idx, c, shards)
         if name == "Options":
             return self._execute_options(idx, c, shards, opt)
-        return self._execute_bitmap_call(idx, c, shards)
+        return self._execute_bitmap_call(idx, c, shards, opt)
 
     # ------------------------------------------------------------------
     # bitmap calls
@@ -578,7 +639,9 @@ class Executor:
             return None  # nothing materialized anywhere: trivial fallback
         return StackedPlan(root, low.operands, low.scalars, len(shard_list))
 
-    def _execute_bitmap_call(self, idx: Index, c: Call, shards) -> Row:
+    def _execute_bitmap_call(
+        self, idx: Index, c: Call, shards, opt: Optional[ExecOptions] = None
+    ) -> Row:
         shard_list = self._shards_for(idx, shards)
         sp = self._lower_stacked(idx, c, shard_list)
         if sp is not None:
@@ -588,14 +651,48 @@ class Executor:
                 if stack[i].any():
                     # copy: a slice view would pin the whole [S, W] stack
                     segments[shard] = stack[i].copy()
-            return Row(segments)
+            return self._finish_bitmap_row(idx, c, Row(segments), opt)
         segments = {}
         memo: dict = {}
         for shard in shard_list:
             words = self._bitmap_call_shard(idx, c, shard, memo)
             if words is not None:
                 segments[shard] = words
-        return Row(segments)
+        return self._finish_bitmap_row(idx, c, Row(segments), opt)
+
+    def _finish_bitmap_row(
+        self, idx: Index, c: Call, row: Row, opt: Optional[ExecOptions]
+    ) -> Row:
+        """Attach row attrs to plain Row() results and honor
+        excludeRowAttrs/excludeColumns (reference: executor.go:595-647
+        executeBitmapCall tail; runs on the coordinator only — remote
+        fan-out partials are merged and re-finished there)."""
+        if opt is None or opt.remote:
+            return row
+        if c.name in ("Row", "Range") and not any(
+            isinstance(v, Condition) for v in c.args.values()
+        ):
+            if opt.exclude_row_attrs:
+                row.attrs = {}
+            else:
+                fname = next(
+                    (
+                        k
+                        for k in c.args
+                        if not k.startswith("_") and k not in ("from", "to")
+                    ),
+                    None,
+                )
+                f = idx.field(fname) if fname else None
+                if f is not None:
+                    rid = c.args.get(fname)
+                    if isinstance(rid, (int, np.integer)) and not isinstance(
+                        rid, bool
+                    ):
+                        row.attrs = f.row_attr_store.attrs(int(rid))
+        if opt.exclude_columns:
+            row.segments = {}
+        return row
 
     def _bitmap_call_shard(self, idx: Index, c: Call, shard: int, memo=None):
         """Lower one bitmap call for one shard to device words (or None).
@@ -1199,15 +1296,31 @@ class Executor:
         return pairs
 
     def _topn_shard(self, idx: Index, c: Call, shard: int) -> List[Pair]:
+        """One shard's TopN candidates, mirroring the reference's
+        fragment.top contract exactly (fragment.go:1570-1704): candidates
+        come from the rank cache in rank order (rows evicted from the cache
+        are not candidates — the documented approximation), attribute
+        filters and the Tanimoto window prune before counting, and a
+        min-heap caps the result at n with threshold-based early stop.
+        Intersection counts for all surviving candidates are computed in
+        one batched device dispatch instead of per-row."""
+        import heapq
+        import math
+
         field_name = c.args.get("_field")
         f = self._field_of(idx, field_name)
         if f.options.type == FIELD_TYPE_INT:
             raise ExecError(f"cannot compute TopN() on integer field: {field_name!r}")
         if f.options.cache_type == "none":
             raise ExecError(f'cannot compute TopN(), field has no cache: "{field_name}"')
-        n = c.uint_arg("n")
+        n = c.uint_arg("n") or 0
         ids = c.args.get("ids")
         threshold = c.uint_arg("threshold") or DEFAULT_MIN_THRESHOLD
+        attr_name = c.args.get("attrName")
+        attr_values = c.args.get("attrValues")
+        tanimoto = c.uint_arg("tanimotoThreshold") or 0
+        if tanimoto > 100:
+            raise ExecError("Tanimoto Threshold is from 1 to 100 only")
         src = None
         if len(c.children) == 1:
             src = self._bitmap_call_shard(idx, c.children[0], shard)
@@ -1221,37 +1334,83 @@ class Executor:
         frag = v.fragment_if_exists(shard)
         if frag is None:
             return []
+        # Candidate pairs in rank order (fragment.go:1703 topBitmapPairs):
+        # explicit ids read exact counts and disable truncation (N=0);
+        # otherwise the rank cache is the pool, already sorted by count.
         if ids:
-            row_ids = [int(i) for i in ids]
+            n = 0
+            pairs = [(rid, frag.row_count(rid)) for rid in (int(i) for i in ids)]
+            pairs = [(rid, cnt) for rid, cnt in pairs if cnt > 0]
+            pairs.sort(key=lambda p: (-p[1], p[0]))
         else:
-            # Candidate pool = the fragment's rank cache (the reference's
-            # approximation contract: rows evicted from the cache are not
-            # TopN candidates; fragment.go:1570 top reads f.cache.Top()).
-            # Cache counts are exact here (updated on every mutation), so
-            # the unfiltered path needs no device pass at all.
-            cached = frag.cache_top()
-            if src is None:
-                out = [
-                    Pair(id=rid, count=cnt)
-                    for rid, cnt in cached
-                    if cnt >= threshold
-                ]
-                if n and len(out) > n * 2:
-                    out = out[: n * 2]
-                return out
-            row_ids = [rid for rid, _ in cached]
-        if not row_ids:
+            pairs = frag.cache_top()
+        if not pairs:
             return []
-        counts = frag.row_counts(row_ids, src)
-        out = [
-            Pair(id=rid, count=int(cnt))
-            for rid, cnt in zip(row_ids, counts)
-            if cnt >= threshold
-        ]
+        filters = None
+        if attr_name and attr_values:
+            filters = {fv for fv in attr_values if fv is not None}
+        use_tan = tanimoto > 0 and src is not None
+        if use_tan or src is not None:
+            src_count = int(ob.popcount(src))
+        if use_tan:
+            # exclusive count window around the Tanimoto-feasible region
+            min_tan = src_count * tanimoto / 100.0
+            max_tan = src_count * 100.0 / tanimoto
+        # Host-side prunes first — the cache-count window/threshold and the
+        # attr filter read no device data — then ONE batched dispatch for
+        # the survivors' intersection counts (the reference computes them
+        # row-by-row with early exit; the decisions below depend only on
+        # the counts, so precomputing gives identical results).
+        survivors: List[Tuple[int, int]] = []
+        for rid, cnt in pairs:
+            if cnt == 0:
+                continue
+            if use_tan:
+                if not (min_tan < cnt < max_tan):
+                    continue
+            elif cnt < threshold:
+                continue
+            if filters is not None:
+                attr = f.row_attr_store.attrs(rid)
+                if not attr:
+                    continue
+                val = attr.get(attr_name)
+                if val is None or val not in filters:
+                    continue
+            survivors.append((rid, cnt))
+        icounts: Dict[int, int] = {}
+        if src is not None and survivors:
+            cand = [rid for rid, _ in survivors]
+            icounts = {
+                rid: int(cnt) for rid, cnt in zip(cand, frag.row_counts(cand, src))
+            }
+        results: List[Tuple[int, int]] = []  # min-heap of (count, rid)
+        for rid, cnt in survivors:
+            if n == 0 or len(results) < n:
+                count = icounts[rid] if src is not None else cnt
+                if count == 0:
+                    continue
+                if use_tan:
+                    t = math.ceil(count * 100 / (cnt + src_count - count))
+                    if t <= tanimoto:
+                        continue
+                elif count < threshold:
+                    continue
+                heapq.heappush(results, (count, rid))
+                if n > 0 and len(results) == n and src is None:
+                    break
+                continue
+            # Result set full: only counts above the current minimum can
+            # displace; cache rank order bounds remaining candidates.
+            low = results[0][0]
+            if low < threshold or cnt < low:
+                break
+            count = icounts[rid]
+            if count < low:
+                continue
+            heapq.heappush(results, (count, rid))
+        out = [Pair(id=rid, count=count) for count, rid in results]
         out.sort(key=lambda p: (-p.count, p.id))
-        # per-shard candidate pool: keep enough for a correct global top-n
-        if n and not ids and len(out) > n * 2:
-            out = out[: n * 2]
         return out
 
     # ------------------------------------------------------------------
@@ -1457,6 +1616,9 @@ class Executor:
             column_attrs=bool(c.args.get("columnAttrs", opt.column_attrs)),
             max_writes=opt.max_writes,
         )
+        # columnAttrs is read at response level, so it must propagate to the
+        # caller's options (reference mutates the shared opt, executor.go:368)
+        opt.column_attrs = new_opt.column_attrs
         s = c.args.get("shards")
         if s is not None:
             if not isinstance(s, list):

@@ -104,15 +104,37 @@ class API:
         remote: bool = False,
         headers: Optional[dict] = None,
     ) -> List[Any]:
+        """Execute PQL and return the per-call results list."""
+        return self.query_response(
+            index, query, shards=shards, remote=remote, headers=headers
+        ).results
+
+    def query_response(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[Sequence[int]] = None,
+        remote: bool = False,
+        headers: Optional[dict] = None,
+        column_attrs: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+    ):
         """Execute PQL, with a trace span, per-query stats and slow-query
-        logging (reference: api.go:135 Query + executor spans
-        executor.go:113-115, LongQueryTime api.go:1157)."""
+        logging; returns the full QueryResponse incl. column attr sets
+        (reference: api.go:135 Query + executor spans executor.go:113-115,
+        LongQueryTime api.go:1157)."""
         import time as _time
 
         from pilosa_tpu.utils import tracing
 
         self._validate("query")
-        opt = ExecOptions(remote=remote)
+        opt = ExecOptions(
+            remote=remote,
+            column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+        )
         span = (
             self.server.tracer.start_span_from_headers("api.query", headers)
             if headers
@@ -123,7 +145,7 @@ class API:
             span.set_tag("index", index)
             span.set_tag("remote", remote)
             try:
-                return self.server.executor.execute(
+                return self.server.executor.execute_response(
                     index, query, shards=shards, opt=opt
                 )
             finally:

@@ -190,8 +190,26 @@ class Handler(BaseHTTPRequestHandler):
             pql = body.decode("utf-8")
             if "shards" in self.query:
                 shards = [int(s) for s in self.query["shards"].split(",")]
-        results = self.api.query(index, pql, shards=shards, headers=self.headers)
-        self._reply({"results": [wire.result_to_public_json(r) for r in results]})
+
+        def flag(name: str, d: Optional[dict] = None) -> bool:
+            if d is not None and name in d:
+                return bool(d[name])
+            return self.query.get(name, "") in ("1", "true")
+
+        opts = d if ctype == "application/json" else None
+        resp = self.api.query_response(
+            index,
+            pql,
+            shards=shards,
+            headers=self.headers,
+            column_attrs=flag("columnAttrs", opts),
+            exclude_row_attrs=flag("excludeRowAttrs", opts),
+            exclude_columns=flag("excludeColumns", opts),
+        )
+        out = {"results": [wire.result_to_public_json(r) for r in resp.results]}
+        if resp.column_attr_sets is not None:
+            out["columnAttrs"] = [s.to_json() for s in resp.column_attr_sets]
+        self._reply(out)
 
     @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def post_import(self, index: str, field: str):

@@ -262,6 +262,31 @@ def test_resize_add_node():
 # ---------------------------------------------------------------------------
 
 
+def test_query_response_attrs_http():
+    """columnAttrs / excludeRowAttrs / excludeColumns over HTTP
+    (reference: http/handler.go handlePostQuery option params)."""
+    with ClusterHarness(1, in_memory=True) as harness:
+        uri = harness[0].node.uri
+        http_json("POST", f"{uri}/index/qa", {"options": {}})
+        http_json("POST", f"{uri}/index/qa/field/qf", {"options": {"type": "set"}})
+        http_json("POST", f"{uri}/index/qa/query", {"query": "Set(1, qf=1)"})
+        http_json(
+            "POST", f"{uri}/index/qa/query",
+            {"query": 'SetRowAttrs(qf, 1, tag="t1") SetColumnAttrs(1, c="x")'},
+        )
+        r = http_json(
+            "POST", f"{uri}/index/qa/query",
+            {"query": "Row(qf=1)", "columnAttrs": True},
+        )
+        assert r["results"][0]["attrs"] == {"tag": "t1"}
+        assert r["columnAttrs"] == [{"id": 1, "attrs": {"c": "x"}}]
+        r = http_json(
+            "POST", f"{uri}/index/qa/query",
+            {"query": "Row(qf=1)", "excludeRowAttrs": True, "excludeColumns": True},
+        )
+        assert r["results"][0] == {"attrs": {}, "columns": []}
+
+
 def test_import_export_roaring_http():
     from pilosa_tpu.core import roaring_io
     from pilosa_tpu.shardwidth import SHARD_WIDTH
