@@ -669,7 +669,7 @@ class Executor:
         fan-out partials are merged and re-finished there)."""
         if opt is None or opt.remote:
             return row
-        if c.name in ("Row", "Range") and not any(
+        if c.name == "Row" and not any(
             isinstance(v, Condition) for v in c.args.values()
         ):
             if opt.exclude_row_attrs:
@@ -1016,12 +1016,15 @@ class Executor:
             from pilosa_tpu.ops import bsi as obsi
 
             depth = f.options.bit_depth
-            cnt, pos, neg = obsi.sum_counts_stacked(
-                planes, exists, sign, exists if filt is None else filt, depth
-            )
-            count = int(np.asarray(cnt, dtype=np.uint64).sum())
-            pos = np.asarray(pos, dtype=np.uint64).reshape(depth, -1).sum(axis=1)
-            neg = np.asarray(neg, dtype=np.uint64).reshape(depth, -1).sum(axis=1)
+            fused = np.asarray(
+                obsi.sum_counts_stacked(
+                    planes, exists, sign, exists if filt is None else filt, depth
+                ),
+                dtype=np.uint64,
+            )  # ONE device read: [1 + 2*depth, S]
+            count = int(fused[0].sum())
+            pos = fused[1 : 1 + depth].sum(axis=1)
+            neg = fused[1 + depth :].sum(axis=1)
             total = sum(
                 (1 << i) * (int(pos[i]) - int(neg[i])) for i in range(depth)
             )
@@ -1054,19 +1057,23 @@ class Executor:
             exists, sign, planes, filt = st
             from pilosa_tpu.ops import bsi as obsi
 
-            val, cnts, any_ = obsi.min_max_signed(
-                planes,
-                exists,
-                sign,
-                exists if filt is None else filt,
-                f.options.bit_depth,
-                is_min,
-            )
-            if not bool(any_):
+            fused = np.asarray(
+                obsi.min_max_signed(
+                    planes,
+                    exists,
+                    sign,
+                    exists if filt is None else filt,
+                    f.options.bit_depth,
+                    is_min,
+                ),
+                dtype=np.uint64,
+            )  # ONE device read: [magnitude, negative, any, counts...]
+            if not fused[2]:
                 return ValCount(0, 0)
+            mag = int(fused[0])
             return ValCount(
-                value=int(val) + f.options.base,
-                count=int(np.asarray(cnts, dtype=np.uint64).sum()),
+                value=(-mag if fused[1] else mag) + f.options.base,
+                count=int(fused[3:].sum()),
             )
         bsiv = f.view(f.bsi_view_name())
         best: Optional[Tuple[int, int]] = None
@@ -1350,7 +1357,7 @@ class Executor:
         if attr_name and attr_values:
             filters = {fv for fv in attr_values if fv is not None}
         use_tan = tanimoto > 0 and src is not None
-        if use_tan or src is not None:
+        if src is not None:
             src_count = int(ob.popcount(src))
         if use_tan:
             # exclusive count window around the Tanimoto-feasible region

@@ -56,29 +56,29 @@ def sum_counts(planes, exists, sign, filter_words, bit_depth: int):
 def sum_counts_stacked(planes, exists, sign, filter_words, bit_depth: int):
     """sum_counts over stacked operands: planes uint32[D, S, W], the rest
     uint32[S, W]. Counts reduce over the word axis only, returning per-shard
-    partials (count[S], pos[D, S], neg[D, S]) the host sums in exact Python
-    ints — per-shard partials can never overflow uint32 (a shard holds at
-    most 2^20 bits), while a whole-stack uint32 sum could at >4B columns."""
+    partials the host sums in exact Python ints — per-shard partials can
+    never overflow uint32 (a shard holds at most 2^20 bits), while a
+    whole-stack uint32 sum could at >4B columns.
+
+    Returns ONE fused uint32[1 + 2*D, S] array — row 0 the considered
+    count, rows 1..D the positive-branch plane counts, rows D+1..2D the
+    negative branch — so the host pays a single device read (three
+    separate outputs cost three round trips on tunneled hardware)."""
     consider = jnp.bitwise_and(exists, filter_words)
     nrow = jnp.bitwise_and(sign, consider)
     prow = jnp.bitwise_and(consider, jnp.bitwise_not(sign))
     count = jnp.sum(_pc(consider), axis=-1, dtype=jnp.uint32)
-    if bit_depth == 0:  # static: all stored values are 0 (or base only)
-        z = jnp.zeros((0,) + count.shape, jnp.uint32)
-        return count, z, z
-    pos = jnp.stack(
-        [
-            jnp.sum(_pc(jnp.bitwise_and(planes[i], prow)), axis=-1, dtype=jnp.uint32)
-            for i in range(bit_depth)
-        ]
-    )
-    neg = jnp.stack(
-        [
-            jnp.sum(_pc(jnp.bitwise_and(planes[i], nrow)), axis=-1, dtype=jnp.uint32)
-            for i in range(bit_depth)
-        ]
-    )
-    return count, pos, neg
+    rows = [count[None]]
+    for branch in (prow, nrow):
+        for i in range(bit_depth):
+            rows.append(
+                jnp.sum(
+                    _pc(jnp.bitwise_and(planes[i], branch)),
+                    axis=-1,
+                    dtype=jnp.uint32,
+                )[None]
+            )
+    return jnp.concatenate(rows, axis=0)
 
 
 @partial(jax.jit, static_argnames=("bit_depth",))
@@ -119,10 +119,14 @@ def min_max_signed(planes, exists, sign, filter_words, bit_depth: int, is_min: b
     Fragment.min/max's sign decomposition, fragment.go:1146/1191), shape-
     generic over [W] or stacked [S, W] operands.
 
-    Returns (value int64, per-shard attain-counts uint32[...], any bool):
-    `any` False means no considered columns. Both sign-branch ladders are
-    evaluated and selected with `where` — they are cheap elementwise passes
-    XLA fuses into one HBM sweep."""
+    Returns ONE fused uint32 1-D array [magnitude, negative, any,
+    counts...] — the unsigned min/max magnitude (exact for any bit_depth
+    <= 32; the sign is the separate `negative` 0/1 flag so no signed cast
+    can truncate), `any` 0/1 for whether any column is considered, then
+    the per-shard attain-counts flattened — a single device read instead
+    of three round trips. Both sign-branch ladders are evaluated and
+    selected with `where` — cheap elementwise passes XLA fuses into one
+    HBM sweep."""
     consider = jnp.bitwise_and(exists, filter_words)
     negatives = jnp.bitwise_and(consider, sign)
     positives = jnp.bitwise_and(consider, jnp.bitwise_not(sign))
@@ -132,16 +136,24 @@ def min_max_signed(planes, exists, sign, filter_words, bit_depth: int, is_min: b
         branch = jnp.any(negatives != 0)
         bval, bfilt = max_unsigned(planes, negatives, bit_depth)
         oval, ofilt = min_unsigned(planes, consider, bit_depth)
-        val = jnp.where(branch, -bval.astype(jnp.int64), oval.astype(jnp.int64))
+        negative = branch
     else:
         # positives present -> max among positives; else -min magnitude
         branch = jnp.any(positives != 0)
         bval, bfilt = max_unsigned(planes, positives, bit_depth)
         oval, ofilt = min_unsigned(planes, consider, bit_depth)
-        val = jnp.where(branch, bval.astype(jnp.int64), -oval.astype(jnp.int64))
+        negative = jnp.logical_not(branch)
+    mag = jnp.where(branch, bval, oval)
     final = jnp.where(branch, bfilt, ofilt)
     counts = jnp.sum(_pc(final), axis=-1, dtype=jnp.uint32)
-    return val, counts, any_
+    return jnp.concatenate(
+        [
+            mag.astype(jnp.uint32)[None],
+            negative.astype(jnp.uint32)[None],
+            any_.astype(jnp.uint32)[None],
+            counts.ravel(),
+        ]
+    )
 
 
 # ---------------------------------------------------------------------------
