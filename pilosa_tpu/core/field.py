@@ -143,6 +143,9 @@ class Field:
                 self.load_meta()
             else:
                 self.save_meta()
+            if os.path.exists(self._avail_path):
+                with open(self._avail_path) as f:
+                    self.remote_available_shards.update(json.load(f))
             views_dir = os.path.join(self.path, "views")
             if os.path.isdir(views_dir):
                 for vname in sorted(os.listdir(views_dir)):
@@ -169,6 +172,31 @@ class Field:
         with open(self.meta_path) as f:
             data = json.load(f)
         self.options = FieldOptions(**data)
+
+    @property
+    def _avail_path(self) -> Optional[str]:
+        return (
+            None
+            if self.path is None
+            else os.path.join(self.path, ".available.shards.json")
+        )
+
+    def add_remote_available(self, shards) -> None:
+        """Merge cluster-announced shards into the availability set and
+        persist it, so a restarted node still knows which shards exist
+        cluster-wide even if it holds no local fragment for them
+        (reference: .available.shards protobuf sidecar, field.go:290-345)."""
+        with self._mu:
+            new = {int(s) for s in shards} - self.remote_available_shards
+            if not new:
+                return
+            self.remote_available_shards.update(new)
+            p = self._avail_path
+            if p is not None:
+                tmp = p + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(sorted(self.remote_available_shards), f)
+                os.replace(tmp, p)
 
     # ------------------------------------------------------------------
     # views

@@ -297,7 +297,7 @@ class DistributedExecutor(Executor):
         # remote_available_shards doubles as "already announced cluster-wide"
         if shard in f.remote_available_shards:
             return
-        f.remote_available_shards.add(shard)
+        f.add_remote_available([shard])
         msg = {
             "type": "available-shards",
             "index": idx.name,

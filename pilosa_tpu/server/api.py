@@ -334,6 +334,8 @@ class API:
     ) -> None:
         owners = self.cluster.shard_nodes(idx.name, shard)
         targets = [self.server.node] if local_only else owners
+        applied = 0
+        errors = []
         for n in targets:
             if n.id == self.server.node.id:
                 ts = (
@@ -343,12 +345,30 @@ class API:
                 )
                 f.import_bits(rows, cols, timestamps=ts, clear=clear)
                 idx.track_columns(cols)
+                applied += 1
             else:
-                self.server.client.import_bits(
-                    n.uri, idx.name, f.name, shard,
-                    rows.tolist(), cols.tolist(), clear,
-                    timestamps=timestamps,
-                )
+                # replica fan-out is best-effort per owner: a down replica
+                # is repaired by anti-entropy after it returns (divergence
+                # from the reference, which blocks writes in DEGRADED;
+                # availability is the TPU-native choice here). Zero live
+                # owners is still an error — nothing accepted the write.
+                from pilosa_tpu.server.client import ClientError
+
+                try:
+                    self.server.client.import_bits(
+                        n.uri, idx.name, f.name, shard,
+                        rows.tolist(), cols.tolist(), clear,
+                        timestamps=timestamps,
+                    )
+                    applied += 1
+                except ClientError as e:
+                    errors.append(f"{n.id}: {e}")
+                    self.server.logger(
+                        f"import shard {shard} to replica {n.id} failed "
+                        f"(anti-entropy will repair): {e}"
+                    )
+        if not applied:
+            raise ApiError(f"import shard {shard}: no owner reachable: {errors}")
         if not local_only:
             self._announce_shard(idx.name, f.name, shard)
 
@@ -486,6 +506,64 @@ class API:
         self.holder.recalculate_caches()
         self._broadcast({"type": "recalculate-caches"})
 
+    # -- cluster lifecycle (cluster.go:1141-1561, api.go:1226-1250) --------
+
+    def cluster_join(self, node: dict) -> dict:
+        """Admit a node: coordinator drives a resize job adding it to the
+        membership (reference: nodeJoin -> listenForJoins -> resize job,
+        cluster.go:1796,1141). Returns the job record (poll resize_job)."""
+        self._validate("cluster_join", write=True)
+        from pilosa_tpu.cluster.topology import Node
+
+        joiner = Node.from_json(node)
+        if not joiner.id or not joiner.uri:
+            raise ApiError("join requires node id and uri")
+        # a fresh node self-reports as its own coordinator; it joins as a
+        # plain member (one coordinator per cluster)
+        joiner.is_coordinator = False
+        cur = self.server.cluster.nodes
+        if any(n.id == joiner.id for n in cur):
+            # idempotent re-join of a known member: nothing to move
+            return {"state": "DONE", "action": "noop", "nodes": [n.to_json() for n in cur]}
+        from pilosa_tpu.server.client import ClientError
+
+        try:
+            return self.server.start_resize(list(cur) + [joiner], "add-node")
+        except ClientError as e:
+            raise ApiError(str(e))
+
+    def remove_node(self, node_id: str) -> dict:
+        """Reference: api.go:1226 RemoveNode -> nodeLeave resize."""
+        self._validate("remove_node", write=True)
+        from pilosa_tpu.cluster.topology import Node
+
+        cur = self.server.cluster.nodes
+        if not any(n.id == node_id for n in cur):
+            raise NotFoundError(f"node not in cluster: {node_id}")
+        remaining = [
+            Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+            for n in cur
+            if n.id != node_id
+        ]
+        if not remaining:
+            raise ApiError("cannot remove the last node")
+        # removing the coordinator transfers coordinatorship (the role of
+        # the reference's set-coordinator message, cluster.go:311)
+        if not any(n.is_coordinator for n in remaining):
+            remaining[0].is_coordinator = True
+        from pilosa_tpu.server.client import ClientError
+
+        try:
+            return self.server.start_resize(remaining, "remove-node")
+        except ClientError as e:
+            raise ApiError(str(e))
+
+    def resize_abort(self) -> dict:
+        return self.server.abort_resize()
+
+    def resize_job(self) -> dict:
+        return self.server.resize_job or {"state": "NONE"}
+
     # -- cluster info ------------------------------------------------------
 
     def status(self) -> dict:
@@ -544,7 +622,7 @@ class API:
             if idx is not None:
                 f = idx.field(msg["field"])
                 if f is not None:
-                    f.remote_available_shards.update(int(s) for s in msg["shards"])
+                    f.add_remote_available(msg["shards"])
         elif t == "cluster-status":
             self.server.apply_cluster_status(msg)
         elif t == "node-state":

@@ -108,6 +108,43 @@ class InternalClient:
             "POST", uri, "/internal/cluster/message", json.dumps(message).encode()
         ) or {}
 
+    # -- resize orchestration (cluster.go:1297 followResizeInstruction) ----
+
+    def resize_node(
+        self,
+        uri: str,
+        nodes: List[dict],
+        old_nodes: Optional[List[dict]] = None,
+        replica_n: Optional[int] = None,
+        schema: Optional[List[dict]] = None,
+        timeout: float = 300.0,
+    ) -> dict:
+        """Tell one node to reshard itself to the new membership (the
+        coordinator's per-node step of a resize job). Joining nodes get the
+        old membership (their own view is just themselves) and the schema."""
+        body: Dict[str, Any] = {"nodes": nodes}
+        if old_nodes is not None:
+            body["oldNodes"] = old_nodes
+        if replica_n is not None:
+            body["replicaN"] = replica_n
+        if schema is not None:
+            body["schema"] = schema
+        return self._json(
+            "POST", uri, "/internal/resize", json.dumps(body).encode(),
+            timeout=timeout,
+        ) or {}
+
+    def join_cluster(self, coordinator_uri: str, node: dict) -> dict:
+        """Ask the coordinator to admit a node (reference: gossip nodeJoin,
+        cluster.go:1796; here an explicit HTTP join per the static-mesh
+        membership design). Returns the resize job record."""
+        return self._json(
+            "POST",
+            coordinator_uri,
+            "/cluster/join",
+            json.dumps(node).encode(),
+        ) or {}
+
     # -- imports (http/client.go:319-669) ----------------------------------
 
     def import_bits(
@@ -251,6 +288,11 @@ class InternalClient:
         )
 
     # -- translate replication (http/translator.go:44) ---------------------
+
+    def available_shards(self, uri: str, index: str) -> Dict[str, List[int]]:
+        """Peer's per-field cluster-known shards (NodeStatus merge analog)."""
+        resp = self._json("GET", uri, f"/internal/index/{index}/available-shards")
+        return {k: [int(s) for s in v] for k, v in resp.get("fields", {}).items()}
 
     def fragment_inventory(self, uri: str, index: str) -> List[Tuple[str, str, int]]:
         resp = self._json("GET", uri, f"/internal/index/{index}/fragments")

@@ -301,6 +301,68 @@ class Handler(BaseHTTPRequestHandler):
     def post_cluster_message(self):
         self._reply(self.api.receive_message(self._json_body()))
 
+    # -- cluster lifecycle (cluster.go:1141-1561; api.go:1226-1250) --------
+
+    @route("POST", "/cluster/join")
+    def post_cluster_join(self):
+        self._reply(self.api.cluster_join(self._json_body()))
+
+    @route("POST", "/cluster/resize/remove-node")
+    def post_remove_node(self):
+        self._reply(self.api.remove_node(self._json_body().get("id", "")))
+
+    @route("POST", "/cluster/resize/abort")
+    def post_resize_abort(self):
+        self._reply(self.api.resize_abort())
+
+    @route("GET", "/cluster/resize/job")
+    def get_resize_job(self):
+        self._reply(self.api.resize_job())
+
+    @route("GET", "/internal/index/(?P<index>[^/]+)/available-shards")
+    def get_available_shards(self, index: str):
+        """Per-field cluster-known shards (the NodeStatus availableShards
+        exchange of the reference's gossip state merge, gossip.go:295-362;
+        here pulled over HTTP at anti-entropy time)."""
+        idx = self.node.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        self._reply(
+            {
+                "fields": {
+                    f.name: sorted(f.available_shards())
+                    for f in idx.fields(include_hidden=True)
+                }
+            }
+        )
+
+    @route("POST", "/internal/sync")
+    def post_internal_sync(self):
+        """Trigger one anti-entropy pass now (operational hook; the loop
+        runs on anti-entropy.interval — server.go:514 monitorAntiEntropy)."""
+        self._reply({"synced": self.node.sync_holder()})
+
+    @route("POST", "/internal/resize")
+    def post_internal_resize(self):
+        """One node's step of a coordinator-driven resize: apply schema if
+        supplied (joining nodes), then reshard to the new membership
+        (cluster.go:1297 followResizeInstruction, checkpoint-based)."""
+        d = self._json_body()
+        from pilosa_tpu.cluster.topology import Node as TNode
+
+        if d.get("schema"):
+            self.api.apply_schema(d["schema"])
+        fetched = self.node.resize_to(
+            [TNode.from_json(n) for n in d["nodes"]],
+            replica_n=d.get("replicaN"),
+            old_nodes=(
+                [TNode.from_json(n) for n in d["oldNodes"]]
+                if d.get("oldNodes")
+                else None
+            ),
+        )
+        self._reply({"fetched": fetched})
+
     @route("POST", "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def post_internal_import(self, index: str, field: str):
         d = self._json_body()

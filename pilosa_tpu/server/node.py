@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 from pilosa_tpu.cluster.topology import (
     STATE_NORMAL,
+    STATE_RESIZING,
     Cluster,
     JumpHasher,
     Node,
@@ -29,6 +30,10 @@ from pilosa_tpu.cluster import antientropy
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.exec.distributed import DistributedExecutor
 from pilosa_tpu.server.client import ClientError, InternalClient
+
+
+class _ResizeAborted(Exception):
+    pass
 
 
 class NodeServer:
@@ -49,7 +54,9 @@ class NodeServer:
         logger=None,
     ):
         self.data_dir = data_dir
-        self.node = Node(id=node_id, uri="")
+        # a fresh node is its own coordinator until a topology install says
+        # otherwise (set_topology syncs identity from the membership list)
+        self.node = Node(id=node_id, uri="", is_coordinator=True)
         self.bind = bind
         self.cluster = Cluster(
             nodes=[self.node], replica_n=replica_n, hasher=hasher or JumpHasher()
@@ -79,6 +86,12 @@ class NodeServer:
         self._probe_thread = None
         self._closing = threading.Event()
         self._down_ids: set = set()
+        # coordinator-driven resize job (cluster.go:1447-1561 resizeJob):
+        # at most one at a time; RUNNING -> DONE | ABORTED
+        self.resize_job: Optional[dict] = None
+        self._resize_mu = threading.Lock()
+        self._resize_abort = threading.Event()
+        self._resize_thread: Optional[threading.Thread] = None
 
         from pilosa_tpu.server.api import API
 
@@ -274,9 +287,29 @@ class NodeServer:
         this node PRIMARY-owns, reconcile all replicas via block checksums
         + majority-vote merge (fragment.go:2861 syncFragment). Returns the
         number of fragments that needed repair."""
-        if self.cluster.replica_n <= 1 or len(self.cluster.nodes) <= 1:
+        if len(self.cluster.nodes) <= 1:
             return 0
         repaired = 0
+        # merge peers' availability first: a node restarted after missing
+        # shard announcements must re-learn which shards exist cluster-wide
+        # (the reference's gossip NodeStatus state merge, gossip.go:295-362).
+        # This runs even at replica_n=1 — availability is about query
+        # fan-out correctness, not replica repair.
+        for idx in self.holder.indexes():
+            for peer in self.cluster.nodes:
+                if peer.id == self.node.id or peer.state == "DOWN":
+                    continue
+                try:
+                    for fname, shards in self.client.available_shards(
+                        peer.uri, idx.name
+                    ).items():
+                        f = idx.field(fname)
+                        if f is not None:
+                            f.add_remote_available(shards)
+                except ClientError:
+                    continue
+        if self.cluster.replica_n <= 1:
+            return 0
         for idx in self.holder.indexes():
             for f in idx.fields(include_hidden=True):
                 for vname, v in list(f.views.items()):
@@ -394,7 +427,7 @@ class NodeServer:
             for fl, vw, sh in inventory:
                 f = idx.field(fl)
                 if f is not None:
-                    f.remote_available_shards.add(sh)
+                    f.add_remote_available([sh])
             sources = old.frag_sources(new, idx.name, frags)
             for src in sources.get(self.node.id, []):
                 f = idx.field(src.field)
@@ -412,3 +445,131 @@ class NodeServer:
                 fetched += 1
         self.set_topology(new_nodes, replica_n=new.replica_n)
         return fetched
+
+    # -- coordinator-driven resize jobs (cluster.go:1141-1561) -------------
+
+    def start_resize(
+        self,
+        new_nodes: List[Node],
+        action: str,
+        replica_n: Optional[int] = None,
+    ) -> dict:
+        """Start a coordinator-driven resize job: order every node through
+        resize_to under a RUNNING/DONE/ABORTED job record, with rollback of
+        the old topology on failure or abort (the role of the reference's
+        listenForJoins -> generateResizeJob -> resizeJob.run,
+        cluster.go:1141,1196,1504 — checkpoint-streaming instead of live
+        ResizeInstructions, per the TPU-native static-mesh design).
+        Returns the job record immediately; poll `resize_job` for state."""
+        if not self.node.is_coordinator:
+            raise ClientError("node is not the coordinator")
+        with self._resize_mu:
+            if self.resize_job is not None and self.resize_job["state"] == "RUNNING":
+                raise ClientError("a resize job is already running")
+            job = {
+                "id": f"{self.node.id}-{int(time.time() * 1000)}",
+                "action": action,
+                "state": "RUNNING",
+                "nodes": [n.to_json() for n in new_nodes],
+                "error": None,
+            }
+            self.resize_job = job
+            self._resize_abort.clear()
+            self._resize_thread = threading.Thread(
+                target=self._run_resize,
+                args=(job, list(new_nodes), replica_n),
+                name=f"resize-{self.node.id}",
+                daemon=True,
+            )
+            self._resize_thread.start()
+        return job
+
+    def abort_resize(self) -> dict:
+        """Abort path (reference: api.go:1250 ResizeAbort). The running job
+        notices between per-node steps and rolls back the old topology."""
+        self._resize_abort.set()
+        return self.resize_job or {"state": "NONE"}
+
+    def _run_resize(self, job: dict, new_nodes: List[Node], replica_n) -> None:
+        old_members = list(self.cluster.nodes)
+        old_replica = self.cluster.replica_n
+        old_ids = {n.id for n in old_members}
+        new_ids = {n.id for n in new_nodes}
+        joiners = [n for n in new_nodes if n.id not in old_ids]
+        removed = [n for n in old_members if n.id not in new_ids]
+        schema = self.api.schema()
+
+        def rollback() -> None:
+            # restore the old membership on the old members; any joiner
+            # that already installed the new topology is reset to a
+            # standalone single-node cluster (it never became a member)
+            self._send_status(old_members, old_members, old_replica, STATE_NORMAL)
+            for n in joiners:
+                solo = Node(id=n.id, uri=n.uri, is_coordinator=True)
+                self._send_status([solo], [solo], 1, STATE_NORMAL)
+
+        try:
+            # freeze writes cluster-wide while fragments move
+            self._send_status(old_members, old_members, old_replica, STATE_RESIZING)
+            # existing members first (they fetch from current owners while
+            # everyone still holds their old fragments), joiners last
+            order = [n for n in new_nodes if n.id in old_ids] + [
+                n for n in new_nodes if n.id not in old_ids
+            ]
+            for n in order:
+                if self._resize_abort.is_set():
+                    raise _ResizeAborted()
+                joining = n.id not in old_ids
+                if n.id == self.node.id:
+                    self.resize_to(new_nodes, replica_n=replica_n)
+                else:
+                    self.client.resize_node(
+                        n.uri,
+                        [m.to_json() for m in new_nodes],
+                        old_nodes=(
+                            [m.to_json() for m in old_members] if joining else None
+                        ),
+                        replica_n=replica_n,
+                        schema=schema if joining else None,
+                    )
+            new_replica = replica_n if replica_n is not None else old_replica
+            # removed nodes get the final status too: they unfreeze from
+            # RESIZING and learn they are no longer members
+            self._send_status(
+                new_nodes + removed, new_nodes, new_replica, STATE_NORMAL
+            )
+            job["state"] = "DONE"
+        except _ResizeAborted:
+            rollback()
+            job["state"] = "ABORTED"
+            job["error"] = "aborted"
+        except Exception as e:  # noqa: BLE001 - job record carries the error
+            rollback()
+            job["state"] = "ABORTED"
+            job["error"] = str(e)
+            self.logger(f"resize job {job['id']} aborted: {e}")
+
+    def _send_status(
+        self,
+        to_nodes: List[Node],
+        member_nodes: List[Node],
+        replica_n: int,
+        state: str,
+    ) -> None:
+        """Deliver a cluster-status to a node set (the RESIZING/NORMAL
+        broadcasts of resizeJob.run; best-effort to unreachable nodes,
+        which the probe loop will mark DOWN anyway)."""
+        msg = {
+            "type": "cluster-status",
+            "nodes": [m.to_json() for m in member_nodes],
+            "replicaN": replica_n,
+            "state": state,
+        }
+        for n in to_nodes:
+            if n.id == self.node.id:
+                self.apply_cluster_status(msg)
+                continue
+            try:
+                self.client.send_message(n.uri, msg)
+            except ClientError as e:
+                self.logger(f"cluster-status to {n.id}: {e}")
