@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pilosa_tpu.server import wire
 from pilosa_tpu.utils import tracing
 
 DEFAULT_TIMEOUT = 30.0
@@ -42,6 +43,7 @@ class InternalClient:
         query: Optional[Dict[str, Any]] = None,
         content_type: str = "application/json",
         timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> bytes:
         url = uri.rstrip("/") + path
         if query:
@@ -49,6 +51,9 @@ class InternalClient:
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        if headers:
+            for k, v in headers.items():
+                req.add_header(k, v)
         # propagate trace context to the peer (reference: http/client.go
         # wraps every request with tracing.InjectHTTPHeaders)
         span = tracing.current_span()
@@ -78,8 +83,6 @@ class InternalClient:
         shards: Optional[Sequence[int]] = None,
         remote: bool = False,
     ) -> List[Any]:
-        from pilosa_tpu.server import wire
-
         body = {"query": query, "remote": remote}
         if shards is not None:
             body["shards"] = list(shards)
@@ -158,14 +161,25 @@ class InternalClient:
         clear: bool = False,
         timestamps: Optional[Sequence[Optional[str]]] = None,
     ) -> None:
+        if timestamps is None:
+            # binary data plane: raw u64 arrays instead of JSON number
+            # lists (the reference ships protobuf here, http/client.go:319)
+            self._do(
+                "POST",
+                uri,
+                f"/internal/index/{index}/field/{field}/import",
+                wire.encode_arrays(rows, cols),
+                query={"clear": "1"} if clear else None,
+                content_type=wire.ARRAYS_CTYPE,
+            )
+            return
         body = {
             "shard": shard,
             "rows": [int(r) for r in rows],
             "cols": [int(c) for c in cols],
             "clear": clear,
+            "timestamps": list(timestamps),
         }
-        if timestamps is not None:
-            body["timestamps"] = list(timestamps)
         self._do(
             "POST",
             uri,
@@ -182,16 +196,13 @@ class InternalClient:
         cols: Sequence[int],
         values: Sequence[int],
     ) -> None:
-        body = {
-            "shard": shard,
-            "cols": [int(c) for c in cols],
-            "values": [int(v) for v in values],
-        }
+        vals = np.asarray(values, np.int64).view(np.uint64)  # two's-complement
         self._do(
             "POST",
             uri,
             f"/internal/index/{index}/field/{field}/import-value",
-            json.dumps(body).encode(),
+            wire.encode_arrays(np.asarray(cols, np.uint64), vals),
+            content_type=wire.ARRAYS_CTYPE,
         )
 
     def import_roaring(
@@ -236,7 +247,7 @@ class InternalClient:
     def block_data(
         self, uri: str, index: str, field: str, view: str, shard: int, block: int
     ) -> Tuple[np.ndarray, np.ndarray]:
-        resp = self._json(
+        data = self._do(
             "GET",
             uri,
             "/internal/fragment/block/data",
@@ -247,11 +258,10 @@ class InternalClient:
                 "shard": shard,
                 "block": block,
             },
+            headers={"Accept": wire.ARRAYS_CTYPE},
         )
-        return (
-            np.array(resp.get("rows", []), np.uint64),
-            np.array(resp.get("cols", []), np.uint64),
-        )
+        rows, cols = wire.decode_arrays(data, 2)
+        return rows, cols
 
     def send_block_deltas(
         self,
@@ -263,16 +273,13 @@ class InternalClient:
         sets: Tuple[np.ndarray, np.ndarray],
         clears: Tuple[np.ndarray, np.ndarray],
     ) -> None:
-        body = {
-            "index": index,
-            "field": field,
-            "view": view,
-            "shard": shard,
-            "sets": {"rows": sets[0].tolist(), "cols": sets[1].tolist()},
-            "clears": {"rows": clears[0].tolist(), "cols": clears[1].tolist()},
-        }
         self._do(
-            "POST", uri, "/internal/fragment/block/deltas", json.dumps(body).encode()
+            "POST",
+            uri,
+            "/internal/fragment/block/deltas",
+            wire.encode_arrays(sets[0], sets[1], clears[0], clears[1]),
+            query={"index": index, "field": field, "view": view, "shard": shard},
+            content_type=wire.ARRAYS_CTYPE,
         )
 
     # -- fragment streaming for resize (http/client.go:742) ----------------

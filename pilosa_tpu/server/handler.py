@@ -365,21 +365,42 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("POST", "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def post_internal_import(self, index: str, field: str):
-        d = self._json_body()
-        self.api.import_bits(
-            index, field, d.get("rows", []), d.get("cols", []),
-            clear=d.get("clear", False),
-            timestamps=d.get("timestamps"),
-            local_only=True,
-        )
+        """Replica-side bulk import. Body is either the binary array
+        stream (rows, cols; clear via ?clear=1) or JSON — timestamped
+        (time-field) imports stay JSON (http/client.go:319 protobuf body
+        analog)."""
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == wire.ARRAYS_CTYPE:
+            rows, cols = wire.decode_arrays(self._body(), 2)
+            self.api.import_bits(
+                index, field, rows, cols,
+                clear=self.query.get("clear", "") in ("1", "true"),
+                local_only=True,
+            )
+        else:
+            d = self._json_body()
+            self.api.import_bits(
+                index, field, d.get("rows", []), d.get("cols", []),
+                clear=d.get("clear", False),
+                timestamps=d.get("timestamps"),
+                local_only=True,
+            )
         self._reply({})
 
     @route("POST", "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-value")
     def post_internal_import_value(self, index: str, field: str):
-        d = self._json_body()
-        self.api.import_values(
-            index, field, d.get("cols", []), d.get("values", []), local_only=True
-        )
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == wire.ARRAYS_CTYPE:
+            cols, vals_u64 = wire.decode_arrays(self._body(), 2)
+            # values travel as uint64 two's-complement (BSI values are signed)
+            self.api.import_values(
+                index, field, cols, vals_u64.view(np.int64), local_only=True
+            )
+        else:
+            d = self._json_body()
+            self.api.import_values(
+                index, field, d.get("cols", []), d.get("values", []), local_only=True
+            )
         self._reply({})
 
     def _fragment(self):
@@ -402,16 +423,38 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/internal/fragment/block/data")
     def get_block_data(self):
+        binary = wire.ARRAYS_CTYPE in (self.headers.get("Accept") or "")
         frag = self._fragment()
         if frag is None:
-            self._reply({"rows": [], "cols": []})
-            return
-        rows, cols = frag.block_pairs(int(self.query["block"]))
-        self._reply({"rows": rows.tolist(), "cols": cols.tolist()})
+            rows = cols = np.zeros(0, np.uint64)
+        else:
+            rows, cols = frag.block_pairs(int(self.query["block"]))
+        if binary:
+            self._reply(
+                None,
+                raw=wire.encode_arrays(rows, cols),
+                content_type=wire.ARRAYS_CTYPE,
+            )
+        else:
+            self._reply({"rows": rows.tolist(), "cols": cols.tolist()})
 
     @route("POST", "/internal/fragment/block/deltas")
     def post_block_deltas(self):
-        d = self._json_body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == wire.ARRAYS_CTYPE:
+            d = dict(self.query)
+            sr, sc, cr, cc = wire.decode_arrays(self._body(), 4)
+            sets, clears = (sr, sc), (cr, cc)
+        else:
+            d = self._json_body()
+            sets = (
+                np.array(d["sets"]["rows"], np.uint64),
+                np.array(d["sets"]["cols"], np.uint64),
+            )
+            clears = (
+                np.array(d["clears"]["rows"], np.uint64),
+                np.array(d["clears"]["cols"], np.uint64),
+            )
         idx = self.node.holder.index(d["index"])
         if idx is None:
             raise NotFoundError(f"index not found: {d['index']}")
@@ -420,16 +463,7 @@ class Handler(BaseHTTPRequestHandler):
             raise NotFoundError(f"field not found: {d['field']}")
         v = f._view_create(d.get("view", "standard"))
         frag = v.fragment(int(d["shard"]))
-        frag.apply_deltas(
-            (
-                np.array(d["sets"]["rows"], np.uint64),
-                np.array(d["sets"]["cols"], np.uint64),
-            ),
-            (
-                np.array(d["clears"]["rows"], np.uint64),
-                np.array(d["clears"]["cols"], np.uint64),
-            ),
-        )
+        frag.apply_deltas(sets, clears)
         self._reply({})
 
     @route("GET", "/internal/fragment/data")

@@ -13,6 +13,7 @@ column list."""
 from __future__ import annotations
 
 import base64
+import struct
 from typing import Any, Dict, List
 
 import numpy as np
@@ -20,6 +21,52 @@ import numpy as np
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.exec.executor import FieldRow, GroupCount, Pair, ValCount
 from pilosa_tpu.ops import bitmap as ob
+
+# -- binary array streams (bulk data plane) ---------------------------------
+#
+# Raw little-endian uint64 arrays with a magic + length-prefixed framing,
+# replacing JSON number lists for the bulk internode paths (imports, block
+# deltas/data) — the role of the reference's protobuf bodies
+# (encoding/proto/proto.go; http/client.go:319-669). JSON stays on the
+# control plane; these are ~8 bytes/value instead of ~8-20 chars + parse.
+
+ARRAYS_MAGIC = b"PTA1"
+ARRAYS_CTYPE = "application/octet-stream"
+_MAX_ARRAY_BYTES = 1 << 31  # 2 GiB bound: reject absurd length prefixes
+
+
+def encode_arrays(*arrays) -> bytes:
+    """magic | u32 n_arrays | per array: u32 length | raw <u8 bytes."""
+    parts = [ARRAYS_MAGIC, struct.pack("<I", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a, dtype=np.uint64))
+        parts.append(struct.pack("<I", a.size))
+        parts.append(a.astype("<u8", copy=False).tobytes())
+    return b"".join(parts)
+
+
+def decode_arrays(data: bytes, expect: int) -> List[np.ndarray]:
+    """Strictly validated inverse of encode_arrays (untrusted input)."""
+    if len(data) < 8 or data[:4] != ARRAYS_MAGIC:
+        raise ValueError("bad array-stream magic")
+    (n,) = struct.unpack_from("<I", data, 4)
+    if n != expect:
+        raise ValueError(f"array-stream has {n} arrays, expected {expect}")
+    off = 8
+    out: List[np.ndarray] = []
+    for _ in range(n):
+        if off + 4 > len(data):
+            raise ValueError("truncated array-stream header")
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        nbytes = ln * 8
+        if nbytes > _MAX_ARRAY_BYTES or off + nbytes > len(data):
+            raise ValueError("truncated array-stream payload")
+        out.append(np.frombuffer(data, dtype="<u8", count=ln, offset=off).copy())
+        off += nbytes
+    if off != len(data):
+        raise ValueError("trailing bytes in array-stream")
+    return out
 
 
 def _b64_positions(words) -> str:
