@@ -262,6 +262,18 @@ class Fragment:
         with self._mu:
             return self.cache.top()
 
+    def row_counts_host(self, row_ids) -> np.ndarray:
+        """Cardinalities of the listed rows as one uint64 vector under one
+        lock acquisition (TopN pass-2 reads n_shards x n_candidates counts;
+        per-call locking would dominate)."""
+        with self._mu:
+            rows = self._rows
+            return np.fromiter(
+                (rb.count() if (rb := rows.get(r)) is not None else 0 for r in row_ids),
+                np.uint64,
+                len(row_ids),
+            )
+
     # ------------------------------------------------------------------
     # writes — everything funnels through import_positions
     # ------------------------------------------------------------------

@@ -40,18 +40,20 @@ class RowBits:
     ArrayMaxSize=4096 for 2^16-bit containers, scaled to the full shard).
     """
 
-    __slots__ = ("n_bits", "n_words", "positions", "dense")
+    __slots__ = ("n_bits", "n_words", "positions", "dense", "_n")
 
     def __init__(self, n_bits: int):
         self.n_bits = n_bits
         self.n_words = n_bits // 32
         self.positions: Optional[np.ndarray] = np.empty(0, dtype=np.uint32)
         self.dense: Optional[np.ndarray] = None
+        self._n = 0  # maintained cardinality while dense (O(1) count())
 
     # -- representation management ---------------------------------------
 
     def _maybe_densify(self):
         if self.positions is not None and len(self.positions) > self.n_words:
+            self._n = len(self.positions)
             self.dense = self._to_dense()
             self.positions = None
 
@@ -73,14 +75,23 @@ class RowBits:
     # -- reads -------------------------------------------------------------
 
     def count(self) -> int:
+        """Cardinality in O(1): maintained incrementally while dense, the
+        array length while sparse. Exact counts being free host metadata is
+        what lets TopN answer from rank caches with no device pass (the
+        reference recounts rows because its cache counts are approximate,
+        cache.go:136-300)."""
         if self.dense is not None:
-            return _popcount_words(self.dense)
+            return self._n
         return len(self.positions)
 
     def to_words(self) -> np.ndarray:
-        """Dense uint32 word vector (always a fresh/readonly-safe array)."""
+        """Dense uint32 word vector. The dense branch hands out a read-only
+        view of the live buffer (not a copy): mutating it would desync the
+        maintained cardinality, which TopN answers from with no recount."""
         if self.dense is not None:
-            return self.dense
+            w = self.dense.view()
+            w.flags.writeable = False
+            return w
         return self._to_dense()
 
     def to_positions(self) -> np.ndarray:
@@ -116,6 +127,7 @@ class RowBits:
             if before.all():
                 return 0
             uniq = np.unique(new[~before])
+            self._n += len(uniq)
             return len(uniq)
         merged = np.union1d(self.positions, new)
         changed = len(merged) - len(self.positions)
@@ -135,7 +147,8 @@ class RowBits:
             self.dense = self._to_dense()
             self.positions = None
         np.bitwise_or(self.dense, words, out=self.dense)
-        added = self.count() - before
+        self._n = _popcount_words(self.dense)
+        added = self._n - before
         self._maybe_sparsify()
         return added
 
@@ -150,8 +163,10 @@ class RowBits:
             m = np.uint32(1) << (gone & np.uint32(31))
             before = (self.dense[w] & m) != 0
             np.bitwise_and.at(self.dense, w, np.bitwise_not(m))
+            cleared = int(before.sum())
+            self._n -= cleared
             self._maybe_sparsify()
-            return int(before.sum())
+            return cleared
         kept = np.setdiff1d(self.positions, gone)
         changed = len(self.positions) - len(kept)
         self.positions = kept.astype(np.uint32)
@@ -171,6 +186,7 @@ class RowBits:
         if rep == DENSE_REP:
             rb.dense = payload.astype(np.uint32, copy=True)
             rb.positions = None
+            rb._n = _popcount_words(rb.dense)
         else:
             rb.positions = payload.astype(np.uint32, copy=True)
         return rb

@@ -148,6 +148,26 @@ class GroupCount:
         return tuple(g.row_id for g in self.group)
 
 
+@dataclass
+class _TopNSpec:
+    """Parsed + validated TopN arguments, shared by the batched and
+    per-shard paths (reference: fragment.go:1560 topOptions)."""
+
+    f: Field
+    n: int
+    ids: Optional[list]
+    threshold: int
+    attr_name: Optional[str]
+    filters: Optional[set]
+    tanimoto: int
+    src_call: Optional[Call]
+
+
+# TopN dispatch accounting: tests assert the batched path issues O(1)
+# device tallies per pass, never one per shard.
+TOPN_STATS = {"batched": 0, "fallback": 0, "tally_evals": 0}
+
+
 _COND_OP_NAME = {EQ: "eq", NEQ: "neq", LT: "lt", LTE: "lte", GT: "gt", GTE: "gte"}
 
 # Stacked (compiled mesh) query path: on by default; PILOSA_TPU_STACKED=0
@@ -1293,81 +1313,56 @@ class Executor:
             trimmed = trimmed[:n]
         return trimmed
 
-    def _topn_shards(self, idx: Index, c: Call, shards) -> List[Pair]:
-        merged: Dict[int, int] = {}
-        for shard in self._shards_for(idx, shards):
-            for pair in self._topn_shard(idx, c, shard):
-                merged[pair.id] = merged.get(pair.id, 0) + pair.count
-        pairs = [Pair(id=i, count=cnt) for i, cnt in merged.items()]
-        pairs.sort(key=lambda p: (-p.count, p.id))
-        return pairs
-
-    def _topn_shard(self, idx: Index, c: Call, shard: int) -> List[Pair]:
-        """One shard's TopN candidates, mirroring the reference's
-        fragment.top contract exactly (fragment.go:1570-1704): candidates
-        come from the rank cache in rank order (rows evicted from the cache
-        are not candidates — the documented approximation), attribute
-        filters and the Tanimoto window prune before counting, and a
-        min-heap caps the result at n with threshold-based early stop.
-        Intersection counts for all surviving candidates are computed in
-        one batched device dispatch instead of per-row."""
-        import heapq
-        import math
-
+    def _topn_parse(self, idx: Index, c: Call) -> "_TopNSpec":
+        """Validate TopN args once per pass (semantic errors raise
+        identically on the batched and per-shard paths)."""
         field_name = c.args.get("_field")
         f = self._field_of(idx, field_name)
         if f.options.type == FIELD_TYPE_INT:
             raise ExecError(f"cannot compute TopN() on integer field: {field_name!r}")
         if f.options.cache_type == "none":
             raise ExecError(f'cannot compute TopN(), field has no cache: "{field_name}"')
-        n = c.uint_arg("n") or 0
-        ids = c.args.get("ids")
-        threshold = c.uint_arg("threshold") or DEFAULT_MIN_THRESHOLD
-        attr_name = c.args.get("attrName")
-        attr_values = c.args.get("attrValues")
         tanimoto = c.uint_arg("tanimotoThreshold") or 0
         if tanimoto > 100:
             raise ExecError("Tanimoto Threshold is from 1 to 100 only")
-        src = None
-        if len(c.children) == 1:
-            src = self._bitmap_call_shard(idx, c.children[0], shard)
-            if src is None:
-                return []
-        elif len(c.children) > 1:
+        if len(c.children) > 1:
             raise ExecError("TopN() can only have one input bitmap")
-        v = f.view(VIEW_STANDARD)
-        if v is None:
-            return []
-        frag = v.fragment_if_exists(shard)
-        if frag is None:
-            return []
-        # Candidate pairs in rank order (fragment.go:1703 topBitmapPairs):
-        # explicit ids read exact counts and disable truncation (N=0);
-        # otherwise the rank cache is the pool, already sorted by count.
-        if ids:
-            n = 0
-            pairs = [(rid, frag.row_count(rid)) for rid in (int(i) for i in ids)]
-            pairs = [(rid, cnt) for rid, cnt in pairs if cnt > 0]
-            pairs.sort(key=lambda p: (-p[1], p[0]))
-        else:
-            pairs = frag.cache_top()
-        if not pairs:
-            return []
+        attr_name = c.args.get("attrName")
+        attr_values = c.args.get("attrValues")
         filters = None
         if attr_name and attr_values:
             filters = {fv for fv in attr_values if fv is not None}
-        use_tan = tanimoto > 0 and src is not None
-        if src is not None:
-            src_count = int(ob.popcount(src))
+        return _TopNSpec(
+            f=f,
+            n=c.uint_arg("n") or 0,
+            ids=c.args.get("ids"),
+            threshold=c.uint_arg("threshold") or DEFAULT_MIN_THRESHOLD,
+            attr_name=attr_name,
+            filters=filters,
+            tanimoto=tanimoto,
+            src_call=c.children[0] if c.children else None,
+        )
+
+    def _topn_pool(self, spec: "_TopNSpec", frag) -> Tuple[int, list]:
+        """One shard's candidate pool in rank order (fragment.go:1703
+        topBitmapPairs): explicit ids read exact counts and disable
+        truncation (n=0); otherwise the rank cache is the pool, already
+        sorted by count. Counts are exact O(1) host metadata either way."""
+        if spec.ids:
+            ids = [int(i) for i in spec.ids]
+            counts = frag.row_counts_host(ids)
+            pairs = [(rid, int(cnt)) for rid, cnt in zip(ids, counts) if cnt > 0]
+            pairs.sort(key=lambda p: (-p[1], p[0]))
+            return 0, pairs
+        return spec.n, frag.cache_top()
+
+    def _topn_survivors(self, spec: "_TopNSpec", pairs, use_tan: bool, src_count: int):
+        """Host-side prunes: the cache-count window/threshold and the attr
+        filter read no device data (fragment.go:1610-1668)."""
         if use_tan:
             # exclusive count window around the Tanimoto-feasible region
-            min_tan = src_count * tanimoto / 100.0
-            max_tan = src_count * 100.0 / tanimoto
-        # Host-side prunes first — the cache-count window/threshold and the
-        # attr filter read no device data — then ONE batched dispatch for
-        # the survivors' intersection counts (the reference computes them
-        # row-by-row with early exit; the decisions below depend only on
-        # the counts, so precomputing gives identical results).
+            min_tan = src_count * spec.tanimoto / 100.0
+            max_tan = src_count * 100.0 / spec.tanimoto
         survivors: List[Tuple[int, int]] = []
         for rid, cnt in pairs:
             if cnt == 0:
@@ -1375,50 +1370,255 @@ class Executor:
             if use_tan:
                 if not (min_tan < cnt < max_tan):
                     continue
-            elif cnt < threshold:
+            elif cnt < spec.threshold:
                 continue
-            if filters is not None:
-                attr = f.row_attr_store.attrs(rid)
+            if spec.filters is not None:
+                attr = spec.f.row_attr_store.attrs(rid)
                 if not attr:
                     continue
-                val = attr.get(attr_name)
-                if val is None or val not in filters:
+                val = attr.get(spec.attr_name)
+                if val is None or val not in spec.filters:
                     continue
             survivors.append((rid, cnt))
-        icounts: Dict[int, int] = {}
-        if src is not None and survivors:
-            cand = [rid for rid, _ in survivors]
-            icounts = {
-                rid: int(cnt) for rid, cnt in zip(cand, frag.row_counts(cand, src))
-            }
+        return survivors
+
+    @staticmethod
+    def _topn_select(
+        spec: "_TopNSpec",
+        n: int,
+        survivors,
+        has_src: bool,
+        src_count: int,
+        icounts,
+    ) -> List[Tuple[int, int]]:
+        """The per-shard heap selection, mirroring fragment.top exactly
+        (fragment.go:1570-1704): a min-heap caps the result at n with
+        threshold-based early stop; cache rank order bounds remaining
+        candidates once the result set is full. The decisions depend only
+        on the (pre-computed) counts, so batching the count computation
+        gives identical results. Returns (count, rid) tuples."""
+        import heapq
+        import math
+
+        use_tan = spec.tanimoto > 0 and has_src
         results: List[Tuple[int, int]] = []  # min-heap of (count, rid)
         for rid, cnt in survivors:
             if n == 0 or len(results) < n:
-                count = icounts[rid] if src is not None else cnt
+                count = icounts[rid] if has_src else cnt
                 if count == 0:
                     continue
                 if use_tan:
                     t = math.ceil(count * 100 / (cnt + src_count - count))
-                    if t <= tanimoto:
+                    if t <= spec.tanimoto:
                         continue
-                elif count < threshold:
+                elif count < spec.threshold:
                     continue
                 heapq.heappush(results, (count, rid))
-                if n > 0 and len(results) == n and src is None:
+                if n > 0 and len(results) == n and not has_src:
                     break
                 continue
             # Result set full: only counts above the current minimum can
             # displace; cache rank order bounds remaining candidates.
             low = results[0][0]
-            if low < threshold or cnt < low:
+            if low < spec.threshold or cnt < low:
                 break
             count = icounts[rid]
             if count < low:
                 continue
             heapq.heappush(results, (count, rid))
-        out = [Pair(id=rid, count=count) for count, rid in results]
-        out.sort(key=lambda p: (-p.count, p.id))
+        return results
+
+    def _topn_shards(self, idx: Index, c: Call, shards) -> List[Pair]:
+        spec = self._topn_parse(idx, c)
+        shard_list = self._shards_for(idx, shards)
+        merged = self._topn_merged_batched(idx, spec, shard_list)
+        if merged is None:
+            merged = {}
+            TOPN_STATS["fallback"] += 1
+            for shard in shard_list:
+                for count, rid in self._topn_shard(idx, spec, shard):
+                    merged[rid] = merged.get(rid, 0) + count
+        pairs = [Pair(id=i, count=cnt) for i, cnt in merged.items()]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        return pairs
+
+    def _topn_merged_batched(
+        self, idx: Index, spec: "_TopNSpec", shard_list
+    ) -> Optional[Dict[int, int]]:
+        """All shards' TopN tallies in one batched pass (VERDICT r2 #1: the
+        last host-bound query family goes device-first).
+
+        Candidate *selection* stays on the rank caches (exact O(1) host
+        metadata — unlike the reference's approximate caches, recounting
+        plain candidates is free here, fragment.go:1570 top). Only a filter
+        bitmap needs device work: the child lowers to ONE stacked plan
+        eval, and the survivors' intersection counts are tallied as
+        popcount(planes & src) in O(candidates/tile) chunked dispatches
+        with a single host read — never one dispatch per shard. Returns
+        None when the child has no stacked form (per-shard fallback)."""
+        v = spec.f.view(VIEW_STANDARD)
+        if v is None:
+            return {}
+        present = [
+            (s, frag)
+            for s in shard_list
+            if (frag := v.fragment_if_exists(s)) is not None
+        ]
+        if not present:
+            return {}
+        has_src = spec.src_call is not None
+        if not has_src:
+            TOPN_STATS["batched"] += 1
+            return self._topn_merged_hostfast(spec, present)
+        pshards = [s for s, _ in present]
+        sp = self._lower_stacked(idx, spec.src_call, pshards)
+        if sp is None:
+            return None
+        TOPN_STATS["batched"] += 1
+        src_stack = sp.rows_full()  # one plan dispatch, stays on device
+        src_counts = None
+        if spec.tanimoto > 0:
+            TOPN_STATS["tally_evals"] += 1
+            src_counts = np.asarray(
+                ob.popcount_rows(src_stack), dtype=np.uint64
+            )[: len(present)]
+        # Per-shard pools + host-side survivor prunes.
+        pools = []
+        cand_union: Dict[int, None] = {}  # insertion-ordered set
+        use_tan = spec.tanimoto > 0
+        for j, (shard, frag) in enumerate(present):
+            n, pairs = self._topn_pool(spec, frag)
+            sc = int(src_counts[j]) if use_tan else 0
+            survivors = self._topn_survivors(spec, pairs, use_tan, sc)
+            pools.append((n, survivors, sc))
+            for rid, _ in survivors:
+                cand_union[rid] = None
+        ic_rows: Dict[int, np.ndarray] = {}
+        if cand_union:
+            ic_rows = self._topn_icounts(v, list(cand_union), present, src_stack)
+        merged: Dict[int, int] = {}
+        for j, (n, survivors, sc) in enumerate(pools):
+            icounts = {rid: int(ic_rows[rid][j]) for rid, _ in survivors}
+            for count, rid in self._topn_select(
+                spec, n, survivors, True, sc, icounts
+            ):
+                merged[rid] = merged.get(rid, 0) + count
+        return merged
+
+    def _topn_merged_hostfast(self, spec: "_TopNSpec", present) -> Dict[int, int]:
+        """The no-filter-bitmap merge: counts are exact O(1) host metadata,
+        so both passes reduce to vectorized metadata walks — zero device
+        dispatches. Semantics identical to _topn_pool/_topn_survivors/
+        _topn_select with has_src=False (the differential tests force the
+        general path and compare)."""
+        merged: Dict[int, int] = {}
+        allowed = None
+        if spec.filters is not None:
+            store = spec.f.row_attr_store
+            memo: Dict[int, bool] = {}
+
+            def allowed(rid: int) -> bool:
+                ok = memo.get(rid)
+                if ok is None:
+                    attr = store.attrs(rid)
+                    val = attr.get(spec.attr_name) if attr else None
+                    ok = memo[rid] = val is not None and val in spec.filters
+                return ok
+
+        if spec.ids:
+            # pass 2 / explicit ids: no truncation -> the per-shard select
+            # reduces to "sum counts >= threshold per shard" (exact).
+            ids = [int(i) for i in spec.ids]
+            if allowed is not None:
+                ids = [rid for rid in ids if allowed(rid)]
+            if not ids:
+                return merged
+            totals = np.zeros(len(ids), np.uint64)
+            thr = np.uint64(spec.threshold)
+            for _, frag in present:
+                c = frag.row_counts_host(ids)
+                c[c < thr] = 0
+                totals += c
+            for rid, cnt in zip(ids, totals):
+                if cnt:
+                    merged[rid] = merged.get(rid, 0) + int(cnt)
+            return merged
+        # pass 1: per-shard top-n of the rank cache. cache_top is sorted
+        # descending, so the threshold cut is a prefix and the n-bound is an
+        # early break — the same contract as the select heap with no src.
+        n = spec.n
+        for _, frag in present:
+            taken = 0
+            for rid, cnt in frag.cache_top():
+                if cnt < spec.threshold:
+                    break  # sorted desc: everything after is below too
+                if allowed is not None and not allowed(rid):
+                    continue
+                merged[rid] = merged.get(rid, 0) + cnt
+                taken += 1
+                if n and taken == n:
+                    break
+        return merged
+
+    def _topn_icounts(
+        self, view, cand: List[int], present, src_stack
+    ) -> Dict[int, np.ndarray]:
+        """Intersection counts for every candidate row across all present
+        shards: chunked [R_c, S, W] plane stacks tallied against the src
+        stack on device — O(candidates/tile) dispatches and ONE [R, S]
+        host read, replacing the per-shard frag.row_counts loop."""
+        from pilosa_tpu.exec import groupby as gb
+
+        pshards = tuple(s for s, _ in present)
+        s_pad, w = src_stack.shape
+        r_c = max(1, gb._tile_bytes() // (s_pad * w * 4))
+        chunks = []
+        for i in range(0, len(cand), r_c):
+            ids = cand[i : i + r_c]
+            pad_ids = [int(x) for x in gb._pad_pow2(np.asarray(ids))]
+            planes = view.plane_stack(pad_ids, pshards)
+            if planes.shape[1] != s_pad:
+                # stacked src may carry extra Shift-predecessor shards
+                src_stack = src_stack[: planes.shape[1]]
+            TOPN_STATS["tally_evals"] += 1
+            chunks.append((ids, gb._counts_cross(src_stack[None], planes)[0]))
+        out: Dict[int, np.ndarray] = {}
+        for ids, counts in chunks:
+            h = np.asarray(counts, dtype=np.uint64)[:, : len(present)]
+            for k, rid in enumerate(ids):
+                out[rid] = h[k]
         return out
+
+    def _topn_shard(self, idx: Index, spec: "_TopNSpec", shard: int) -> List[Tuple[int, int]]:
+        """One shard's TopN candidates (the per-shard fallback when the
+        filter child has no stacked form). Same pool/prune/select pipeline
+        as the batched path; intersection counts for surviving candidates
+        come from one batched per-shard dispatch."""
+        src = None
+        if spec.src_call is not None:
+            src = self._bitmap_call_shard(idx, spec.src_call, shard)
+            if src is None:
+                return []
+        v = spec.f.view(VIEW_STANDARD)
+        if v is None:
+            return []
+        frag = v.fragment_if_exists(shard)
+        if frag is None:
+            return []
+        n, pairs = self._topn_pool(spec, frag)
+        if not pairs:
+            return []
+        has_src = src is not None
+        src_count = int(ob.popcount(src)) if has_src else 0
+        use_tan = spec.tanimoto > 0 and has_src
+        survivors = self._topn_survivors(spec, pairs, use_tan, src_count)
+        icounts: Optional[Dict[int, int]] = None
+        if has_src and survivors:
+            cand = [rid for rid, _ in survivors]
+            icounts = {
+                rid: int(cnt) for rid, cnt in zip(cand, frag.row_counts(cand, src))
+            }
+        return self._topn_select(spec, n, survivors, has_src, src_count, icounts)
 
     # ------------------------------------------------------------------
     # Rows / GroupBy (executor.go:1068-1273)
