@@ -48,6 +48,15 @@ class Unsupported(Exception):
     executor falls back to the per-shard path."""
 
 
+class SparseView(Unsupported):
+    """A view is materialized in too few of the requested shards for a
+    dense stack to be economical. Unlike other Unsupported shapes, the
+    executor recovers by re-lowering over a compacted shard list (only
+    present shards + Shift relay successors) instead of falling back to
+    the per-shard loop — sparse shards stay free, as in the reference
+    (/root/reference/field.go:263-296 available-shards)."""
+
+
 # ---------------------------------------------------------------------------
 # Plan nodes
 # ---------------------------------------------------------------------------
@@ -213,15 +222,28 @@ def _eval_jit(plan: PNode, out_mode: str, operands: Tuple, scalars: Tuple):
 
 
 class StackedPlan:
-    """A lowered plan plus its operand stacks, ready to evaluate."""
+    """A lowered plan plus its operand stacks, ready to evaluate.
 
-    __slots__ = ("root", "operands", "scalars", "n_shards")
+    `out_shards` maps output stack positions 0..n_shards-1 back to shard
+    ids: under compacted lowering (SparseView recovery) the stack covers
+    only present shards, so consumers must not assume position == the
+    requested shard list."""
 
-    def __init__(self, root: PNode, operands: List, scalars: List[int], n_shards: int):
+    __slots__ = ("root", "operands", "scalars", "n_shards", "out_shards")
+
+    def __init__(
+        self,
+        root: PNode,
+        operands: List,
+        scalars: List[int],
+        n_shards: int,
+        out_shards: Optional[List[int]] = None,
+    ):
         self.root = root
         self.operands = operands
         self.scalars = scalars
         self.n_shards = n_shards
+        self.out_shards = out_shards
 
     def _scalar_args(self) -> Tuple:
         return tuple(jnp.uint32(s) for s in self.scalars)

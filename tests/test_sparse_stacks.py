@@ -1,0 +1,175 @@
+"""Compacted stacked lowering for sparse views (VERDICT r2 #3).
+
+A view materialized in few of many shards used to bail out of the stacked
+path (dispatch-per-shard fallback). Now lowering compacts the stack to
+present shards (+ Shift relay successors): one dispatch, sparse shards
+free — the reference's available-shards economics (field.go:263-296).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec import executor as exmod
+from pilosa_tpu.exec import plan as planmod
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+N_SHARDS = 1000
+PRESENT = list(range(0, N_SHARDS, 20))  # 5% of shards
+
+
+@pytest.fixture(scope="module")
+def sparse_ix():
+    h = Holder().open()
+    idx = h.create_index("i")
+    # marker field: one bit in every shard => available_shards = all 1000
+    marker = idx.create_field("marker")
+    marker.import_bits(
+        np.zeros(N_SHARDS, np.uint64),
+        (np.arange(N_SHARDS, dtype=np.uint64)) * np.uint64(SHARD_WIDTH),
+    )
+    # sparse set field: rows 1..3 in 5% of shards
+    f = idx.create_field("f")
+    rows, cols = [], []
+    for j, s in enumerate(PRESENT):
+        for r in (1, 2, 3):
+            for i in range(r + (j % 3)):
+                rows.append(r)
+                cols.append(s * SHARD_WIDTH + r * 101 + i)
+    f.import_bits(np.array(rows, np.uint64), np.array(cols, np.uint64))
+    # sparse BSI field in the same shards
+    v = idx.create_field("v", FieldOptions(type="int", min=-50, max=500))
+    vcols = np.array([s * SHARD_WIDTH + 7 for s in PRESENT], np.uint64)
+    vvals = np.arange(len(PRESENT), dtype=np.int64) * 9 - 50
+    v.import_values(vcols, vvals)
+    return h, Executor(h)
+
+
+def _serial(ex, pql, monkeypatch):
+    with monkeypatch.context() as m:
+        m.setattr(exmod, "_STACKED_ENABLED", False)
+        return ex.execute("i", pql)
+
+
+class TestCompaction:
+    def test_count_one_dispatch(self, sparse_ix):
+        """The VERDICT done-criterion: stacked evals == 1 for a 1000-shard
+        index where the queried field is 5% present."""
+        h, ex = sparse_ix
+        ex.execute("i", "Count(Row(f=1))")  # warm (stack builds)
+        planmod.reset_stats()
+        got = ex.execute("i", "Count(Row(f=1))")
+        assert planmod.STATS["evals"] == 1
+        expect = sum(1 + (j % 3) for j in range(len(PRESENT)))
+        assert got == [expect]
+
+    @pytest.mark.parametrize(
+        "pql",
+        [
+            "Row(f=2)",
+            "Count(Union(Row(f=1), Row(f=2)))",
+            "Count(Intersect(Row(f=1), Row(marker=0)))",
+            "Count(Difference(Row(f=3), Row(f=1)))",
+            "Count(Xor(Row(f=1), Row(f=2)))",
+            "Count(Not(Row(f=1)))",
+            "Row(v > 40)",
+            "Count(Row(-20 < v < 300))",
+        ],
+    )
+    def test_differential_vs_serial(self, sparse_ix, monkeypatch, pql):
+        h, ex = sparse_ix
+        got = ex.execute("i", pql)
+        want = _serial(ex, pql, monkeypatch)
+        if hasattr(got[0], "columns"):
+            assert got[0].columns().tolist() == want[0].columns().tolist(), pql
+        else:
+            assert got == want, pql
+
+    def test_shift_carry_across_gap(self, sparse_ix, monkeypatch):
+        """A bit at the top of a present shard must carry into the next
+        (absent) shard — the relay successor is kept in the compacted
+        stack."""
+        h, ex = sparse_ix
+        f = h.index("i").field("f")
+        edge = 40 * SHARD_WIDTH + SHARD_WIDTH - 1  # top bit of present shard
+        f.import_bits(np.array([9], np.uint64), np.array([edge], np.uint64))
+        got = ex.execute("i", "Shift(Row(f=9), n=1)")
+        want = _serial(ex, "Shift(Row(f=9), n=1)", monkeypatch)
+        assert got[0].columns().tolist() == want[0].columns().tolist()
+        assert (edge + 1) in got[0].columns().tolist()
+
+    def test_sum_min_max_compacted(self, sparse_ix, monkeypatch):
+        h, ex = sparse_ix
+        ex.execute("i", "Sum(field=v)")  # warm
+        planmod.reset_stats()
+        got_sum = ex.execute("i", "Sum(field=v)")
+        got_min = ex.execute("i", "Min(field=v)")
+        got_max = ex.execute("i", "Max(field=v)")
+        assert got_sum == _serial(ex, "Sum(field=v)", monkeypatch)
+        assert got_min == _serial(ex, "Min(field=v)", monkeypatch)
+        assert got_max == _serial(ex, "Max(field=v)", monkeypatch)
+        vals = np.arange(len(PRESENT), dtype=np.int64) * 9 - 50
+        assert got_sum[0].value == int(vals.sum())
+        assert got_min[0].value == int(vals.min())
+        assert got_max[0].value == int(vals.max())
+
+    def test_groupby_compacted(self, sparse_ix, monkeypatch):
+        h, ex = sparse_ix
+        pql = "GroupBy(Rows(f), Rows(f))"
+        got = ex.execute("i", pql)
+        want = _serial(ex, pql, monkeypatch)
+        as_t = lambda res: [
+            (tuple((fr.field, fr.row_id) for fr in g.group), g.count) for g in res[0]
+        ]
+        assert as_t(got) == as_t(want)
+
+    def test_topn_filtered_sparse_src(self, sparse_ix, monkeypatch):
+        h, ex = sparse_ix
+        pql = "TopN(f, Row(f=1), n=5)"
+        got = ex.execute("i", pql)
+        with monkeypatch.context() as m:
+            m.setattr(
+                Executor,
+                "_topn_merged_batched",
+                lambda self, idx, spec, shards: None,
+            )
+            want = ex.execute("i", pql)
+        assert [(p.id, p.count) for p in got[0]] == [
+            (p.id, p.count) for p in want[0]
+        ]
+
+    def test_explicit_subset_shards(self, sparse_ix, monkeypatch):
+        """Explicit shard subsets intersect with compaction correctly."""
+        h, ex = sparse_ix
+        subset = list(range(0, 500))  # half the index, 25 present
+        got = ex.execute("i", "Count(Row(f=2))", shards=subset)
+        want = _serial(ex, "Count(Row(f=2))", monkeypatch)  # full index
+        sub_expect = sum(
+            2 + (j % 3) for j, s in enumerate(PRESENT) if s < 500
+        )
+        assert got == [sub_expect]
+
+
+class TestFallbackBatchedReads:
+    def test_count_fallback_bounded_reads(self, monkeypatch):
+        """When stacked lowering is off entirely, the per-shard Count
+        fallback fuses host reads: a 100-shard query does ceil(100/64)=2
+        device->host syncs, not 100 (VERDICT r2 #8)."""
+        h = Holder().open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        n_shards = 100
+        f.import_bits(
+            np.ones(n_shards, np.uint64),
+            np.arange(n_shards, dtype=np.uint64) * np.uint64(SHARD_WIDTH)
+            + np.uint64(5),
+        )
+        ex = Executor(h)
+        with monkeypatch.context() as m:
+            m.setattr(exmod, "_STACKED_ENABLED", False)
+            exmod.FALLBACK_STATS["count_reads"] = 0
+            got = ex.execute("i", "Count(Row(f=1))")
+            assert got == [n_shards]
+            assert exmod.FALLBACK_STATS["count_reads"] == 2
