@@ -34,6 +34,11 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--cluster-hosts", help="comma-separated id@uri entries")
     sp.add_argument("--replicas", type=int)
     sp.add_argument("--anti-entropy-interval", type=float)
+    sp.add_argument(
+        "--join",
+        help="coordinator URI to join on boot (self-registers and waits for "
+        "the resize job; the listenForJoins role, cluster.go:1141)",
+    )
     sp.add_argument("--verbose", action="store_true", default=None)
 
     ip = sub.add_parser("import", help="bulk-import CSV rows (row,col[,ts])")
@@ -95,7 +100,52 @@ def _load_config(args) -> Config:
 # ---------------------------------------------------------------------------
 
 
-def cmd_server(cfg: Config, wait: bool = True):
+def _join_on_boot(srv, coordinator_uri: str, timeout: float = 180.0) -> None:
+    """Self-register with the coordinator and wait until this node is an
+    active member (reference: gossip join -> listenForJoins -> resize job,
+    cluster.go:1141,1796). Retries while the coordinator is busy with
+    another resize — concurrent joins serialize on the coordinator's
+    one-job-at-a-time rule."""
+    import time
+
+    from pilosa_tpu.server.client import ClientError
+
+    payload = {"id": srv.node.id, "uri": srv.node.uri}
+    deadline = time.time() + timeout
+    registered_at: Optional[float] = None
+    while time.time() < deadline:
+        if registered_at is None:
+            try:
+                srv.client.join_cluster(coordinator_uri, payload)
+                registered_at = time.time()
+            except ClientError as e:
+                # coordinator busy (a resize job is already running) or not
+                # up yet: back off and retry
+                print(f"join: waiting for coordinator: {e}", file=sys.stderr)
+                time.sleep(1.0)
+                continue
+        elif len(srv.cluster.nodes) <= 1 and time.time() - registered_at > 10.0:
+            # the join resize aborted and rolled us back to a solo
+            # cluster: re-register rather than idling out the deadline
+            print("join: resize rolled back; re-registering", file=sys.stderr)
+            registered_at = None
+            continue
+        if (
+            len(srv.cluster.nodes) > 1
+            and any(n.id == srv.node.id for n in srv.cluster.nodes)
+            and srv.state == "NORMAL"
+        ):
+            print(
+                f"joined cluster of {len(srv.cluster.nodes)} nodes via "
+                f"{coordinator_uri}",
+                file=sys.stderr,
+            )
+            return
+        time.sleep(0.2)
+    raise SystemExit(f"join via {coordinator_uri} did not complete in {timeout}s")
+
+
+def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
     from pilosa_tpu.cluster.topology import Node
     from pilosa_tpu.server.node import NodeServer
 
@@ -129,6 +179,8 @@ def cmd_server(cfg: Config, wait: bool = True):
             members.append(Node(id=node_id, uri=srv.node.uri))
         members[0].is_coordinator = True
         srv.set_topology(members, replica_n=cfg.cluster.replicas)
+    if join:
+        _join_on_boot(srv, join)
     print(f"pilosa-tpu node {node_id} listening on {srv.node.uri}", file=sys.stderr)
     if wait:
         stop = []
@@ -311,7 +363,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.print_help()
         return 2
     if args.command == "server":
-        cmd_server(_load_config(args))
+        cmd_server(_load_config(args), join=getattr(args, "join", None))
         return 0
     if args.command == "import":
         return cmd_import(args)

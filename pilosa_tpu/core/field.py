@@ -119,12 +119,25 @@ class Field:
             if options.min == 0 and options.max == 0:
                 options.max = 2**31 - 1  # mirror of reference default range
             options.base = bsi_base(options.min, options.max)
+            required = max(
+                bit_depth_of(abs(options.min - options.base)),
+                bit_depth_of(abs(options.max - options.base)),
+            )
             if options.bit_depth == 0:
-                required = max(
-                    bit_depth_of(abs(options.min - options.base)),
-                    bit_depth_of(abs(options.max - options.base)),
-                )
                 options.bit_depth = required
+            # Device BSI ladders and fused min/max are uint32: magnitudes
+            # above 32 bits would silently truncate (r2 advisor). The
+            # reference supports 63-bit BSI (fragment.go:90); here ranges
+            # wider than 32-bit magnitudes around the base are rejected at
+            # creation — values are range-checked on every write, so the
+            # auto-widen paths can never exceed this afterwards.
+            if max(required, options.bit_depth) > 32:
+                raise ValueError(
+                    f"int field range [{options.min}, {options.max}] needs "
+                    f"{max(required, options.bit_depth)}-bit magnitudes; device "
+                    "BSI supports at most 32 (narrow the range or shift it "
+                    "closer to the base)"
+                )
         if options.type == FIELD_TYPE_TIME:
             timeq.validate_quantum(options.time_quantum)
 

@@ -97,6 +97,24 @@ class View:
     def fragment_if_exists(self, shard: int) -> Optional[Fragment]:
         return self.fragments.get(shard)
 
+    def delete_fragment(self, shard: int) -> bool:
+        """Drop one shard's fragment: close it, delete its on-disk files
+        and free its device-cache residency (the post-resize holder
+        cleaner's unit of work, reference holder.go:1126)."""
+        with self._mu:
+            frag = self.fragments.pop(shard, None)
+            if frag is None:
+                return False
+            frag.close()  # also frees the fragment's device-cache residency
+            for p in (frag.snap_path, frag.wal_path, frag.cache_path):
+                if p is not None:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+            DEVICE_CACHE.invalidate_owner(self._stack_token)
+            return True
+
     def available_shards(self) -> List[int]:
         with self._mu:
             return sorted(self.fragments)

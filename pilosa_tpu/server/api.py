@@ -260,13 +260,17 @@ class API:
         clear: bool = False,
         timestamps: Optional[Sequence] = None,
         local_only: bool = False,
-    ) -> None:
+    ) -> dict:
         """Bulk set-bit import; translates keys, groups bits by shard and
-        routes each shard batch to all its owner nodes (api.go:963-996)."""
+        routes each shard batch to all its owner nodes (api.go:963-996).
+        Returns an application summary {"applied", "expected", "errors"} so
+        callers can detect reduced durability when a replica was down
+        (r2 advisor: partial application must be visible, not silent)."""
         self._validate("import_bits", write=True)
         idx, f = self._index_field(index, field)
         rows, cols = self._translate_import(idx, f, rows, cols)
         shards = cols // SHARD_WIDTH
+        summary = {"applied": 0, "expected": 0, "errors": []}
         for shard in np.unique(shards):
             m = shards == shard
             ts = (
@@ -274,9 +278,13 @@ class API:
                 if timestamps is not None
                 else None
             )
-            self._route_shard_import(
+            applied, expected, errors = self._route_shard_import(
                 idx, f, int(shard), rows[m], cols[m], clear, ts, local_only
             )
+            summary["applied"] += applied
+            summary["expected"] += expected
+            summary["errors"] += errors
+        return summary
 
     def import_values(
         self,
@@ -285,26 +293,49 @@ class API:
         cols: Sequence,
         values: Sequence[int],
         local_only: bool = False,
-    ) -> None:
+    ) -> dict:
         self._validate("import_values", write=True)
         idx, f = self._index_field(index, field)
         _, cols = self._translate_import(idx, f, None, cols)
         values = np.asarray(values, dtype=np.int64)
         shards = cols // SHARD_WIDTH
+        summary = {"applied": 0, "expected": 0, "errors": []}
         for shard in np.unique(shards):
             m = shards == shard
             owners = self.cluster.shard_nodes(idx.name, int(shard))
-            for n in owners if not local_only else [self.server.node]:
+            targets = owners if not local_only else [self.server.node]
+            applied = 0
+            errors = []
+            for n in targets:
                 if n.id == self.server.node.id:
                     f.import_values(cols[m], values[m])
                     idx.track_columns(cols[m])
+                    applied += 1
                 else:
-                    self.server.client.import_values(
-                        n.uri, index, field, int(shard),
-                        cols[m].tolist(), values[m].tolist(),
-                    )
+                    from pilosa_tpu.server.client import ClientError
+
+                    try:
+                        self.server.client.import_values(
+                            n.uri, index, field, int(shard),
+                            cols[m].tolist(), values[m].tolist(),
+                        )
+                        applied += 1
+                    except ClientError as e:
+                        errors.append(f"{n.id}: {e}")
+                        self.server.logger(
+                            f"import-value shard {shard} to replica {n.id} "
+                            f"failed (anti-entropy will repair): {e}"
+                        )
+            if not applied:
+                raise ApiError(
+                    f"import-value shard {shard}: no owner reachable: {errors}"
+                )
+            summary["applied"] += applied
+            summary["expected"] += len(targets)
+            summary["errors"] += errors
             if not local_only:
                 self._announce_shard(index, field, int(shard))
+        return summary
 
     def _index_field(self, index: str, field: str):
         idx = self.holder.index(index)
@@ -331,7 +362,8 @@ class API:
 
     def _route_shard_import(
         self, idx, f, shard, rows, cols, clear, timestamps, local_only
-    ) -> None:
+    ) -> tuple:
+        """Returns (applied, expected, errors) for durability reporting."""
         owners = self.cluster.shard_nodes(idx.name, shard)
         targets = [self.server.node] if local_only else owners
         applied = 0
@@ -371,6 +403,7 @@ class API:
             raise ApiError(f"import shard {shard}: no owner reachable: {errors}")
         if not local_only:
             self._announce_shard(idx.name, f.name, shard)
+        return applied, len(targets), errors
 
     def import_roaring(
         self,
@@ -629,6 +662,10 @@ class API:
             self.server.set_node_state(msg["node"], msg["state"])
         elif t == "recalculate-caches":
             self.holder.recalculate_caches()
+        elif t == "clean-holder":
+            # post-resize GC (holder.go:1126 CleanHolder): drop fragments
+            # the current topology no longer assigns to this node
+            self.server.clean_holder()
         else:
             raise ApiError(f"unknown cluster message type {t!r}")
         return {"ok": True}

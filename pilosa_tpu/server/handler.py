@@ -216,19 +216,19 @@ class Handler(BaseHTTPRequestHandler):
         d = self._json_body()
         rows = d.get("rowKeys") or d.get("rows") or []
         cols = d.get("colKeys") or d.get("cols") or []
-        self.api.import_bits(
+        summary = self.api.import_bits(
             index, field, rows, cols,
             clear=d.get("clear", False),
             timestamps=d.get("timestamps"),
         )
-        self._reply({})
+        self._reply(summary or {})
 
     @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-value")
     def post_import_value(self, index: str, field: str):
         d = self._json_body()
         cols = d.get("colKeys") or d.get("cols") or []
-        self.api.import_values(index, field, cols, d.get("values", []))
-        self._reply({})
+        summary = self.api.import_values(index, field, cols, d.get("values", []))
+        self._reply(summary or {})
 
     @route(
         "POST",

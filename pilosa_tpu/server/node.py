@@ -286,30 +286,45 @@ class NodeServer:
         """One full anti-entropy pass: for every local fragment whose shard
         this node PRIMARY-owns, reconcile all replicas via block checksums
         + majority-vote merge (fragment.go:2861 syncFragment). Returns the
-        number of fragments that needed repair."""
+        number of fragments that needed repair.
+
+        Fragment syncs run on a thread pool (one slow replica no longer
+        serializes the whole walk — the reference runs one goroutine per
+        mapper the same way, executor.go:2522)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         if len(self.cluster.nodes) <= 1:
             return 0
-        repaired = 0
         # merge peers' availability first: a node restarted after missing
         # shard announcements must re-learn which shards exist cluster-wide
         # (the reference's gossip NodeStatus state merge, gossip.go:295-362).
         # This runs even at replica_n=1 — availability is about query
         # fan-out correctness, not replica repair.
-        for idx in self.holder.indexes():
-            for peer in self.cluster.nodes:
-                if peer.id == self.node.id or peer.state == "DOWN":
-                    continue
-                try:
-                    for fname, shards in self.client.available_shards(
-                        peer.uri, idx.name
-                    ).items():
-                        f = idx.field(fname)
-                        if f is not None:
-                            f.add_remote_available(shards)
-                except ClientError:
-                    continue
+        peers = [
+            n
+            for n in self.cluster.nodes
+            if n.id != self.node.id and n.state != "DOWN"
+        ]
+
+        def merge_avail(args) -> None:
+            idx, peer = args
+            try:
+                for fname, shards in self.client.available_shards(
+                    peer.uri, idx.name
+                ).items():
+                    f = idx.field(fname)
+                    if f is not None:
+                        f.add_remote_available(shards)
+            except ClientError:
+                pass
+
+        tasks = [(idx, p) for idx in self.holder.indexes() for p in peers]
+        if tasks:
+            with ThreadPoolExecutor(max_workers=min(8, len(tasks))) as pool:
+                list(pool.map(merge_avail, tasks))
         if self.cluster.replica_n <= 1:
             return 0
+        sync_tasks = []
         for idx in self.holder.indexes():
             for f in idx.fields(include_hidden=True):
                 for vname, v in list(f.views.items()):
@@ -325,9 +340,20 @@ class NodeServer:
                         replicas = [n for n in owners[1:] if n.state != "DOWN"]
                         if not replicas:
                             continue
-                        if self._sync_fragment(idx, f, vname, shard, replicas):
-                            repaired += 1
-        return repaired
+                        sync_tasks.append((idx, f, vname, shard, replicas))
+        if not sync_tasks:
+            return 0
+
+        def run_sync(t) -> bool:
+            try:
+                return self._sync_fragment(*t)
+            except Exception as e:  # noqa: BLE001 - one bad fragment must
+                # not abort the rest of the pass
+                self.logger(f"anti-entropy {t[0].name}/{t[1].name}/{t[3]}: {e}")
+                return False
+
+        with ThreadPoolExecutor(max_workers=min(8, len(sync_tasks))) as pool:
+            return sum(pool.map(run_sync, sync_tasks))
 
     def _sync_fragment(self, idx, f, view: str, shard: int, replicas) -> bool:
         # materialize the local fragment if only replicas hold it
@@ -446,6 +472,27 @@ class NodeServer:
         self.set_topology(new_nodes, replica_n=new.replica_n)
         return fetched
 
+    def clean_holder(self) -> int:
+        """Remove fragments the current topology no longer assigns to this
+        node (reference: holderCleaner.CleanHolder, holder.go:1126) —
+        without this every resize leaks disk and devcache residency.
+        Returns the number of fragments removed."""
+        if len(self.cluster.nodes) <= 1:
+            return 0
+        removed = 0
+        for idx in self.holder.indexes():
+            for f in idx.fields(include_hidden=True):
+                for v in list(f.views.values()):
+                    for shard in list(v.fragments):
+                        owners = self.cluster.shard_nodes(idx.name, shard)
+                        if any(n.id == self.node.id for n in owners):
+                            continue
+                        v.delete_fragment(shard)
+                        removed += 1
+        if removed:
+            self.logger(f"holder cleaner removed {removed} fragments")
+        return removed
+
     # -- coordinator-driven resize jobs (cluster.go:1141-1561) -------------
 
     def start_resize(
@@ -509,8 +556,33 @@ class NodeServer:
                 self._send_status([solo], [solo], 1, STATE_NORMAL)
 
         try:
-            # freeze writes cluster-wide while fragments move
-            self._send_status(old_members, old_members, old_replica, STATE_RESIZING)
+            # refresh liveness first so dead members are excluded from the
+            # required-ack sets (the reference confirms down via /status
+            # probes before honoring it, cluster.go:1724)
+            self.probe_peers()
+            # freeze writes cluster-wide while fragments move; every KEPT
+            # live member must acknowledge the freeze or the job aborts
+            # (r2 advisor). Nodes being removed or already DOWN can't be
+            # required to ack — a dead node must stay removable.
+            removed_ids = {n.id for n in removed}
+
+            def live_kept(nodes):
+                return [
+                    n
+                    for n in nodes
+                    if n.id not in removed_ids and n.state != "DOWN"
+                ]
+
+            self._send_status(
+                live_kept(old_members),
+                old_members,
+                old_replica,
+                STATE_RESIZING,
+                require=True,
+            )
+            rest = [n for n in old_members if n not in live_kept(old_members)]
+            if rest:
+                self._send_status(rest, old_members, old_replica, STATE_RESIZING)
             # existing members first (they fetch from current owners while
             # everyone still holds their old fragments), joiners last
             order = [n for n in new_nodes if n.id in old_ids] + [
@@ -533,21 +605,39 @@ class NodeServer:
                         schema=schema if joining else None,
                     )
             new_replica = replica_n if replica_n is not None else old_replica
-            # removed nodes get the final status too: they unfreeze from
-            # RESIZING and learn they are no longer members
+            # every surviving member must acknowledge the NORMAL restore
+            # (a member stuck in RESIZING would refuse writes forever)
             self._send_status(
-                new_nodes + removed, new_nodes, new_replica, STATE_NORMAL
+                new_nodes, new_nodes, new_replica, STATE_NORMAL, require=True
             )
+            # removed nodes get the final status too (best-effort): they
+            # unfreeze and learn they are no longer members
+            if removed:
+                self._send_status(removed, new_nodes, new_replica, STATE_NORMAL)
             job["state"] = "DONE"
         except _ResizeAborted:
             rollback()
             job["state"] = "ABORTED"
             job["error"] = "aborted"
+            return
         except Exception as e:  # noqa: BLE001 - job record carries the error
             rollback()
             job["state"] = "ABORTED"
             job["error"] = str(e)
             self.logger(f"resize job {job['id']} aborted: {e}")
+            return
+        # post-resize GC: members drop fragments the new topology no longer
+        # assigns to them (holder.go:1126 CleanHolder). Runs AFTER the job
+        # committed — sources keep their data until every node has fetched
+        # its set, and a GC failure must never roll back a DONE resize.
+        for n in new_nodes:
+            try:
+                if n.id == self.node.id:
+                    self.clean_holder()
+                else:
+                    self.client.send_message(n.uri, {"type": "clean-holder"})
+            except Exception as e:  # noqa: BLE001 - GC is best-effort
+                self.logger(f"clean-holder on {n.id}: {e}")
 
     def _send_status(
         self,
@@ -555,21 +645,49 @@ class NodeServer:
         member_nodes: List[Node],
         replica_n: int,
         state: str,
-    ) -> None:
+        require: bool = False,
+        retries: int = 3,
+    ) -> List[str]:
         """Deliver a cluster-status to a node set (the RESIZING/NORMAL
-        broadcasts of resizeJob.run; best-effort to unreachable nodes,
-        which the probe loop will mark DOWN anyway)."""
+        broadcasts of resizeJob.run), retrying and VERIFYING each member
+        applied the state via /status (r2 advisor: a member that misses
+        the RESIZING freeze keeps accepting writes while fragments move; a
+        member that misses the NORMAL restore stays frozen forever).
+        Returns the ids that never acknowledged; raises instead when
+        `require` is set, so the resize job aborts and rolls back."""
         msg = {
             "type": "cluster-status",
             "nodes": [m.to_json() for m in member_nodes],
             "replicaN": replica_n,
             "state": state,
         }
+        failed: List[str] = []
         for n in to_nodes:
             if n.id == self.node.id:
                 self.apply_cluster_status(msg)
                 continue
-            try:
-                self.client.send_message(n.uri, msg)
-            except ClientError as e:
-                self.logger(f"cluster-status to {n.id}: {e}")
+            ok = False
+            last: Optional[Exception] = None
+            for attempt in range(max(retries, 1)):
+                try:
+                    self.client.send_message(n.uri, msg)
+                    st = self.client.status(n.uri, timeout=5.0)
+                    if st.get("state") == state:
+                        ok = True
+                        break
+                    last = ClientError(
+                        f"applied state {st.get('state')!r}, want {state!r}"
+                    )
+                except ClientError as e:
+                    last = e
+                time.sleep(0.1 * (attempt + 1))
+            if not ok:
+                failed.append(n.id)
+                self.logger(
+                    f"cluster-status {state} to {n.id} not acknowledged: {last}"
+                )
+        if require and failed:
+            raise ClientError(
+                f"cluster-status {state} not acknowledged by: {failed}"
+            )
+        return failed

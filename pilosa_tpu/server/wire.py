@@ -36,10 +36,19 @@ _MAX_ARRAY_BYTES = 1 << 31  # 2 GiB bound: reject absurd length prefixes
 
 
 def encode_arrays(*arrays) -> bytes:
-    """magic | u32 n_arrays | per array: u32 length | raw <u8 bytes."""
+    """magic | u32 n_arrays | per array: u32 length | raw <u8 bytes.
+
+    Enforces the same _MAX_ARRAY_BYTES bound as decode_arrays: a sender
+    must never produce a payload the receiver is guaranteed to reject
+    (r2 advisor) — callers chunk oversized transfers instead."""
     parts = [ARRAYS_MAGIC, struct.pack("<I", len(arrays))]
     for a in arrays:
         a = np.ascontiguousarray(np.asarray(a, dtype=np.uint64))
+        if a.nbytes > _MAX_ARRAY_BYTES:
+            raise ValueError(
+                f"array of {a.nbytes} bytes exceeds the {_MAX_ARRAY_BYTES}-byte "
+                "wire frame bound; chunk the transfer"
+            )
         parts.append(struct.pack("<I", a.size))
         parts.append(a.astype("<u8", copy=False).tobytes())
     return b"".join(parts)
