@@ -30,6 +30,7 @@ import numpy as np
 from pilosa_tpu.core import cache as cachemod
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
+from pilosa_tpu.core import rowstore as rowstore_mod
 from pilosa_tpu.core.rowstore import RowBits
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.ops import bsi as obsi
@@ -364,6 +365,8 @@ class Fragment:
             rb = self._rows.get(row_id)
             self.cache.add(row_id, rb.count() if rb is not None else 0)
             DEVICE_CACHE.invalidate((self._token, row_id))
+        if rowstore_mod.PARANOIA:
+            self._paranoia_check(touched)
         if touched:
             # multi-row stacks may contain any touched row; drop them all
             DEVICE_CACHE.invalidate_owner(self._stack_token)
@@ -409,7 +412,36 @@ class Fragment:
             self.version += 1
             if self.on_mutate is not None:
                 self.on_mutate()
+        if rowstore_mod.PARANOIA:
+            self._paranoia_check({row_id})
         return added
+
+    def _paranoia_check(self, touched) -> None:
+        """Opt-in invariant pass after every mutation (the reference's
+        roaringparanoia tag, roaring/roaring_paranoia.go:15): rowstore
+        structural checks plus cache/rowstore count coherence for the
+        touched rows. Called under self._mu."""
+        for row_id in touched:
+            rb = self._rows.get(row_id)
+            if rb is None:
+                continue
+            rb.check()
+            if self.cache.cache_type != cachemod.CACHE_TYPE_NONE:
+                cached = self.cache.get(row_id)
+                if cached and cached != rb.count():
+                    raise AssertionError(
+                        f"row {row_id}: cache count {cached} != "
+                        f"rowstore count {rb.count()}"
+                    )
+            if self._mutex_map is not None and rb.count():
+                # mutex invariant: every set bit's column maps back to
+                # this row in the mutex vector (bounded spot check without
+                # materializing the row)
+                for col in rb.first_positions(64):
+                    if self._mutex_map.get(int(col)) != row_id:
+                        raise AssertionError(
+                            f"mutex vector disagrees at col {int(col)}"
+                        )
 
     def _wal_append(self, op: int, positions: np.ndarray) -> None:
         if self._wal is not None:

@@ -11,12 +11,18 @@ compression never reaches the compute path.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
 
 ARRAY_REP = 0
 DENSE_REP = 1
+
+# Opt-in invariant checking on the hot mutation funnel — the analog of the
+# reference's roaringparanoia/roaringsentinel build tags
+# (roaring/roaring_paranoia.go:15). Read once at import, like a build tag.
+PARANOIA = os.environ.get("PILOSA_TPU_PARANOIA", "") in ("1", "true")
 
 if hasattr(np, "bitwise_count"):  # numpy >= 2.0
 
@@ -171,6 +177,53 @@ class RowBits:
         changed = len(self.positions) - len(kept)
         self.positions = kept.astype(np.uint32)
         return changed
+
+    def first_positions(self, k: int) -> np.ndarray:
+        """Up to k set positions in ascending order, without materializing
+        the whole row (paranoia spot checks): sparse slices directly; dense
+        unpacks only the first <=k nonzero words."""
+        if self.dense is None:
+            return self.positions[:k].copy()
+        w_idx = np.nonzero(self.dense)[0][:k]  # each word holds >=1 bit
+        if not len(w_idx):
+            return np.empty(0, np.uint32)
+        by = self.dense[w_idx].astype("<u4").view(np.uint8).reshape(len(w_idx), 4)
+        bits = np.unpackbits(by, axis=1, bitorder="little")
+        wi, bi = np.nonzero(bits)
+        return (
+            w_idx[wi].astype(np.uint32) * np.uint32(32) + bi.astype(np.uint32)
+        )[:k]
+
+    # -- invariants (PILOSA_TPU_PARANOIA=1) --------------------------------
+
+    def check(self) -> None:
+        """Structural invariants (reference: Bitmap.Check/Container.check,
+        roaring/roaring.go:1664,3010): exactly one live representation,
+        positions strictly increasing and in-range, maintained cardinality
+        exact. Raises AssertionError on violation."""
+        if self.dense is not None:
+            if self.positions is not None:
+                raise AssertionError("both dense and positions live")
+            if self.dense.shape != (self.n_words,):
+                raise AssertionError(
+                    f"dense shape {self.dense.shape} != ({self.n_words},)"
+                )
+            actual = _popcount_words(self.dense)
+            if actual != self._n:
+                raise AssertionError(
+                    f"maintained count {self._n} != actual {actual}"
+                )
+        else:
+            p = self.positions
+            if p is None:
+                raise AssertionError("neither representation live")
+            if len(p):
+                if not np.all(np.diff(p.astype(np.int64)) > 0):
+                    raise AssertionError("positions not strictly increasing")
+                if int(p[-1]) >= self.n_bits:
+                    raise AssertionError(
+                        f"position {int(p[-1])} >= n_bits {self.n_bits}"
+                    )
 
     # -- serialization (snapshot payload) ----------------------------------
 

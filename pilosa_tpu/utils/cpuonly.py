@@ -7,11 +7,77 @@ any backend enumeration dials the TPU tunnel even for CPU-only work (and
 hangs when the tunnel is unhealthy). This helper makes CPU-only runs
 hermetic: drop non-CPU backend factories before any client is created and
 pin the platform via jax.config.
+
+This necessarily touches jax's PRIVATE backend registry
+(jax._src.xla_bridge._backend_factories). The surgery is contained in
+_patch_backend_factories, which validates the private surface first and
+raises CpuOnlyDriftError with an actionable message if a JAX upgrade
+changed it — loud failure instead of silently dialing the TPU.
 """
 
 from __future__ import annotations
 
 import os
+
+_DRIFT_HELP = (
+    "jax's private backend registry (jax._src.xla_bridge._backend_factories) "
+    "no longer matches what force_cpu() expects — a JAX upgrade changed the "
+    "private API this shim patches. Update _patch_backend_factories for the "
+    "new shape, or run with JAX_PLATFORMS=cpu set BEFORE the interpreter "
+    "starts (so sitecustomize's pre-import honors it) instead."
+)
+
+
+class CpuOnlyDriftError(RuntimeError):
+    """The private JAX surface force_cpu() patches has changed shape."""
+
+
+def _refuse(name):
+    def factory(*a, **kw):
+        raise RuntimeError(f"backend {name!r} disabled by force_cpu()")
+
+    return factory
+
+
+def _patch_backend_factories(xb) -> None:
+    """Replace every non-CPU backend factory with a refusal, keeping the
+    platform *registered* (known_platforms() must still list e.g. "tpu", or
+    importing jax.experimental.pallas/checkify fails at lowering-rule
+    registration). Validates the private surface and fails loudly on
+    drift."""
+    import dataclasses
+
+    factories = getattr(xb, "_backend_factories", None)
+    if not isinstance(factories, dict) or not factories:
+        raise CpuOnlyDriftError(
+            f"_backend_factories is {type(factories).__name__}, expected a "
+            f"non-empty dict. {_DRIFT_HELP}"
+        )
+    if "cpu" not in factories:
+        raise CpuOnlyDriftError(
+            f"no 'cpu' entry in _backend_factories "
+            f"(has {sorted(factories)}). {_DRIFT_HELP}"
+        )
+    # validate EVERY entry before mutating any: a drift failure must not
+    # leave the registry half-patched for a caller that catches the error
+    to_patch = []
+    for name, reg in list(factories.items()):
+        if name == "cpu":
+            continue
+        if not (
+            dataclasses.is_dataclass(reg)
+            and hasattr(reg, "factory")
+            and hasattr(reg, "fail_quietly")
+        ):
+            raise CpuOnlyDriftError(
+                f"registration for backend {name!r} is {type(reg).__name__} "
+                f"without factory/fail_quietly fields. {_DRIFT_HELP}"
+            )
+        to_patch.append((name, reg))
+    for name, reg in to_patch:
+        factories[name] = dataclasses.replace(
+            reg, factory=_refuse(name), fail_quietly=True
+        )
 
 
 def force_cpu(n_devices: int = 8) -> None:
@@ -22,24 +88,8 @@ def force_cpu(n_devices: int = 8) -> None:
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
 
-    import dataclasses
-
     import jax
     from jax._src import xla_bridge as xb
 
-    def _refuse(name):
-        def factory(*a, **kw):
-            raise RuntimeError(f"backend {name!r} disabled by force_cpu()")
-
-        return factory
-
-    for name, reg in list(xb._backend_factories.items()):
-        if name != "cpu":
-            # Keep the platform *registered* (known_platforms() must still
-            # list e.g. "tpu", or importing jax.experimental.pallas/checkify
-            # fails at lowering-rule registration) but make its factory
-            # refuse, so nothing can dial the TPU tunnel.
-            xb._backend_factories[name] = dataclasses.replace(
-                reg, factory=_refuse(name), fail_quietly=True
-            )
+    _patch_backend_factories(xb)
     jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,149 @@
+"""Generate the checked-in roaring-decoder crasher corpus.
+
+The reference checks confirmed unmarshal crashers into its repo
+(/root/reference/roaring/fuzz_test.go:21-76); this is our analog, seeded
+with the same failure classes (malformed headers, overrunning containers,
+non-increasing keys, truncations) against BOTH decoders — the numpy codec
+(core/roaring_io.py) and the C++ codec (native/roaring_codec.cpp).
+
+Run `python tests/corpus/make_roaring_corpus.py` to (re)generate
+tests/corpus/roaring/*.bin deterministically. Files prefixed `ok_` must
+decode successfully (and identically in both decoders); `bad_` files must
+raise RoaringError in both — never crash, hang, or return garbage.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "roaring")
+
+
+def pilosa_header(n_keys: int, flags: int = 0, version: int = 0) -> bytes:
+    return struct.pack("<HBBI", 12348, version, flags, n_keys)
+
+
+def pilosa_file(containers):
+    """containers: list of (key, ctype, card, payload_bytes)."""
+    n = len(containers)
+    hdr = pilosa_header(n)
+    desc = b"".join(
+        struct.pack("<QHH", key, ctype, card - 1)
+        for key, ctype, card, _ in containers
+    )
+    data_start = 8 + 12 * n + 4 * n
+    offs, payloads, pos = [], [], data_start
+    for _, _, _, payload in containers:
+        offs.append(struct.pack("<I", pos))
+        payloads.append(payload)
+        pos += len(payload)
+    return hdr + desc + b"".join(offs) + b"".join(payloads)
+
+
+def array_payload(vals):
+    return np.asarray(vals, dtype="<u2").tobytes()
+
+
+def bitmap_payload(lows):
+    bits = np.zeros(1 << 16, np.uint8)
+    bits[np.asarray(lows)] = 1
+    return np.packbits(bits, bitorder="little").tobytes()
+
+
+def run_payload(pairs):  # (start, last) pairs, pilosa dialect
+    out = struct.pack("<H", len(pairs))
+    for s, l in pairs:
+        out += struct.pack("<HH", s, l)
+    return out
+
+
+def official_norun(containers):
+    """containers: list of (key, sorted_lows). Array/bitmap by cardinality."""
+    n = len(containers)
+    out = struct.pack("<II", 12346, n)
+    descs, bodies = [], []
+    pos = 8 + 4 * n + 4 * n
+    for key, lows in containers:
+        card = len(lows)
+        descs.append(struct.pack("<HH", key, card - 1))
+        body = (
+            array_payload(lows) if card <= 4096 else bitmap_payload(lows)
+        )
+        bodies.append((pos, body))
+        pos += len(body)
+    offs = b"".join(struct.pack("<I", p) for p, _ in bodies)
+    return out + b"".join(descs) + offs + b"".join(b for _, b in bodies)
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    cases = {}
+
+    # ---- valid files (differential: both decoders must agree) ----
+    cases["ok_empty_zero_keys.bin"] = pilosa_file([])
+    cases["ok_mixed_types.bin"] = pilosa_file(
+        [
+            (0, 1, 3, array_payload([1, 5, 9])),
+            (2, 2, 5000, bitmap_payload(list(range(5000)))),
+            (7, 3, 20, run_payload([(10, 19), (100, 109)])),
+        ]
+    )
+    cases["ok_oplog_tail_ignored.bin"] = (
+        pilosa_file([(1, 1, 2, array_payload([7, 8]))]) + b"\x13\x07junk-oplog"
+    )
+    cases["ok_official_norun.bin"] = official_norun(
+        [(0, list(range(10))), (3, list(range(0, 60000, 7)))]
+    )
+    cases["ok_key_above_2e16.bin"] = pilosa_file(
+        [(1 << 40, 1, 2, array_payload([0, 65535]))]
+    )
+
+    # ---- malformed headers ----
+    cases["bad_empty_file.bin"] = b""
+    cases["bad_short_header.bin"] = b"\x3c\x30\x00"
+    cases["bad_unknown_cookie.bin"] = struct.pack("<II", 99, 1)
+    cases["bad_version.bin"] = struct.pack("<HBBI", 12348, 9, 0, 0)
+    cases["bad_huge_n_keys.bin"] = pilosa_header(0xFFFFFFFF)
+    cases["bad_header_overrun.bin"] = pilosa_header(4) + b"\x00" * 10
+
+    # ---- key ordering ----
+    good = [(5, 1, 2, array_payload([1, 2])), (3, 1, 2, array_payload([4, 5]))]
+    cases["bad_nonincreasing_keys.bin"] = pilosa_file(good)
+    dup = [(5, 1, 2, array_payload([1, 2])), (5, 1, 2, array_payload([4, 5]))]
+    cases["bad_duplicate_keys.bin"] = pilosa_file(dup)
+
+    # ---- overrunning containers ----
+    f = bytearray(pilosa_file([(0, 1, 100, array_payload([1, 2]))]))
+    cases["bad_array_overrun.bin"] = bytes(f)
+    f = bytearray(pilosa_file([(0, 2, 5000, b"\x00" * 100)]))
+    cases["bad_bitmap_overrun.bin"] = bytes(f)
+    f = bytearray(pilosa_file([(0, 3, 10, struct.pack("<H", 500))]))
+    cases["bad_run_count_overrun.bin"] = bytes(f)
+    cases["bad_run_bounds.bin"] = pilosa_file(
+        [(0, 3, 10, run_payload([(50, 10)]))]  # last < start
+    )
+    cases["bad_container_type.bin"] = pilosa_file(
+        [(0, 9, 2, array_payload([1, 2]))]
+    )
+    # offset table pointing past the buffer
+    body = bytearray(pilosa_file([(0, 1, 2, array_payload([1, 2]))]))
+    struct.pack_into("<I", body, 8 + 12, 0xFFFFFF)
+    cases["bad_offset_past_end.bin"] = bytes(body)
+
+    # ---- official-format malformations ----
+    ok_off = bytearray(official_norun([(0, [1, 2, 3])]))
+    cases["bad_official_truncated.bin"] = bytes(ok_off[: len(ok_off) - 4])
+    swapped = official_norun([(4, [1, 2]), (1, [3, 4])])
+    cases["bad_official_nonincreasing.bin"] = swapped
+    # run-cookie with absurd container count in the high bits
+    cases["bad_official_runcookie_trunc.bin"] = struct.pack("<I", (0xFFFF << 16) | 12347)
+
+    for name, data in sorted(cases.items()):
+        with open(os.path.join(OUT, name), "wb") as fh:
+            fh.write(data)
+    print(f"wrote {len(cases)} corpus files to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

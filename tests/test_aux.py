@@ -8,6 +8,7 @@ import json
 import urllib.request
 
 import numpy as np
+import pytest
 
 from pilosa_tpu.testing import ClusterHarness
 from pilosa_tpu.utils import logger as loggermod
@@ -147,3 +148,85 @@ def test_long_query_logging():
         srv.api.create_field("lq", "lf", options={"type": "set"})
         srv.api.query("lq", "Count(Row(lf=0))")
     assert any("slow query" in m for m in captured)
+
+
+# ---------------------------------------------------------------------------
+# force_cpu containment (VERDICT r2 weak #8) + paranoia guards (#6b)
+# ---------------------------------------------------------------------------
+
+
+class TestForceCpuContainment:
+    def test_normal_path_applied(self):
+        """conftest already ran force_cpu(8): devices must be CPU and the
+        surgery must have left the registry patched."""
+        import jax
+
+        assert all(d.platform == "cpu" for d in jax.devices())
+        assert len(jax.devices()) == 8
+
+    def test_drift_raises_loudly(self):
+        from pilosa_tpu.utils.cpuonly import (
+            CpuOnlyDriftError,
+            _patch_backend_factories,
+        )
+
+        class NoRegistry:
+            pass
+
+        with pytest.raises(CpuOnlyDriftError, match="JAX upgrade"):
+            _patch_backend_factories(NoRegistry())
+
+        class MissingCpu:
+            _backend_factories = {"tpu": object()}
+
+        with pytest.raises(CpuOnlyDriftError, match="no 'cpu' entry"):
+            _patch_backend_factories(MissingCpu())
+
+        class BadShape:
+            _backend_factories = {"cpu": object(), "tpu": object()}
+
+        with pytest.raises(CpuOnlyDriftError, match="factory/fail_quietly"):
+            _patch_backend_factories(BadShape())
+
+
+class TestParanoia:
+    def test_mutations_pass_under_paranoia(self, monkeypatch):
+        import numpy as np
+
+        from pilosa_tpu.core import rowstore
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        monkeypatch.setattr(rowstore, "PARANOIA", True)
+        frag = Fragment(None, "i", "f", "standard", 0).open()
+        rng = np.random.default_rng(2)
+        frag.bulk_import(
+            rng.integers(0, 5, 500).astype(np.uint64),
+            rng.integers(0, SHARD_WIDTH, 500).astype(np.uint64),
+        )
+        frag.bulk_import(
+            np.zeros(100, np.uint64),
+            rng.integers(0, SHARD_WIDTH, 100).astype(np.uint64),
+            clear=True,
+        )
+        words = np.zeros(SHARD_WIDTH // 32, np.uint32)
+        words[:200] = 0xFFFFFFFF
+        frag.import_row_words(7, words)
+
+    def test_corruption_detected(self, monkeypatch):
+        import numpy as np
+
+        from pilosa_tpu.core import rowstore
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        monkeypatch.setattr(rowstore, "PARANOIA", True)
+        frag = Fragment(None, "i", "f", "standard", 0).open()
+        words = np.zeros(SHARD_WIDTH // 32, np.uint32)
+        words[:600] = 0xFFFFFFFF  # >n_words/2 bits: stays dense
+        frag.import_row_words(1, words)
+        assert frag._rows[1].dense is not None
+        # corrupt the maintained cardinality behind the store's back
+        frag._rows[1]._n += 5
+        with pytest.raises(AssertionError, match="maintained count"):
+            frag.set_bit(1, 3_000)
