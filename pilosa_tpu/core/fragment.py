@@ -44,6 +44,130 @@ BSI_EXISTS_BIT = 0
 BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
 
+# Lazy host snapshot tier: fragments open by indexing the snapshot headers
+# and memory-mapping payloads, materializing RowBits on first access —
+# holder open is O(rows), untouched rows stay on disk (the host analog of
+# the reference's zero-copy mmap storage, fragment.go:311 + syswrap).
+# PILOSA_TPU_LAZY_SNAPSHOTS=0 forces eager loads (debugging aid).
+_LAZY_SNAPSHOTS = os.environ.get("PILOSA_TPU_LAZY_SNAPSHOTS", "1") in ("1", "true")
+
+
+class _LazyRows:
+    """MutableMapping-shaped row store over an on-disk snapshot.
+
+    Materialized rows (mutated or read) live in `_mat` and take precedence;
+    everything else is served by seeking into the snapshot file on demand
+    (open-per-access: no fd is held between reads, so thousands of lazy
+    fragments cost zero resident fds — the page cache keeps repeat reads
+    cheap). After snapshot() rewrites the file, rebase() re-indexes against
+    the new file while keeping materialized rows (they are the
+    authoritative, identical state that was just written)."""
+
+    __slots__ = ("n_bits", "path", "_mat", "_index")
+
+    def __init__(self, path: str, expect_n_bits: int):
+        _, n_bits, index = walmod.read_snapshot_index(path)
+        if n_bits != expect_n_bits:
+            raise ValueError(
+                f"{path}: snapshot width {n_bits} != configured "
+                f"SHARD_WIDTH {expect_n_bits}"
+            )
+        self.n_bits = n_bits
+        self.path = path
+        self._mat: Dict[int, RowBits] = {}
+        self._index = index
+
+    def _read_payload(self, off: int, n: int) -> np.ndarray:
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            data = f.read(n * 4)
+        if len(data) != n * 4:
+            raise ValueError(f"{self.path}: truncated payload at {off}")
+        return np.frombuffer(data, dtype="<u4")
+
+    # -- mapping protocol --------------------------------------------------
+
+    def __getitem__(self, row_id: int) -> RowBits:
+        rb = self._mat.get(row_id)
+        if rb is None:
+            meta = self._index.get(row_id)
+            if meta is None:
+                raise KeyError(row_id)
+            rep, off, n = meta
+            payload = self._read_payload(off, n)
+            rb = self._mat[row_id] = RowBits.from_payload(self.n_bits, rep, payload)
+        return rb
+
+    def get(self, row_id: int, default=None):
+        if row_id in self._mat or row_id in self._index:
+            return self[row_id]
+        return default
+
+    def __setitem__(self, row_id: int, rb: RowBits) -> None:
+        self._mat[row_id] = rb
+
+    def __delitem__(self, row_id: int) -> None:
+        found = self._mat.pop(row_id, None) is not None
+        found = self._index.pop(row_id, None) is not None or found
+        if not found:
+            raise KeyError(row_id)
+
+    def __contains__(self, row_id) -> bool:
+        return row_id in self._mat or row_id in self._index
+
+    def __iter__(self):
+        return iter(self._mat.keys() | self._index.keys())
+
+    def __len__(self) -> int:
+        return len(self._mat.keys() | self._index.keys())
+
+    def __bool__(self) -> bool:
+        return bool(self._mat) or bool(self._index)
+
+    def items(self):
+        for row_id in self:
+            yield row_id, self[row_id]
+
+    def values(self):
+        for row_id in self:
+            yield self[row_id]
+
+    def keys(self):
+        return self._mat.keys() | self._index.keys()
+
+    # -- lazy-aware accessors ----------------------------------------------
+
+    def count_of(self, row_id: int) -> int:
+        """Row cardinality WITHOUT materializing: array reps know it from
+        the header; dense reps popcount the mapped payload (page cache,
+        no resident RowBits)."""
+        rb = self._mat.get(row_id)
+        if rb is not None:
+            return rb.count()
+        meta = self._index.get(row_id)
+        if meta is None:
+            return 0
+        rep, off, n = meta
+        if rep == rowstore_mod.ARRAY_REP:
+            return n
+        return rowstore_mod._popcount_words(self._read_payload(off, n))
+
+    def rep_payload(self, row_id: int) -> Tuple[int, np.ndarray]:
+        """(rep, payload) for snapshot writing, without materializing."""
+        rb = self._mat.get(row_id)
+        if rb is not None:
+            return rb.rep(), rb.payload()
+        rep, off, n = self._index[row_id]
+        return rep, self._read_payload(off, n)
+
+    def rebase(self, path: str) -> None:
+        """Point unmaterialized rows at a freshly written snapshot file.
+        Materialized rows may appear in both _mat and _index afterwards —
+        that is fine: iteration/len use the key-set union and __getitem__
+        prefers _mat, whose content is identical to what was written."""
+        self.path = path
+        _, _, self._index = walmod.read_snapshot_index(path)
+
 
 class Fragment:
     """One shard of one view of one field.
@@ -119,13 +243,19 @@ class Fragment:
             if self.path is not None:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 if os.path.exists(self.snap_path):
-                    _, n_bits, rows = walmod.read_snapshot(self.snap_path)
-                    if n_bits != SHARD_WIDTH:
-                        raise ValueError(
-                            f"{self.snap_path}: snapshot width {n_bits} != "
-                            f"configured SHARD_WIDTH {SHARD_WIDTH}"
-                        )
-                    self._rows = rows
+                    # mutex fields load eagerly: rebuilding the col->row
+                    # mutex vector needs every bit anyway, so laziness
+                    # would only add indexing overhead
+                    if _LAZY_SNAPSHOTS and self._mutex_map is None:
+                        self._rows = _LazyRows(self.snap_path, SHARD_WIDTH)
+                    else:
+                        _, n_bits, rows = walmod.read_snapshot(self.snap_path)
+                        if n_bits != SHARD_WIDTH:
+                            raise ValueError(
+                                f"{self.snap_path}: snapshot width {n_bits} != "
+                                f"configured SHARD_WIDTH {SHARD_WIDTH}"
+                            )
+                        self._rows = rows
                 for op, positions in walmod.replay_wal(self.wal_path):
                     if op == walmod.OP_ROW_WORDS:
                         self._apply_row_words(
@@ -180,12 +310,17 @@ class Fragment:
 
     def recalculate_cache(self) -> None:
         """Rebuild the cache from exact per-row counts
-        (reference: api.go RecalculateCaches)."""
+        (reference: api.go RecalculateCaches). Lazy stores count from the
+        header index / mapped payloads without materializing rows."""
         with self._mu:
             self.cache.clear()
-            self.cache.bulk_add(
-                (row_id, rb.count()) for row_id, rb in self._rows.items()
-            )
+            count_of = getattr(self._rows, "count_of", None)
+            if count_of is not None:
+                self.cache.bulk_add((rid, count_of(rid)) for rid in self._rows)
+            else:
+                self.cache.bulk_add(
+                    (row_id, rb.count()) for row_id, rb in self._rows.items()
+                )
 
     def _rebuild_mutex_map(self) -> None:
         self._mutex_map = {}
@@ -252,8 +387,12 @@ class Fragment:
             return rb is not None and rb.contains(col % SHARD_WIDTH)
 
     def row_count(self, row_id: int) -> int:
-        """Cardinality of one row (host metadata; used by caches/imports)."""
+        """Cardinality of one row (host metadata; used by caches/imports).
+        Lazy stores answer from header metadata without materializing."""
         with self._mu:
+            count_of = getattr(self._rows, "count_of", None)
+            if count_of is not None:
+                return count_of(row_id)
             rb = self._rows.get(row_id)
             return rb.count() if rb is not None else 0
 
@@ -269,6 +408,11 @@ class Fragment:
         per-call locking would dominate)."""
         with self._mu:
             rows = self._rows
+            count_of = getattr(rows, "count_of", None)
+            if count_of is not None:
+                return np.fromiter(
+                    (count_of(r) for r in row_ids), np.uint64, len(row_ids)
+                )
             return np.fromiter(
                 (rb.count() if (rb := rows.get(r)) is not None else 0 for r in row_ids),
                 np.uint64,
@@ -433,10 +577,12 @@ class Fragment:
                         f"row {row_id}: cache count {cached} != "
                         f"rowstore count {rb.count()}"
                     )
-            if self._mutex_map is not None and rb.count():
+            if self._mutex_map is not None and self._open and rb.count():
                 # mutex invariant: every set bit's column maps back to
                 # this row in the mutex vector (bounded spot check without
-                # materializing the row)
+                # materializing the row). Skipped during open()'s WAL
+                # replay: the vector is only rebuilt after replay, so
+                # snapshot-loaded columns are not in it yet.
                 for col in rb.first_positions(64):
                     if self._mutex_map.get(int(col)) != row_id:
                         raise AssertionError(
@@ -801,6 +947,10 @@ class Fragment:
                 self._op_n = 0
                 return
             walmod.write_snapshot(self.snap_path, self.shard, SHARD_WIDTH, self._rows)
+            if isinstance(self._rows, _LazyRows):
+                # offsets moved with the rewrite: re-index unmaterialized
+                # rows against the new file (materialized rows unaffected)
+                self._rows.rebase(self.snap_path)
             if self._wal is not None:
                 self._wal.truncate()
             self._op_n = 0

@@ -25,7 +25,10 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
+from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Dict, Iterator, Tuple
 
 import numpy as np
@@ -43,17 +46,23 @@ OP_ROW_WORDS = 2
 _REC_HDR = struct.Struct("<IBII")
 
 
-def write_snapshot_stream(f, shard: int, n_bits: int, rows: Dict[int, RowBits]) -> None:
+def write_snapshot_stream(f, shard: int, n_bits: int, rows) -> None:
     """Write the snapshot record stream to an open binary file object.
 
     Single codec shared by on-disk snapshots and resize/backup streaming
-    (reference: the same WriteTo serves both, fragment.go:2436)."""
+    (reference: the same WriteTo serves both, fragment.go:2436). `rows` is
+    any mapping row_id -> RowBits; a mapping exposing `rep_payload(row_id)`
+    (the lazy snapshot tier) is serialized without materializing rows."""
     f.write(SNAP_MAGIC)
     f.write(struct.pack("<QQQ", shard, n_bits, len(rows)))
+    rep_payload = getattr(rows, "rep_payload", None)
     for row_id in sorted(rows):
-        rb = rows[row_id]
-        payload = rb.payload()
-        f.write(struct.pack("<QBQ", row_id, rb.rep(), len(payload)))
+        if rep_payload is not None:
+            rep, payload = rep_payload(row_id)
+        else:
+            rb = rows[row_id]
+            rep, payload = rb.rep(), rb.payload()
+        f.write(struct.pack("<QBQ", row_id, rep, len(payload)))
         f.write(payload.astype(np.uint32, copy=False).tobytes())
 
 
@@ -96,27 +105,112 @@ def read_snapshot(path: str) -> Tuple[int, int, Dict[int, RowBits]]:
         return read_snapshot_stream(f)
 
 
+def read_snapshot_index(path: str) -> Tuple[int, int, Dict[int, Tuple[int, int, int]]]:
+    """Header-only snapshot scan: (shard, n_bits, index) where
+    index[row_id] = (rep, payload_byte_offset, n_items). Payloads are
+    seeked over, not read — the lazy host tier's open cost is O(rows), not
+    O(bits) (the host analog of the reference's mmap open,
+    fragment.go:311 openStorage)."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        magic = _read_exact(f, 8)
+        if magic != SNAP_MAGIC:
+            raise ValueError(f"bad snapshot magic {magic!r}")
+        shard, n_bits, n_rows = struct.unpack("<QQQ", _read_exact(f, 24))
+        index: Dict[int, Tuple[int, int, int]] = {}
+        pos = 32
+        for _ in range(n_rows):
+            f.seek(pos)
+            row_id, rep, n_items = struct.unpack("<QBQ", _read_exact(f, 17))
+            payload_off = pos + 17
+            if payload_off + n_items * 4 > size:
+                raise ValueError("truncated snapshot: payload overruns file")
+            index[row_id] = (rep, payload_off, n_items)
+            pos = payload_off + n_items * 4
+    return shard, n_bits, index
+
+
+# Open-WAL-handle cap: a holder with thousands of fragments must not hold
+# thousands of fds (the reference caps open files via syswrap,
+# syswrap/file.go + max-file-count config). Writers above the cap close
+# their fd LRU-style and transparently reopen in append mode on next use.
+_MAX_OPEN_WALS = max(8, int(os.environ.get("PILOSA_TPU_MAX_OPEN_FILES", "256")))
+
+
 class WalWriter:
     """Append-only op log. One writer per fragment (single-writer, like the
-    reference's per-fragment storage lock)."""
+    reference's per-fragment storage lock); file handles are pooled under
+    _MAX_OPEN_WALS."""
+
+    _lru: "OrderedDict[int, WalWriter]" = OrderedDict()
+    _lru_mu = threading.Lock()
+    _next_tok = 0
 
     def __init__(self, path: str):
         self.path = path
-        self._f = open(path, "ab")
+        self._f = None
+        self._pinned = 0  # guarded by _lru_mu; evictor skips pinned fds
+        with WalWriter._lru_mu:
+            WalWriter._next_tok += 1
+            self._tok = WalWriter._next_tok
+        with self._pin():  # fail at construction if the path is bad
+            pass
+
+    @contextmanager
+    def _pin(self):
+        """Open (or touch) this writer's fd and hold it safe from LRU
+        eviction for the duration — a concurrent writer's eviction pass
+        must never close an fd mid-write. Victim fds are closed OUTSIDE
+        the lock so eviction I/O never stalls other writers."""
+        to_close = []
+        with WalWriter._lru_mu:
+            if self._f is None:
+                self._f = open(self.path, "ab")
+            WalWriter._lru[self._tok] = self
+            WalWriter._lru.move_to_end(self._tok)
+            self._pinned += 1
+            # detach oldest UNPINNED fds over the cap
+            excess = len(WalWriter._lru) - _MAX_OPEN_WALS
+            if excess > 0:
+                for tok in list(WalWriter._lru):
+                    if excess <= 0:
+                        break
+                    victim = WalWriter._lru[tok]
+                    if victim._pinned:
+                        continue
+                    del WalWriter._lru[tok]
+                    if victim._f is not None:
+                        to_close.append(victim._f)
+                        victim._f = None
+                    excess -= 1
+            f = self._f
+        for fh in to_close:
+            fh.close()
+        try:
+            yield f
+        finally:
+            with WalWriter._lru_mu:
+                self._pinned -= 1
 
     def append(self, op: int, positions: np.ndarray) -> None:
         payload = np.asarray(positions, dtype=np.uint64).tobytes()
         rec = _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))
-        self._f.write(rec + payload)
-        self._f.flush()
+        with self._pin() as f:
+            f.write(rec + payload)
+            f.flush()
 
     def truncate(self) -> None:
         """Reset after a snapshot has absorbed all ops."""
-        self._f.truncate(0)
-        self._f.seek(0)
+        with self._pin() as f:
+            f.truncate(0)
+            f.seek(0)
 
     def close(self) -> None:
-        self._f.close()
+        with WalWriter._lru_mu:
+            WalWriter._lru.pop(self._tok, None)
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
 
 def replay_wal(path: str) -> Iterator[Tuple[int, np.ndarray]]:

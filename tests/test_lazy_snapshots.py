@@ -1,0 +1,253 @@
+"""Lazy host snapshot tier (VERDICT r2 SURVEY-partial #6): fragments open
+by indexing snapshot headers + memmap; rows materialize on first access —
+the host analog of the reference's zero-copy mmap storage
+(fragment.go:311 openStorage, roaring.go:1437 RemapRoaringStorage)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.fragment import Fragment, _LazyRows
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def snap_dir(tmp_path, rng):
+    """A closed fragment on disk with 40 rows (mixed sparse/dense)."""
+    frag = Fragment(str(tmp_path / "frags" / "0"), "i", "f", "standard", 0).open()
+    expect = {}
+    for row in range(40):
+        n = 30_000 if row % 7 == 0 else 50 + row  # every 7th densifies
+        cols = np.unique(rng.integers(0, SHARD_WIDTH, n).astype(np.uint64))
+        frag.bulk_import(np.full(len(cols), row, np.uint64), cols)
+        expect[row] = set(int(c) for c in cols)
+    frag.snapshot()
+    frag.close()
+    return str(tmp_path / "frags" / "0"), expect
+
+
+def test_open_is_lazy_and_reads_correct(snap_dir):
+    path, expect = snap_dir
+    frag = Fragment(path, "i", "f", "standard", 0).open()
+    assert isinstance(frag._rows, _LazyRows)
+    assert len(frag._rows._mat) == 0, "open materialized rows"
+    # metadata answers without materializing
+    assert frag.row_ids() == sorted(expect)
+    assert frag.row_count(3) == len(expect[3])
+    assert frag.row_count(7) == len(expect[7])
+    assert len(frag._rows._mat) == 0, "count_of materialized rows"
+    # cache rebuilt from header metadata (sidecar was flushed on close, so
+    # it loads; drop it to force the lazy rebuild)
+    frag.cache.clear()
+    frag.recalculate_cache()
+    assert frag.cache.get(7) == len(expect[7])
+    assert len(frag._rows._mat) == 0, "cache rebuild materialized rows"
+    # actual reads materialize only what they touch
+    pos = frag.row_positions(5)
+    assert set(int(p) for p in pos) == expect[5]
+    assert set(frag._rows._mat) == {5}
+    frag.close()
+
+
+def test_mutations_on_lazy_rows(snap_dir):
+    path, expect = snap_dir
+    frag = Fragment(path, "i", "f", "standard", 0).open()
+    assert frag.set_bit(9, 12345) == (12345 not in expect[9])
+    expect[9].add(12345)
+    assert frag.row_count(9) == len(expect[9])
+    frag.clear_bit(9, 12345)
+    expect[9].discard(12345)
+    assert frag.row_count(9) == len(expect[9])
+    # untouched rows still lazy
+    assert 11 not in frag._rows._mat
+    assert frag.row_count(11) == len(expect[11])
+    frag.close()
+
+
+def test_wal_replay_over_lazy_map(snap_dir):
+    path, expect = snap_dir
+    frag = Fragment(path, "i", "f", "standard", 0).open()
+    frag.set_bit(4, 999_999)
+    frag.close()  # WAL holds the op (no snapshot triggered)
+    frag2 = Fragment(path, "i", "f", "standard", 0).open()
+    assert frag2.contains(4, 999_999)
+    assert frag2.row_count(4) == len(expect[4] | {999_999})
+    # only the WAL-touched row materialized during replay
+    assert 17 not in frag2._rows._mat
+    frag2.close()
+
+
+def test_snapshot_streams_unmaterialized_rows(snap_dir):
+    """snapshot()/to_bytes() must serialize lazy rows from the memmap
+    without materializing them, and rebase afterwards."""
+    path, expect = snap_dir
+    frag = Fragment(path, "i", "f", "standard", 0).open()
+    frag.set_bit(0, 77)  # one materialized row
+    expect[0].add(77)
+    blob = frag.to_bytes()
+    assert set(frag._rows._mat) == {0}, "to_bytes materialized rows"
+    frag.snapshot()
+    assert set(frag._rows._mat) == {0}, "snapshot materialized rows"
+    # everything still correct after rebase
+    for row in (0, 7, 13):
+        got = set(int(p) for p in frag.row_positions(row))
+        assert got == expect[row], row
+    frag.close()
+    # the streamed blob round-trips into another fragment
+    frag3 = Fragment(None, "i", "f", "standard", 0)
+    frag3.open()
+    frag3.from_bytes(blob)
+    for row in (0, 7, 39):
+        assert set(int(p) for p in frag3.row_positions(row)) == expect[row]
+
+
+def test_eager_mode_still_works(snap_dir, monkeypatch):
+    from pilosa_tpu.core import fragment as fragmod
+
+    path, expect = snap_dir
+    monkeypatch.setattr(fragmod, "_LAZY_SNAPSHOTS", False)
+    frag = Fragment(path, "i", "f", "standard", 0).open()
+    assert not isinstance(frag._rows, _LazyRows)
+    assert frag.row_count(3) == len(expect[3])
+    frag.close()
+
+
+def test_lazy_vs_eager_differential(snap_dir, monkeypatch, rng):
+    """Same fragment, both modes: identical ids, counts, positions and
+    block checksums."""
+    from pilosa_tpu.core import fragment as fragmod
+
+    path, _ = snap_dir
+    lazy = Fragment(path, "i", "f", "standard", 0).open()
+    with monkeypatch.context() as m:
+        m.setattr(fragmod, "_LAZY_SNAPSHOTS", False)
+        eager = Fragment(path, "i", "f", "standard", 0).open()
+    assert lazy.row_ids() == eager.row_ids()
+    for row in lazy.row_ids():
+        assert lazy.row_count(row) == eager.row_count(row), row
+    assert lazy.block_checksums() == eager.block_checksums()
+    lazy.close()
+    eager.close()
+
+
+class TestWalFdCap:
+    def test_open_wal_handles_bounded(self, tmp_path, monkeypatch):
+        """Thousands of fragments must not hold thousands of WAL fds
+        (reference: syswrap max-file-count). Evicted handles reopen
+        transparently and data survives reopen."""
+        from pilosa_tpu.core import wal as walmod
+
+        monkeypatch.setattr(walmod, "_MAX_OPEN_WALS", 4)
+        frags = []
+        for i in range(12):
+            f = Fragment(
+                str(tmp_path / "v" / str(i)), "i", "f", "standard", i
+            ).open()
+            f.set_bit(1, 100 + i)
+            frags.append(f)
+        open_fds = sum(
+            1 for w in walmod.WalWriter._lru.values() if w._f is not None
+        )
+        assert open_fds <= 4, open_fds
+        # interleaved writes across all writers still land correctly
+        for i, f in enumerate(frags):
+            f.set_bit(2, 200 + i)
+        for f in frags:
+            f.close()
+        for i in range(12):
+            f = Fragment(
+                str(tmp_path / "v" / str(i)), "i", "f", "standard", i
+            ).open()
+            assert f.contains(1, 100 + i) and f.contains(2, 200 + i), i
+            f.close()
+
+    def test_concurrent_appends_under_tiny_cap(self, tmp_path, monkeypatch):
+        import threading
+
+        from pilosa_tpu.core import wal as walmod
+
+        monkeypatch.setattr(walmod, "_MAX_OPEN_WALS", 8)
+        frags = [
+            Fragment(str(tmp_path / "c" / str(i)), "i", "f", "standard", i).open()
+            for i in range(16)
+        ]
+        errors = []
+
+        def hammer(start):
+            try:
+                for k in range(60):
+                    frags[(start + k) % 16].set_bit(k % 5, start * 1000 + k)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=hammer, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        for f in frags:
+            f.close()
+        # every write is durable across reopen
+        reopened = [
+            Fragment(str(tmp_path / "c" / str(i)), "i", "f", "standard", i).open()
+            for i in range(16)
+        ]
+        for start in range(6):
+            for k in range(60):
+                assert reopened[(start + k) % 16].contains(k % 5, start * 1000 + k)
+        for f in reopened:
+            f.close()
+
+
+def test_lazy_fragments_hold_no_fds(tmp_path, rng):
+    """Lazy fragments must not retain per-fragment fds (open-per-access);
+    a holder with thousands of fragments stays under RLIMIT_NOFILE."""
+    import os as _os
+
+    def nfds():
+        return len(_os.listdir("/proc/self/fd"))
+
+    frags = []
+    for i in range(20):
+        f = Fragment(str(tmp_path / "fd" / str(i)), "i", "f", "standard", i).open()
+        f.bulk_import(np.zeros(5, np.uint64), np.arange(5, dtype=np.uint64) + i)
+        f.snapshot()
+        f.close()
+        frags.append(f)
+    base = nfds()
+    reopened = [
+        Fragment(str(tmp_path / "fd" / str(i)), "i", "f", "standard", i).open()
+        for i in range(20)
+    ]
+    assert all(isinstance(f._rows, _LazyRows) for f in reopened)
+    # each open fragment holds at most its WAL fd (LRU-capped), never a
+    # snapshot fd; reading rows must not accumulate fds either
+    for f in reopened:
+        f.row_positions(0)
+    grew = nfds() - base
+    assert grew <= 21, grew  # WAL fds only (cap default 256 > 20)
+    for f in reopened:
+        f.close()
+    assert nfds() <= base + 1
+
+
+def test_mutex_fragment_reopen_under_paranoia(tmp_path, monkeypatch):
+    """Regression (r3 review): reopening a mutex fragment with WAL ops
+    under PILOSA_TPU_PARANOIA=1 must not false-positive — the mutex
+    vector is rebuilt only after WAL replay."""
+    from pilosa_tpu.core import rowstore
+
+    monkeypatch.setattr(rowstore, "PARANOIA", True)
+    path = str(tmp_path / "mx" / "0")
+    frag = Fragment(path, "i", "m", "standard", 0, mutex=True).open()
+    frag.set_bit(1, 10)
+    frag.set_bit(1, 11)
+    frag.snapshot()
+    frag.set_bit(1, 12)  # lands in the WAL only
+    frag.close()
+    frag2 = Fragment(path, "i", "m", "standard", 0, mutex=True).open()
+    assert frag2.contains(1, 10) and frag2.contains(1, 12)
+    # mutex semantics intact after reopen: a new row steals the column
+    frag2.set_bit(2, 10)
+    assert not frag2.contains(1, 10) and frag2.contains(2, 10)
+    frag2.close()
