@@ -45,10 +45,10 @@ BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
 
 # Lazy host snapshot tier: fragments open by indexing the snapshot headers
-# and memory-mapping payloads, materializing RowBits on first access —
-# holder open is O(rows), untouched rows stay on disk (the host analog of
-# the reference's zero-copy mmap storage, fragment.go:311 + syswrap).
-# PILOSA_TPU_LAZY_SNAPSHOTS=0 forces eager loads (debugging aid).
+# only, materializing RowBits from seek-reads on first access — holder
+# open is O(rows), untouched rows stay on disk in the page cache (the
+# host analog of the reference's zero-copy mmap storage, fragment.go:311
+# + syswrap). PILOSA_TPU_LAZY_SNAPSHOTS=0 forces eager loads.
 _LAZY_SNAPSHOTS = os.environ.get("PILOSA_TPU_LAZY_SNAPSHOTS", "1") in ("1", "true")
 
 
@@ -63,7 +63,7 @@ class _LazyRows:
     the new file while keeping materialized rows (they are the
     authoritative, identical state that was just written)."""
 
-    __slots__ = ("n_bits", "path", "_mat", "_index")
+    __slots__ = ("n_bits", "path", "_mat", "_index", "_bulk_f")
 
     def __init__(self, path: str, expect_n_bits: int):
         _, n_bits, index = walmod.read_snapshot_index(path)
@@ -76,11 +76,37 @@ class _LazyRows:
         self.path = path
         self._mat: Dict[int, RowBits] = {}
         self._index = index
+        self._bulk_f = None  # shared fd during bulk() scans
+
+    def bulk(self):
+        """Context manager holding ONE fd across a bulk scan (snapshot
+        writes, cache rebuilds): per-row open/close would cost ~4 syscalls
+        per row under the fragment lock."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _bulk():
+            if self._bulk_f is not None:  # nested: reuse
+                yield
+                return
+            with open(self.path, "rb") as f:
+                self._bulk_f = f
+                try:
+                    yield
+                finally:
+                    self._bulk_f = None
+
+        return _bulk()
 
     def _read_payload(self, off: int, n: int) -> np.ndarray:
-        with open(self.path, "rb") as f:
+        f = self._bulk_f
+        if f is not None:
             f.seek(off)
             data = f.read(n * 4)
+        else:
+            with open(self.path, "rb") as f2:
+                f2.seek(off)
+                data = f2.read(n * 4)
         if len(data) != n * 4:
             raise ValueError(f"{self.path}: truncated payload at {off}")
         return np.frombuffer(data, dtype="<u4")
@@ -312,11 +338,17 @@ class Fragment:
         """Rebuild the cache from exact per-row counts
         (reference: api.go RecalculateCaches). Lazy stores count from the
         header index / mapped payloads without materializing rows."""
+        import contextlib
+
         with self._mu:
             self.cache.clear()
             count_of = getattr(self._rows, "count_of", None)
             if count_of is not None:
-                self.cache.bulk_add((rid, count_of(rid)) for rid in self._rows)
+                bulk = getattr(self._rows, "bulk", None)
+                with bulk() if bulk is not None else contextlib.nullcontext():
+                    self.cache.bulk_add(
+                        (rid, count_of(rid)) for rid in self._rows
+                    )
             else:
                 self.cache.bulk_add(
                     (row_id, rb.count()) for row_id, rb in self._rows.items()

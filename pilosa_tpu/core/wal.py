@@ -53,17 +53,21 @@ def write_snapshot_stream(f, shard: int, n_bits: int, rows) -> None:
     (reference: the same WriteTo serves both, fragment.go:2436). `rows` is
     any mapping row_id -> RowBits; a mapping exposing `rep_payload(row_id)`
     (the lazy snapshot tier) is serialized without materializing rows."""
+    import contextlib
+
     f.write(SNAP_MAGIC)
     f.write(struct.pack("<QQQ", shard, n_bits, len(rows)))
     rep_payload = getattr(rows, "rep_payload", None)
-    for row_id in sorted(rows):
-        if rep_payload is not None:
-            rep, payload = rep_payload(row_id)
-        else:
-            rb = rows[row_id]
-            rep, payload = rb.rep(), rb.payload()
-        f.write(struct.pack("<QBQ", row_id, rep, len(payload)))
-        f.write(payload.astype(np.uint32, copy=False).tobytes())
+    bulk = getattr(rows, "bulk", None)
+    with bulk() if bulk is not None else contextlib.nullcontext():
+        for row_id in sorted(rows):
+            if rep_payload is not None:
+                rep, payload = rep_payload(row_id)
+            else:
+                rb = rows[row_id]
+                rep, payload = rb.rep(), rb.payload()
+            f.write(struct.pack("<QBQ", row_id, rep, len(payload)))
+            f.write(payload.astype(np.uint32, copy=False).tobytes())
 
 
 def _read_exact(f, n: int) -> bytes:
@@ -150,6 +154,7 @@ class WalWriter:
         self.path = path
         self._f = None
         self._pinned = 0  # guarded by _lru_mu; evictor skips pinned fds
+        self._closed = False
         with WalWriter._lru_mu:
             WalWriter._next_tok += 1
             self._tok = WalWriter._next_tok
@@ -164,6 +169,11 @@ class WalWriter:
         the lock so eviction I/O never stalls other writers."""
         to_close = []
         with WalWriter._lru_mu:
+            if self._closed:
+                # LRU-evicted fds reopen transparently, but a CLOSED writer
+                # must not resurrect its WAL file (a racing late write
+                # after fragment close/delete would silently recreate it)
+                raise ValueError(f"WalWriter for {self.path} is closed")
             if self._f is None:
                 self._f = open(self.path, "ab")
             WalWriter._lru[self._tok] = self
@@ -207,6 +217,7 @@ class WalWriter:
 
     def close(self) -> None:
         with WalWriter._lru_mu:
+            self._closed = True
             WalWriter._lru.pop(self._tok, None)
             if self._f is not None:
                 self._f.close()

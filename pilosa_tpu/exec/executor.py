@@ -34,6 +34,7 @@ from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.exec import translation
 from pilosa_tpu.exec.plan import (
+    BudgetExceeded,
     MultiCountPlan,
     PLeaf,
     PNary,
@@ -234,7 +235,7 @@ class _StackedLowering:
             if present and present * 8 < n:
                 raise SparseView("sparse view: stacked form would densify")
         if n * WORDS_PER_ROW * 4 * max(mult, 1) > DEVICE_CACHE.budget_bytes // 4:
-            raise Unsupported("stack exceeds device budget")
+            raise BudgetExceeded("stack exceeds device budget")
 
     def _view_leaf(self, view, row_id: int) -> PNode:
         key = ("row", id(view), row_id)
@@ -693,17 +694,65 @@ class Executor:
         list — only shards where some touched view is materialized, plus
         Shift relay successors — keeping the one-dispatch property while
         sparse shards stay free (reference: field.go:263-296)."""
-        lowered = self._lower_roots(idx, [c], shard_list)
+        try:
+            lowered = self._lower_roots(idx, [c], shard_list)
+        except BudgetExceeded:
+            return None  # callers that can chunk use _lower_plans instead
         if lowered is None:
             return None
         roots, low, n_out, out_shards = lowered
         return StackedPlan(roots[0], low.operands, low.scalars, n_out, out_shards)
 
-    def _lower_roots(self, idx: Index, calls: List[Call], shard_list):
+    def _lower_plans(self, idx: Index, c: Call, shard_list) -> Optional[List[StackedPlan]]:
+        """One stacked plan when the operands fit the device budget; a
+        handful of shard-axis-chunked plans when they don't (recursive
+        halving) — NEVER the dispatch-per-shard loop just because the index
+        is big. Returns None only for genuinely unsupported shapes."""
+        if not _STACKED_ENABLED or not shard_list:
+            return None
+
+        def one(chunk):
+            lowered = self._lower_roots(idx, [c], chunk, empty_ok=True)
+            if lowered is None:
+                return None
+            if lowered == self._EMPTY_LOWER:
+                return []
+            roots, low, n_out, out_shards = lowered
+            return [
+                StackedPlan(roots[0], low.operands, low.scalars, n_out, out_shards)
+            ]
+
+        return self._chunk_by_budget(list(shard_list), one)
+
+    @staticmethod
+    def _chunk_by_budget(shard_list, lower_one):
+        """Shared recursive halving for budget-exceeded lowering:
+        lower_one(chunk) returns a list of per-chunk results ([] = empty
+        range) or None for genuinely unsupported shapes; BudgetExceeded
+        splits the shard axis until chunks fit (or bottoms out below 16
+        shards, where the per-shard fallback takes over)."""
+        try:
+            return lower_one(shard_list)
+        except BudgetExceeded:
+            if len(shard_list) < 16:
+                return None  # can't subdivide usefully: per-shard fallback
+            mid = len(shard_list) // 2
+            left = Executor._chunk_by_budget(shard_list[:mid], lower_one)
+            right = Executor._chunk_by_budget(shard_list[mid:], lower_one)
+            if left is None or right is None:
+                return None
+            return left + right
+
+    _EMPTY_LOWER = "empty"  # sentinel: nothing materialized in this range
+
+    def _lower_roots(self, idx: Index, calls: List[Call], shard_list, empty_ok: bool = False):
         """Lower one or more bitmap call trees over ONE shared operand set
         (shared leaf memo: an operand referenced by several calls is
-        materialized once). Returns (roots, lowering, n_out, out_shards)
-        or None for per-shard fallback; semantic ExecErrors propagate."""
+        materialized once). Returns (roots, lowering, n_out, out_shards),
+        None for per-shard fallback, or (with empty_ok) the _EMPTY_LOWER
+        sentinel when no operand is materialized anywhere in the range;
+        semantic ExecErrors propagate, BudgetExceeded propagates for
+        shard-axis chunking."""
         if not _STACKED_ENABLED or not shard_list:
             return None
         shard_list = list(shard_list)
@@ -729,10 +778,13 @@ class Executor:
             roots = [low.lower(c) for c in calls]
         except SparseView:
             return self._lower_roots_compacted(idx, calls, shard_list, aug, k)
+        except BudgetExceeded:
+            raise  # recoverable by shard-axis chunking (_lower_plans)
         except Unsupported:
             return None
         if not low.operands:
-            return None  # nothing materialized anywhere: trivial fallback
+            # nothing materialized anywhere: trivial (empty) result
+            return self._EMPTY_LOWER if empty_ok else None
         return roots, low, len(shard_list), shard_list
 
     def _lower_roots_compacted(
@@ -768,6 +820,8 @@ class Executor:
         low = _StackedLowering(self, idx, compact, no_sparse_guard=True)
         try:
             roots = [low.lower(c) for c in calls]
+        except BudgetExceeded:
+            raise  # recoverable by shard-axis chunking (_lower_plans)
         except Unsupported:
             return None
         if not low.operands:
@@ -780,14 +834,15 @@ class Executor:
         self, idx: Index, c: Call, shards, opt: Optional[ExecOptions] = None
     ) -> Row:
         shard_list = self._shards_for(idx, shards)
-        sp = self._lower_stacked(idx, c, shard_list)
-        if sp is not None:
-            stack = np.asarray(sp.rows())
+        plans = self._lower_plans(idx, c, shard_list)
+        if plans is not None:
             segments = {}
-            for i, shard in enumerate(sp.out_shards):
-                if stack[i].any():
-                    # copy: a slice view would pin the whole [S, W] stack
-                    segments[shard] = stack[i].copy()
+            for sp in plans:
+                stack = np.asarray(sp.rows())
+                for i, shard in enumerate(sp.out_shards):
+                    if stack[i].any():
+                        # copy: a slice view would pin the whole [S, W] stack
+                        segments[shard] = stack[i].copy()
             return self._finish_bitmap_row(idx, c, Row(segments), opt)
         segments = {}
         memo: dict = {}
@@ -1092,7 +1147,11 @@ class Executor:
         lists = [self._shards_for(idx, shards, c) for c in calls]
         if any(lst != lists[0] for lst in lists[1:]):
             return None
-        lowered = self._lower_roots(idx, children, lists[0])
+        try:
+            lowered = self._lower_roots(idx, children, lists[0])
+        except BudgetExceeded:
+            # per-call execution chunks each count by shard axis instead
+            return None
         if lowered is None:
             return None
         roots, low, n_out, out_shards = lowered
@@ -1103,10 +1162,11 @@ class Executor:
         if len(c.children) != 1:
             raise ExecError("Count() only accepts a single bitmap input")
         shard_list = self._shards_for(idx, shards)
-        sp = self._lower_stacked(idx, c.children[0], shard_list)
-        if sp is not None:
-            # one jitted dispatch over all shards + one [S] host read
-            return sp.count()
+        plans = self._lower_plans(idx, c.children[0], shard_list)
+        if plans is not None:
+            # one jitted dispatch + one [S] host read per (budget-sized)
+            # shard chunk — usually exactly one
+            return sum(sp.count() for sp in plans)
         # Per-shard fallback: the algebra still lowers shard-by-shard, but
         # counts are fetched in fused chunked reads (one [G] transfer per
         # _FALLBACK_READ_CHUNK shards) instead of one host sync per shard —
@@ -1193,36 +1253,55 @@ class Executor:
                 range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + f.options.bit_depth),
                 low.shards,
             )
+        except BudgetExceeded:
+            raise  # recoverable: _bsi_chunks halves the shard axis
         except Unsupported:
             return None
         return exists, sign, planes, filt
+
+    def _bsi_chunks(self, idx: Index, c: Call, f: Field, shard_list):
+        """Stacked BSI operand sets, shard-axis-chunked under the device
+        budget: a big int field costs a few dispatches, never one per
+        shard. Returns a list of (exists, sign, planes, filt) tuples
+        ([] = trivially empty), or None for per-shard fallback."""
+
+        def one(chunk):
+            st = self._stacked_bsi(idx, c, f, chunk)
+            if st is None:
+                return None
+            if st == self._BSI_EMPTY:
+                return []
+            return [st]
+
+        return self._chunk_by_budget(list(shard_list), one)
 
     def _execute_sum(self, idx: Index, c: Call, shards) -> ValCount:
         field_name = c.string_arg("field") or self._field_arg_name(c)
         f = self._field_of(idx, field_name)
         if f.options.type != FIELD_TYPE_INT:
             raise ExecError(f"field {field_name} is not an int field")
-        st = self._stacked_bsi(idx, c, f, self._shards_for(idx, shards))
-        if st == self._BSI_EMPTY:
-            return ValCount(0, 0)
-        if st is not None:
-            # one jitted dispatch over all shards, exact host combine
-            exists, sign, planes, filt = st
+        chunks = self._bsi_chunks(idx, c, f, self._shards_for(idx, shards))
+        if chunks is not None:
+            # one jitted dispatch + one fused read per (budget-sized)
+            # shard chunk — usually exactly one; exact host combine
             from pilosa_tpu.ops import bsi as obsi
 
             depth = f.options.bit_depth
-            fused = np.asarray(
-                obsi.sum_counts_stacked(
-                    planes, exists, sign, exists if filt is None else filt, depth
-                ),
-                dtype=np.uint64,
-            )  # ONE device read: [1 + 2*depth, S]
-            count = int(fused[0].sum())
-            pos = fused[1 : 1 + depth].sum(axis=1)
-            neg = fused[1 + depth :].sum(axis=1)
-            total = sum(
-                (1 << i) * (int(pos[i]) - int(neg[i])) for i in range(depth)
-            )
+            count = 0
+            total = 0
+            for exists, sign, planes, filt in chunks:
+                fused = np.asarray(
+                    obsi.sum_counts_stacked(
+                        planes, exists, sign, exists if filt is None else filt, depth
+                    ),
+                    dtype=np.uint64,
+                )  # ONE device read: [1 + 2*depth, S]
+                count += int(fused[0].sum())
+                pos = fused[1 : 1 + depth].sum(axis=1)
+                neg = fused[1 + depth :].sum(axis=1)
+                total += sum(
+                    (1 << i) * (int(pos[i]) - int(neg[i])) for i in range(depth)
+                )
             return ValCount(value=total + count * f.options.base, count=count)
         bsiv = f.view(f.bsi_view_name())
         total = 0
@@ -1245,31 +1324,35 @@ class Executor:
         f = self._field_of(idx, field_name)
         if f.options.type != FIELD_TYPE_INT:
             raise ExecError(f"field {field_name} is not an int field")
-        st = self._stacked_bsi(idx, c, f, self._shards_for(idx, shards))
-        if st == self._BSI_EMPTY:
-            return ValCount(0, 0)
-        if st is not None:
-            exists, sign, planes, filt = st
+        chunks = self._bsi_chunks(idx, c, f, self._shards_for(idx, shards))
+        if chunks is not None:
             from pilosa_tpu.ops import bsi as obsi
 
-            fused = np.asarray(
-                obsi.min_max_signed(
-                    planes,
-                    exists,
-                    sign,
-                    exists if filt is None else filt,
-                    f.options.bit_depth,
-                    is_min,
-                ),
-                dtype=np.uint64,
-            )  # ONE device read: [magnitude, negative, any, counts...]
-            if not fused[2]:
+            best: Optional[Tuple[int, int]] = None  # (value, count)
+            for exists, sign, planes, filt in chunks:
+                fused = np.asarray(
+                    obsi.min_max_signed(
+                        planes,
+                        exists,
+                        sign,
+                        exists if filt is None else filt,
+                        f.options.bit_depth,
+                        is_min,
+                    ),
+                    dtype=np.uint64,
+                )  # ONE device read: [magnitude, negative, any, counts...]
+                if not fused[2]:
+                    continue
+                mag = int(fused[0])
+                val = -mag if fused[1] else mag
+                cnt = int(fused[3:].sum())
+                if best is None or (val < best[0] if is_min else val > best[0]):
+                    best = (val, cnt)
+                elif val == best[0]:
+                    best = (val, best[1] + cnt)
+            if best is None:
                 return ValCount(0, 0)
-            mag = int(fused[0])
-            return ValCount(
-                value=(-mag if fused[1] else mag) + f.options.base,
-                count=int(fused[3:].sum()),
-            )
+            return ValCount(value=best[0] + f.options.base, count=best[1])
         bsiv = f.view(f.bsi_view_name())
         best: Optional[Tuple[int, int]] = None
         if bsiv is not None:

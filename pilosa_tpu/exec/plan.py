@@ -48,6 +48,13 @@ class Unsupported(Exception):
     executor falls back to the per-shard path."""
 
 
+class BudgetExceeded(Unsupported):
+    """The stacks for this shard list would exceed the device budget.
+    Recoverable: the executor splits the shard axis and evaluates chunked
+    plans (a handful of dispatches) instead of falling back to the
+    dispatch-per-shard loop."""
+
+
 class SparseView(Unsupported):
     """A view is materialized in too few of the requested shards for a
     dense stack to be economical. Unlike other Unsupported shapes, the
