@@ -20,6 +20,7 @@ Position convention matches fragment.go:3090:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -78,25 +79,20 @@ class _LazyRows:
         self._index = index
         self._bulk_f = None  # shared fd during bulk() scans
 
+    @contextlib.contextmanager
     def bulk(self):
         """Context manager holding ONE fd across a bulk scan (snapshot
         writes, cache rebuilds): per-row open/close would cost ~4 syscalls
         per row under the fragment lock."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def _bulk():
-            if self._bulk_f is not None:  # nested: reuse
+        if self._bulk_f is not None:  # nested: reuse
+            yield
+            return
+        with open(self.path, "rb") as f:
+            self._bulk_f = f
+            try:
                 yield
-                return
-            with open(self.path, "rb") as f:
-                self._bulk_f = f
-                try:
-                    yield
-                finally:
-                    self._bulk_f = None
-
-        return _bulk()
+            finally:
+                self._bulk_f = None
 
     def _read_payload(self, off: int, n: int) -> np.ndarray:
         f = self._bulk_f
@@ -338,8 +334,6 @@ class Fragment:
         """Rebuild the cache from exact per-row counts
         (reference: api.go RecalculateCaches). Lazy stores count from the
         header index / mapped payloads without materializing rows."""
-        import contextlib
-
         with self._mu:
             self.cache.clear()
             count_of = getattr(self._rows, "count_of", None)

@@ -28,7 +28,7 @@ import struct
 import threading
 import zlib
 from collections import OrderedDict
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Dict, Iterator, Tuple
 
 import numpy as np
@@ -53,13 +53,11 @@ def write_snapshot_stream(f, shard: int, n_bits: int, rows) -> None:
     (reference: the same WriteTo serves both, fragment.go:2436). `rows` is
     any mapping row_id -> RowBits; a mapping exposing `rep_payload(row_id)`
     (the lazy snapshot tier) is serialized without materializing rows."""
-    import contextlib
-
     f.write(SNAP_MAGIC)
     f.write(struct.pack("<QQQ", shard, n_bits, len(rows)))
     rep_payload = getattr(rows, "rep_payload", None)
     bulk = getattr(rows, "bulk", None)
-    with bulk() if bulk is not None else contextlib.nullcontext():
+    with bulk() if bulk is not None else nullcontext():
         for row_id in sorted(rows):
             if rep_payload is not None:
                 rep, payload = rep_payload(row_id)

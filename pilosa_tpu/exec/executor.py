@@ -223,7 +223,8 @@ class _StackedLowering:
         """Refuse stacked lowering when densifying would blow memory: a view
         materialized in few of many shards raises SparseView (recovered by
         compacted re-lowering), a stack bigger than a quarter of the device
-        budget raises plain Unsupported (per-shard fallback)."""
+        budget raises BudgetExceeded (recovered by shard-axis chunking —
+        callers that can chunk must let it propagate, _chunk_by_budget)."""
         from pilosa_tpu.core.devcache import DEVICE_CACHE
         from pilosa_tpu.shardwidth import WORDS_PER_ROW
 
@@ -1379,13 +1380,21 @@ class Executor:
         return ValCount(value=best[0] + f.options.base, count=best[1])
 
     def _execute_min_max_row(self, idx: Index, c: Call, shards, is_min: bool):
-        """MinRow/MaxRow (executor.go:514-581)."""
+        """MinRow/MaxRow (executor.go:514-581). Filtered queries tally
+        candidate rows against ONE stacked filter eval in extreme-end-first
+        chunks with early stop — O(1..few) dispatches, not one per shard."""
         field_name = c.string_arg("field") or c.string_arg("_field")
         if field_name is None:
             field_name = self._field_arg_name(c)
         f = self._field_of(idx, field_name)
         v = f.view(VIEW_STANDARD)
         filter_call = c.children[0] if c.children else None
+        if filter_call is not None and v is not None:
+            batched = self._min_max_row_batched(
+                idx, v, filter_call, self._shards_for(idx, shards), is_min
+            )
+            if batched is not None:
+                return batched
         best_row = None
         best_count = 0
         if v is not None:
@@ -1423,6 +1432,47 @@ class Executor:
                     elif rid == best_row:
                         best_count += int(cnt)
         return {"id": 0 if best_row is None else best_row, "count": best_count}
+
+    def _min_max_row_batched(
+        self, idx: Index, view, filter_call: Call, shard_list, is_min: bool
+    ) -> Optional[dict]:
+        """Filtered MinRow/MaxRow: candidates walk from the extreme end in
+        tile-bounded chunks, each tallied against the stacked filter in one
+        batched pass; the first row with any filtered bits wins."""
+        present = [
+            (s, frag)
+            for s in shard_list
+            if (frag := view.fragment_if_exists(s)) is not None
+        ]
+        if not present:
+            return {"id": 0, "count": 0}
+        sp = self._lower_stacked(idx, filter_call, [s for s, _ in present])
+        if sp is None:
+            return None
+        if sp.out_shards != [s for s, _ in present]:
+            # compacted filter: shards outside it contribute nothing (the
+            # serial loop skips shards whose filter words are None)
+            outs = set(sp.out_shards)
+            present = [(s, frag) for s, frag in present if s in outs]
+            if not present:
+                return {"id": 0, "count": 0}
+        src_stack = sp.rows_full()
+        if not bool(np.asarray(ob.popcount(src_stack))):
+            # filter matched nothing anywhere: no candidate can score
+            return {"id": 0, "count": 0}
+        cand: set = set()
+        for _, frag in present:
+            cand.update(frag.row_ids())
+        ordered = sorted(cand, reverse=not is_min)
+        chunk = 64
+        for i in range(0, len(ordered), chunk):
+            ids = ordered[i : i + chunk]
+            ic = self._topn_icounts(view, ids, present, src_stack)
+            for rid in ids:
+                total = int(ic[rid].sum())
+                if total:
+                    return {"id": rid, "count": total}
+        return {"id": 0, "count": 0}
 
     # ------------------------------------------------------------------
     # writes

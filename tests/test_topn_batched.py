@@ -188,3 +188,43 @@ class TestDispatchCounts:
         rb.union_words(words)
         ref |= set(range(3200))
         assert rb.count() == len(ref)
+
+
+class TestMinMaxRowBatched:
+    def test_differential_and_dispatch_count(self, monkeypatch, rng):
+        """Filtered MinRow/MaxRow matches the per-shard path on a random
+        corpus and issues O(1) tallies, not one dispatch per shard."""
+        n_shards = 30
+        bits = []
+        for row in (2, 5, 9, 14, 30):
+            cols = rng.integers(0, n_shards * SHARD_WIDTH, 300)
+            bits += [(row, int(c)) for c in cols]
+        src = [(0, int(c)) for c in rng.integers(0, n_shards * SHARD_WIDTH, 4000)]
+        h, ex = _mk(bits, src_bits=src)
+        for pql in ("MinRow(Row(g=0), field=f)", "MaxRow(Row(g=0), field=f)"):
+            got = ex.execute("i", pql)
+            with monkeypatch.context() as m:
+                m.setattr(
+                    Executor,
+                    "_min_max_row_batched",
+                    lambda self, idx, v, fc, sl, is_min: None,
+                )
+                want = ex.execute("i", pql)
+            assert got == want, pql
+        exmod.TOPN_STATS["tally_evals"] = 0
+        ex.execute("i", "MinRow(Row(g=0), field=f)")
+        assert 0 < exmod.TOPN_STATS["tally_evals"] <= 2
+
+    def test_filter_matches_nothing(self, rng):
+        bits = [(r, r * 11 + i) for r in (3, 7) for i in range(5)]
+        src = [(0, SHARD_WIDTH * 2 + 1)]  # disjoint from all rows
+        h, ex = _mk(bits, src_bits=src)
+        assert ex.execute("i", "MinRow(Row(g=0), field=f)") == [
+            {"id": 0, "count": 0}
+        ]
+
+    def test_unfiltered_still_host(self, rng):
+        bits = [(r, r * 11 + i) for r in (3, 7, 12) for i in range(4)]
+        h, ex = _mk(bits)
+        assert ex.execute("i", "MinRow(field=f)")[0]["id"] == 3
+        assert ex.execute("i", "MaxRow(field=f)")[0]["id"] == 12
