@@ -1446,16 +1446,12 @@ class Executor:
         ]
         if not present:
             return {"id": 0, "count": 0}
-        sp = self._lower_stacked(idx, filter_call, [s for s, _ in present])
-        if sp is None:
+        lowered = self._stacked_filter(idx, filter_call, present)
+        if lowered is None:
             return None
-        if sp.out_shards != [s for s, _ in present]:
-            # compacted filter: shards outside it contribute nothing (the
-            # serial loop skips shards whose filter words are None)
-            outs = set(sp.out_shards)
-            present = [(s, frag) for s, frag in present if s in outs]
-            if not present:
-                return {"id": 0, "count": 0}
+        present, sp = lowered
+        if not present:
+            return {"id": 0, "count": 0}
         src_stack = sp.rows_full()
         if not bool(np.asarray(ob.popcount(src_stack))):
             # filter matched nothing anywhere: no candidate can score
@@ -1778,18 +1774,13 @@ class Executor:
         if not has_src:
             TOPN_STATS["batched"] += 1
             return self._topn_merged_hostfast(spec, present)
-        pshards = [s for s, _ in present]
-        sp = self._lower_stacked(idx, spec.src_call, pshards)
-        if sp is None:
+        lowered = self._stacked_filter(idx, spec.src_call, present)
+        if lowered is None:
             return None
+        present, sp = lowered
         TOPN_STATS["batched"] += 1
-        if sp.out_shards != pshards:
-            # compacted src: shards outside it have no src bits anywhere,
-            # so they contribute no candidates (per-shard path: src None)
-            outs = set(sp.out_shards)
-            present = [(s, frag) for s, frag in present if s in outs]
-            if not present:
-                return {}
+        if not present:
+            return {}
         src_stack = sp.rows_full()  # one plan dispatch, stays on device
         src_counts = None
         if spec.tanimoto > 0:
@@ -1874,6 +1865,22 @@ class Executor:
                 if n and taken == n:
                     break
         return merged
+
+    def _stacked_filter(self, idx: Index, filter_call: Call, present):
+        """Lower a filter bitmap over the present (shard, fragment) pairs
+        for a batched tally. Returns (present, plan) with `present`
+        restricted to the plan's out_shards when compaction dropped shards
+        — those have no filter bits anywhere, so they contribute nothing
+        (the per-shard paths skip None filter words the same way). None =
+        no stacked form (per-shard fallback)."""
+        pshards = [s for s, _ in present]
+        sp = self._lower_stacked(idx, filter_call, pshards)
+        if sp is None:
+            return None
+        if sp.out_shards != pshards:
+            outs = set(sp.out_shards)
+            present = [(s, frag) for s, frag in present if s in outs]
+        return present, sp
 
     def _topn_icounts(
         self, view, cand: List[int], present, src_stack

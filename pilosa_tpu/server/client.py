@@ -104,6 +104,22 @@ class InternalClient:
     def status(self, uri: str, timeout: Optional[float] = None) -> dict:
         return self._json("GET", uri, "/status", timeout=timeout)
 
+    # -- attr anti-entropy (holder.go:975-1019 syncIndex attr diffs) -------
+
+    def attr_blocks(self, uri: str, index: str, field: Optional[str]) -> list:
+        q = f"?field={field}" if field else ""
+        return self._json("GET", uri, f"/internal/index/{index}/attrs/blocks{q}")[
+            "blocks"
+        ]
+
+    def attr_block_data(
+        self, uri: str, index: str, field: Optional[str], block_id: int
+    ) -> dict:
+        q = f"?field={field}" if field else ""
+        return self._json(
+            "GET", uri, f"/internal/index/{index}/attrs/block/{block_id}{q}"
+        )["attrs"]
+
     # -- cluster messages (http/client.go:1017 SendMessage) ----------------
 
     def send_message(self, uri: str, message: dict) -> dict:

@@ -336,6 +336,30 @@ class Handler(BaseHTTPRequestHandler):
             }
         )
 
+    @route("GET", "/internal/index/(?P<index>[^/]+)/attrs/blocks")
+    def get_attr_blocks(self, index: str):
+        """Attr-store block checksums for anti-entropy diffing
+        (reference: attr.go:90 AttrBlock, holder.go:975 syncIndex).
+        ?field= selects a row attr store; absent = column attrs."""
+        store = self._attr_store(index, self.query.get("field"))
+        self._reply({"blocks": store.blocks()})
+
+    @route("GET", "/internal/index/(?P<index>[^/]+)/attrs/block/(?P<block>[0-9]+)")
+    def get_attr_block_data(self, index: str, block: str):
+        store = self._attr_store(index, self.query.get("field"))
+        self._reply({"attrs": {str(k): v for k, v in store.block_data(int(block)).items()}})
+
+    def _attr_store(self, index: str, field):
+        idx = self.node.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        if not field:
+            return idx.column_attr_store
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        return f.row_attr_store
+
     @route("POST", "/internal/sync")
     def post_internal_sync(self):
         """Trigger one anti-entropy pass now (operational hook; the loop

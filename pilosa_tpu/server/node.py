@@ -89,6 +89,9 @@ class NodeServer:
         # coordinator-driven resize job (cluster.go:1447-1561 resizeJob):
         # at most one at a time; RUNNING -> DONE | ABORTED
         self.resize_job: Optional[dict] = None
+        # last-synced fragment versions: AE prioritizes fragments mutated
+        # since their last pass (fresh drift repairs first under load)
+        self._ae_versions: Dict[tuple, int] = {}
         self._resize_mu = threading.Lock()
         self._resize_abort = threading.Event()
         self._resize_thread: Optional[threading.Thread] = None
@@ -322,8 +325,36 @@ class NodeServer:
         if tasks:
             with ThreadPoolExecutor(max_workers=min(8, len(tasks))) as pool:
                 list(pool.map(merge_avail, tasks))
+        # attrs replicate to every node (not sharded), so their repair runs
+        # even at replica_n=1 (reference: holder.go:975-1019 syncIndex)
+        self._sync_attrs(peers)
         if self.cluster.replica_n <= 1:
             return 0
+        sync_tasks = self._ae_tasks()
+        if not sync_tasks:
+            return 0
+
+        def run_sync(t) -> bool:
+            idx, f, vname, shard, replicas = t
+            try:
+                repaired = self._sync_fragment(idx, f, vname, shard, replicas)
+            except Exception as e:  # noqa: BLE001 - one bad fragment must
+                # not abort the rest of the pass
+                self.logger(f"anti-entropy {idx.name}/{f.name}/{shard}: {e}")
+                return False
+            frag = f.views[vname].fragment_if_exists(shard)
+            if frag is not None:
+                self._ae_versions[(idx.name, f.name, vname, shard)] = frag.version
+            return repaired
+
+        with ThreadPoolExecutor(max_workers=min(8, len(sync_tasks))) as pool:
+            return sum(pool.map(run_sync, sync_tasks))
+
+    def _ae_tasks(self) -> list:
+        """Fragment sync work list for one AE pass, locally-mutated-since-
+        last-pass fragments first (the reference walks in fixed order,
+        holder.go:911 — under sustained writes that starves fresh drift
+        behind a long tail of clean fragments)."""
         sync_tasks = []
         for idx in self.holder.indexes():
             for f in idx.fields(include_hidden=True):
@@ -341,19 +372,79 @@ class NodeServer:
                         if not replicas:
                             continue
                         sync_tasks.append((idx, f, vname, shard, replicas))
-        if not sync_tasks:
-            return 0
 
-        def run_sync(t) -> bool:
+        # prune recorded versions for fragments no longer in the walk
+        # (deleted/recreated indexes must not inherit stale "clean" marks,
+        # and the map must not grow forever under index churn)
+        live_keys = {
+            (idx.name, f.name, vname, shard)
+            for idx, f, vname, shard, _ in sync_tasks
+        }
+        for key in list(self._ae_versions):
+            if key not in live_keys:
+                del self._ae_versions[key]
+
+        def prio(t):
+            idx, f, vname, shard, _ = t
+            frag = f.views[vname].fragment_if_exists(shard)
+            key = (idx.name, f.name, vname, shard)
+            changed = frag is None or self._ae_versions.get(key) != frag.version
+            return 0 if changed else 1
+
+        sync_tasks.sort(key=prio)
+        return sync_tasks
+
+    def _sync_attrs(self, peers) -> None:
+        """Pull-merge attribute stores from peers via block-checksum diffs
+        (reference: holder.go:975-1019 syncIndex — column attrs per index,
+        row attrs per field; attr.go:90 AttrBlock.Diff). Pull-only and
+        ADD-ONLY, matching the reference's BulkSetAttrs merge: a delete
+        that a peer missed can be resurrected by drift repair (the
+        reference has the same property; deletes normally propagate via
+        the SetRowAttrs/SetColumnAttrs broadcast, not via AE). Peer block
+        lists are fetched concurrently; local checksums are computed once
+        per store and refreshed only after a merge."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not peers:
+            return
+        stores = []
+        for idx in self.holder.indexes():
+            stores.append((idx.name, None, idx.column_attr_store))
+            for f in idx.fields():
+                stores.append((idx.name, f.name, f.row_attr_store))
+
+        def fetch(args):
+            iname, fname, peer = args
             try:
-                return self._sync_fragment(*t)
-            except Exception as e:  # noqa: BLE001 - one bad fragment must
-                # not abort the rest of the pass
-                self.logger(f"anti-entropy {t[0].name}/{t[1].name}/{t[3]}: {e}")
-                return False
+                return self.client.attr_blocks(peer.uri, iname, fname)
+            except ClientError:
+                return None
 
-        with ThreadPoolExecutor(max_workers=min(8, len(sync_tasks))) as pool:
-            return sum(pool.map(run_sync, sync_tasks))
+        for iname, fname, store in stores:
+            jobs = [(iname, fname, p) for p in peers]
+            with ThreadPoolExecutor(max_workers=min(8, len(jobs))) as pool:
+                remotes = list(pool.map(fetch, jobs))
+            if not any(remotes):
+                continue
+            local = {b["id"]: b["checksum"] for b in store.blocks()}
+            for peer, remote in zip(peers, remotes):
+                for b in remote or []:
+                    if local.get(b["id"]) == b["checksum"]:
+                        continue
+                    try:
+                        data = self.client.attr_block_data(
+                            peer.uri, iname, fname, int(b["id"])
+                        )
+                    except ClientError:
+                        continue
+                    if data:
+                        store.set_bulk_attrs(
+                            {int(k): v for k, v in data.items()}
+                        )
+                        local = {
+                            b2["id"]: b2["checksum"] for b2 in store.blocks()
+                        }
 
     def _sync_fragment(self, idx, f, view: str, shard: int, replicas) -> bool:
         # materialize the local fragment if only replicas hold it
