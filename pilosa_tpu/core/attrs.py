@@ -67,19 +67,28 @@ class AttrStore:
     def blocks(self) -> List[dict]:
         """Per-block checksums for replica diffing."""
         with self._mu:
-            out = []
-            by_block: Dict[int, List[int]] = {}
-            for id in sorted(self._attrs):
-                by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append(id)
-            for block_id, ids in sorted(by_block.items()):
-                payload = json.dumps(
-                    [(i, sorted(self._attrs[i].items())) for i in ids]
-                ).encode()
-                out.append({"id": block_id, "checksum": zlib.crc32(payload)})
-            return out
+            bids = sorted({i // ATTR_BLOCK_SIZE for i in self._attrs})
+            return [
+                {"id": b, "checksum": self.block_checksum(b)} for b in bids
+            ]
 
     def block_data(self, block_id: int) -> Dict[int, dict]:
         with self._mu:
             lo = block_id * ATTR_BLOCK_SIZE
             hi = lo + ATTR_BLOCK_SIZE
             return {i: dict(a) for i, a in self._attrs.items() if lo <= i < hi}
+
+    def block_checksum(self, block_id: int) -> Optional[int]:
+        """Checksum of one block (same serialization as blocks()); None
+        when the block holds no attrs. Lets anti-entropy refresh a single
+        merged block without re-hashing the whole store."""
+        with self._mu:
+            lo = block_id * ATTR_BLOCK_SIZE
+            hi = lo + ATTR_BLOCK_SIZE
+            ids = sorted(i for i in self._attrs if lo <= i < hi)
+            if not ids:
+                return None
+            payload = json.dumps(
+                [(i, sorted(self._attrs[i].items())) for i in ids]
+            ).encode()
+            return zlib.crc32(payload)

@@ -442,7 +442,17 @@ class _Parser:
             # must not be followed by ident chars (e.g. `123abc` is a bare string)
             if self.pos < self.n and (self.src[self.pos].isalnum() or self.src[self.pos] in ":_-"):
                 self.fail("not a number")
-            return float(v) if "." in v else int(v)
+            if "." in v:
+                return float(v)
+            n = int(v)
+            # int args are int64 on the wire; the reference's grammar
+            # rejects out-of-range literals at parse (pqlpeg_test.go
+            # ArgOutOfBounds)
+            if not -(1 << 63) <= n < (1 << 63):
+                raise ParseError(
+                    f"integer literal out of int64 range: {v}", self.pos, self.src
+                )
+            return n
 
         def nested_call():
             name = self.regex(_IDENT_RE)

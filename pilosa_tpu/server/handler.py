@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from pilosa_tpu.exec.executor import ExecError, NotFoundError
+from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.server import wire
 from pilosa_tpu.server.api import ApiError, DisabledError
 
@@ -92,7 +93,7 @@ class Handler(BaseHTTPRequestHandler):
                     self._error(str(e), 404)
                 except DisabledError as e:
                     self._error(str(e), 503)
-                except (ExecError, ApiError, ValueError, KeyError) as e:
+                except (ExecError, ApiError, ParseError, ValueError, KeyError) as e:
                     self._error(str(e), 400)
                 except BrokenPipeError:
                     pass

@@ -382,7 +382,9 @@ class NodeServer:
         }
         for key in list(self._ae_versions):
             if key not in live_keys:
-                del self._ae_versions[key]
+                # pop, not del: a concurrent pass (AE loop + operator's
+                # POST /internal/sync) may have pruned the key already
+                self._ae_versions.pop(key, None)
 
         def prio(t):
             idx, f, vname, shard, _ = t
@@ -413,6 +415,8 @@ class NodeServer:
             stores.append((idx.name, None, idx.column_attr_store))
             for f in idx.fields():
                 stores.append((idx.name, f.name, f.row_attr_store))
+        if not stores:
+            return
 
         def fetch(args):
             iname, fname, peer = args
@@ -421,20 +425,27 @@ class NodeServer:
             except ClientError:
                 return None
 
+        # ONE pool over the full (store x peer) cross product — wall time
+        # is bounded by the slowest peer, not stores x peers round trips
+        jobs = [(iname, fname, p) for iname, fname, _ in stores for p in peers]
+        with ThreadPoolExecutor(max_workers=min(16, len(jobs))) as pool:
+            remotes = list(pool.map(fetch, jobs))
+        by_store: Dict[tuple, list] = {}
+        for (iname, fname, peer), remote in zip(jobs, remotes):
+            by_store.setdefault((iname, fname), []).append((peer, remote))
         for iname, fname, store in stores:
-            jobs = [(iname, fname, p) for p in peers]
-            with ThreadPoolExecutor(max_workers=min(8, len(jobs))) as pool:
-                remotes = list(pool.map(fetch, jobs))
-            if not any(remotes):
+            results = by_store.get((iname, fname), [])
+            if not any(r for _, r in results):
                 continue
             local = {b["id"]: b["checksum"] for b in store.blocks()}
-            for peer, remote in zip(peers, remotes):
+            for peer, remote in results:
                 for b in remote or []:
-                    if local.get(b["id"]) == b["checksum"]:
+                    bid = int(b["id"])
+                    if local.get(bid) == b["checksum"]:
                         continue
                     try:
                         data = self.client.attr_block_data(
-                            peer.uri, iname, fname, int(b["id"])
+                            peer.uri, iname, fname, bid
                         )
                     except ClientError:
                         continue
@@ -442,9 +453,8 @@ class NodeServer:
                         store.set_bulk_attrs(
                             {int(k): v for k, v in data.items()}
                         )
-                        local = {
-                            b2["id"]: b2["checksum"] for b2 in store.blocks()
-                        }
+                        # refresh only the merged block's checksum
+                        local[bid] = store.block_checksum(bid)
 
     def _sync_fragment(self, idx, f, view: str, shard: int, replicas) -> bool:
         # materialize the local fragment if only replicas hold it
