@@ -35,6 +35,8 @@ import sys
 import time
 
 os.environ.setdefault("PILOSA_TPU_HBM_BUDGET_MB", "16384")
+# bigger tally tiles at bench scale: fewer filtered-TopN chunk dispatches
+os.environ.setdefault("PILOSA_TPU_GROUPBY_TILE_MB", "1024")
 
 import numpy as np
 

@@ -65,12 +65,22 @@ class AttrStore:
     # -- anti-entropy support (attr.go:90) ---------------------------------
 
     def blocks(self) -> List[dict]:
-        """Per-block checksums for replica diffing."""
+        """Per-block checksums for replica diffing (one pass over the
+        store; block_checksum below serves single-block refreshes)."""
         with self._mu:
-            bids = sorted({i // ATTR_BLOCK_SIZE for i in self._attrs})
+            by_block: Dict[int, List[int]] = {}
+            for id in sorted(self._attrs):
+                by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append(id)
             return [
-                {"id": b, "checksum": self.block_checksum(b)} for b in bids
+                {"id": b, "checksum": self._checksum_of(ids)}
+                for b, ids in sorted(by_block.items())
             ]
+
+    def _checksum_of(self, ids: List[int]) -> int:
+        payload = json.dumps(
+            [(i, sorted(self._attrs[i].items())) for i in ids]
+        ).encode()
+        return zlib.crc32(payload)
 
     def block_data(self, block_id: int) -> Dict[int, dict]:
         with self._mu:
@@ -88,7 +98,4 @@ class AttrStore:
             ids = sorted(i for i in self._attrs if lo <= i < hi)
             if not ids:
                 return None
-            payload = json.dumps(
-                [(i, sorted(self._attrs[i].items())) for i in ids]
-            ).encode()
-            return zlib.crc32(payload)
+            return self._checksum_of(ids)

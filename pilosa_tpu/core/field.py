@@ -204,12 +204,25 @@ class Field:
             if not new:
                 return
             self.remote_available_shards.update(new)
-            p = self._avail_path
-            if p is not None:
-                tmp = p + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(sorted(self.remote_available_shards), f)
-                os.replace(tmp, p)
+            self._persist_available()
+
+    def remove_remote_available(self, shard: int) -> None:
+        """Forget one cluster-announced shard (reference:
+        handleDeleteRemoteAvailableShard operational repair)."""
+        with self._mu:
+            if shard not in self.remote_available_shards:
+                return
+            self.remote_available_shards.discard(int(shard))
+            self._persist_available()
+
+    def _persist_available(self) -> None:
+        """Write the availability sidecar atomically; call under _mu."""
+        p = self._avail_path
+        if p is not None:
+            tmp = p + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(sorted(self.remote_available_shards), f)
+            os.replace(tmp, p)
 
     # ------------------------------------------------------------------
     # views

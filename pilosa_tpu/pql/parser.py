@@ -353,10 +353,20 @@ class _Parser:
                 return op
         self.fail("expected condition operator")
 
+    def _int64(self, v: str) -> int:
+        """Parse an integer literal, rejecting values outside int64 (the
+        reference's grammar does, pqlpeg_test.go ArgOutOfBounds)."""
+        n = int(v)
+        if not -(1 << 63) <= n < (1 << 63):
+            raise ParseError(
+                f"integer literal out of int64 range: {v}", self.pos, self.src
+            )
+        return n
+
     def _conditional(self, call: Call):
         # conditional <- condint condLT condfield condLT condint
         # e.g. `5 < f <= 10`
-        low = int(self.regex(_COND_INT_RE))
+        low = self._int64(self.regex(_COND_INT_RE))
         self.sp()
         op1 = "<=" if self.try_lit("<=") else ("<" if self.try_lit("<") else self.fail("expected <"))
         self.sp()
@@ -364,7 +374,7 @@ class _Parser:
         self.sp()
         op2 = "<=" if self.try_lit("<=") else ("<" if self.try_lit("<") else self.fail("expected <"))
         self.sp()
-        high = int(self.regex(_COND_INT_RE))
+        high = self._int64(self.regex(_COND_INT_RE))
         self.sp()
         # reference semantics (ast.go:82 endConditional): strict bounds are
         # shifted inward to produce an inclusive BETWEEN.
@@ -444,15 +454,8 @@ class _Parser:
                 self.fail("not a number")
             if "." in v:
                 return float(v)
-            n = int(v)
-            # int args are int64 on the wire; the reference's grammar
-            # rejects out-of-range literals at parse (pqlpeg_test.go
-            # ArgOutOfBounds)
-            if not -(1 << 63) <= n < (1 << 63):
-                raise ParseError(
-                    f"integer literal out of int64 range: {v}", self.pos, self.src
-                )
-            return n
+            # int args are int64 on the wire (pqlpeg ArgOutOfBounds)
+            return self._int64(v)
 
         def nested_call():
             name = self.regex(_IDENT_RE)

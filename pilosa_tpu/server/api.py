@@ -610,6 +610,83 @@ class API:
     def hosts(self) -> List[dict]:
         return [n.to_json() for n in self.cluster.nodes]
 
+    def version(self) -> str:
+        from pilosa_tpu import __version__
+
+        return __version__
+
+    def info(self) -> dict:
+        """Host info (reference: api.Info — shard width + CPU counts)."""
+        import os as _os
+
+        logical = _os.cpu_count() or 1
+        physical = logical
+        try:
+            pairs = set()
+            with open("/proc/cpuinfo") as f:
+                phys = core = None
+                for line in f:
+                    if line.startswith("physical id"):
+                        phys = line.split(":")[1].strip()
+                    elif line.startswith("core id"):
+                        core = line.split(":")[1].strip()
+                    elif not line.strip() and phys is not None:
+                        pairs.add((phys, core))
+                        phys = core = None
+            if pairs:
+                physical = len(pairs)
+        except OSError:
+            pass
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "cpuPhysicalCores": physical,
+            "cpuLogicalCores": logical,
+        }
+
+    def index_info(self, name: str) -> dict:
+        idx = self.holder.index(name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {name}")
+        return {
+            "name": idx.name,
+            "options": {"keys": idx.keys, "trackExistence": idx.track_existence},
+            "shardWidth": SHARD_WIDTH,
+            "fields": [f.name for f in idx.fields()],
+        }
+
+    def set_coordinator(self, node_id: str) -> dict:
+        """Transfer coordinatorship (reference: api.go SetCoordinator ->
+        cluster.go:311 setCoordinator): rebuild the membership with the new
+        coordinator flag and broadcast the status to every member."""
+        self._validate("set_coordinator", write=True)
+        from pilosa_tpu.cluster.topology import Node
+
+        cur = self.cluster.nodes
+        if not any(n.id == node_id for n in cur):
+            raise NotFoundError(f"node not in cluster: {node_id}")
+        # preserve liveness marks (a DOWN node must stay DOWN)
+        members = [
+            Node(
+                id=n.id, uri=n.uri,
+                is_coordinator=(n.id == node_id), state=n.state,
+            )
+            for n in cur
+        ]
+        # every member must acknowledge: split-brain coordinatorship would
+        # give two nodes the key-translation writer role
+        self.server._send_status(
+            members, members, self.cluster.replica_n, self.server.state,
+            require=True,
+        )
+        return {"coordinator": node_id}
+
+    def delete_remote_available_shard(self, index: str, field: str, shard: int) -> None:
+        """Forget a cluster-known shard (reference:
+        handleDeleteRemoteAvailableShard — operational repair for stale
+        availability entries)."""
+        idx, f = self._index_field(index, field)
+        f.remove_remote_available(shard)
+
     def shard_nodes(self, index: str, shard: int) -> List[dict]:
         return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]
 

@@ -118,6 +118,58 @@ class Handler(BaseHTTPRequestHandler):
     def get_status(self):
         self._reply(self.api.status())
 
+    @route("GET", "/")
+    def get_home(self):
+        """Reference: handleHome — a pointer at the docs/endpoints."""
+        self._reply(
+            {
+                "name": "pilosa-tpu",
+                "version": self.api.version(),
+                "see": ["/status", "/schema", "/index/{index}/query"],
+            }
+        )
+
+    @route("GET", "/version")
+    def get_version(self):
+        self._reply({"version": self.api.version()})
+
+    @route("GET", "/info")
+    def get_info(self):
+        """Host info (reference: handleGetInfo — shard width + CPU info)."""
+        self._reply(self.api.info())
+
+    @route("GET", "/index/(?P<index>[^/]+)")
+    def get_index(self, index: str):
+        self._reply(self.api.index_info(index))
+
+    @route("GET", "/index")
+    def get_indexes(self):
+        self._reply(self.api.schema())
+
+    @route("POST", "/cluster/resize/set-coordinator")
+    def post_set_coordinator(self):
+        self._reply(self.api.set_coordinator(self._json_body().get("id", "")))
+
+    @route("GET", "/internal/nodes")
+    def get_internal_nodes(self):
+        self._reply(self.api.hosts())
+
+    @route("GET", "/internal/fragment/nodes")
+    def get_fragment_nodes(self):
+        """Owner nodes of one shard (reference: handleGetFragmentNodes)."""
+        index = self.query.get("index", "")
+        shard = int(self.query.get("shard", "0"))
+        self._reply(self.api.shard_nodes(index, shard))
+
+    @route(
+        "DELETE",
+        "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
+        "/remote-available-shards/(?P<shard>[0-9]+)",
+    )
+    def delete_remote_available_shard(self, index: str, field: str, shard: str):
+        self.api.delete_remote_available_shard(index, field, int(shard))
+        self._reply({})
+
     @route("GET", "/metrics")
     def get_metrics(self):
         """Prometheus exposition (reference: http/handler.go:282)."""

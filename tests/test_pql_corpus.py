@@ -109,3 +109,10 @@ def test_deep_equality_setrowattrs():
     assert c.args == {"z": 4, "_field": "myfield", "_row": 9}
     (c,) = parse("SetRowAttrs(myfield, 'rowKey', z=4)").calls
     assert c.args == {"z": 4, "_field": "myfield", "_row": "rowKey"}
+
+
+def test_condition_ints_also_bounded():
+    with pytest.raises(ParseError):
+        parse("Row(9223372036854775808 < a < 9223372036854775810)")
+    with pytest.raises(ParseError):
+        parse("Row(1 < a < 9223372036854775808)")

@@ -440,3 +440,39 @@ def test_ae_prioritizes_mutated_fragments():
         api.import_bits("pr", "f", [1], [target * SHARD_WIDTH + 9])
         reordered = c[0]._ae_tasks()
         assert reordered[0][3] == target, [t[3] for t in reordered]
+
+
+def test_reference_route_parity():
+    """Routes the reference serves that rounds 1-2 lacked: home, version,
+    info, index listing/info, set-coordinator, fragment nodes, and
+    remote-available-shards deletion."""
+    with ClusterHarness(2, in_memory=True) as c:
+        uri = c[0].node.uri
+        assert http_json("GET", f"{uri}/")["name"] == "pilosa-tpu"
+        assert http_json("GET", f"{uri}/version")["version"]
+        info = http_json("GET", f"{uri}/info")
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        assert info["shardWidth"] == SHARD_WIDTH
+        c[0].api.create_index("ri")
+        c[0].api.create_field("ri", "f", {"type": "set"})
+        idxs = http_json("GET", f"{uri}/index")
+        assert any(i["name"] == "ri" for i in idxs)
+        one = http_json("GET", f"{uri}/index/ri")
+        assert one["fields"] == ["f"] and one["shardWidth"] == SHARD_WIDTH
+        owners = http_json("GET", f"{uri}/internal/fragment/nodes?index=ri&shard=0")
+        assert len(owners) == 1 and owners[0]["id"] in ("node0", "node1")
+        nodes = http_json("GET", f"{uri}/internal/nodes")
+        assert {n["id"] for n in nodes} == {"node0", "node1"}
+        # set-coordinator transfers the role everywhere
+        http_json("POST", f"{uri}/cluster/resize/set-coordinator", {"id": "node1"})
+        for s in c.nodes:
+            coord = s.cluster.coordinator()
+            assert coord is not None and coord.id == "node1", s.node.id
+        # remote-available-shards delete
+        f = c[0].holder.index("ri").field("f")
+        f.add_remote_available([7])
+        http_json(
+            "DELETE", f"{uri}/internal/index/ri/field/f/remote-available-shards/7"
+        )
+        assert 7 not in f.remote_available_shards
