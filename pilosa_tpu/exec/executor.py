@@ -1896,6 +1896,8 @@ class Executor:
         host read, replacing the per-shard frag.row_counts loop."""
         from pilosa_tpu.exec import groupby as gb
 
+        import jax.numpy as jnp
+
         pshards = tuple(s for s, _ in present)
         s_pad, w = src_stack.shape
         r_c = gb._gmax(s_pad, w)
@@ -1908,12 +1910,19 @@ class Executor:
                 # stacked src may carry extra Shift-predecessor shards
                 src_stack = src_stack[: planes.shape[1]]
             TOPN_STATS["tally_evals"] += 1
-            chunks.append((ids, gb._counts_cross(src_stack[None], planes)[0]))
+            counts = gb._counts_cross(src_stack[None], planes)[0]
+            chunks.append((ids, counts[: len(ids)]))
+        # ONE device->host read for all chunks: per-chunk reads would cost
+        # one RTT each on tunneled hardware (~8 RTT/query at bench scale)
+        fused = np.asarray(
+            jnp.concatenate([c for _, c in chunks], axis=0), dtype=np.uint64
+        )[:, : len(present)]
         out: Dict[int, np.ndarray] = {}
-        for ids, counts in chunks:
-            h = np.asarray(counts, dtype=np.uint64)[:, : len(present)]
-            for k, rid in enumerate(ids):
-                out[rid] = h[k]
+        k = 0
+        for ids, _ in chunks:
+            for rid in ids:
+                out[rid] = fused[k]
+                k += 1
         return out
 
     def _topn_shard(self, idx: Index, spec: "_TopNSpec", shard: int) -> List[Tuple[int, int]]:

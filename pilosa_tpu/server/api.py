@@ -672,12 +672,28 @@ class API:
             )
             for n in cur
         ]
-        # every member must acknowledge: split-brain coordinatorship would
-        # give two nodes the key-translation writer role
-        self.server._send_status(
-            members, members, self.cluster.replica_n, self.server.state,
-            require=True,
-        )
+        old = [
+            Node(
+                id=n.id, uri=n.uri,
+                is_coordinator=n.is_coordinator, state=n.state,
+            )
+            for n in cur
+        ]
+        from pilosa_tpu.server.client import ClientError
+
+        # every member must acknowledge: split coordinatorship would give
+        # two nodes the key-translation writer role. On partial delivery,
+        # roll the old coordinator back everywhere before failing.
+        try:
+            self.server._send_status(
+                members, members, self.cluster.replica_n, self.server.state,
+                require=True,
+            )
+        except ClientError as e:
+            self.server._send_status(
+                old, old, self.cluster.replica_n, self.server.state, retries=10
+            )
+            raise ApiError(f"set-coordinator rolled back: {e}")
         return {"coordinator": node_id}
 
     def delete_remote_available_shard(self, index: str, field: str, shard: int) -> None:

@@ -187,16 +187,27 @@ class NodeServer:
         """Install the static cluster membership (all nodes must agree; the
         test/bootstrap harness calls this after every node has bound)."""
         self.cluster = Cluster(
-            nodes=[Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator) for n in nodes],
+            nodes=[
+                # preserve liveness marks: a node the sender saw DOWN must
+                # stay DOWN here too (placement skips DOWN nodes) until a
+                # probe says otherwise
+                Node(
+                    id=n.id, uri=n.uri,
+                    is_coordinator=n.is_coordinator, state=n.state,
+                )
+                for n in nodes
+            ],
             replica_n=replica_n if replica_n is not None else self.cluster.replica_n,
             partition_n=self.cluster.partition_n,
             hasher=self.cluster.hasher,
             state=STATE_NORMAL,
         )
-        # keep self.node identity in sync with the membership entry
+        # keep self.node identity in sync with the membership entry; we are
+        # definitionally alive, whatever a peer's stale view says
         mine = self.cluster.node_by_id(self.node.id)
         if mine is not None:
             mine.uri = self.node.uri
+            mine.state = "READY"
             self.node = mine
         self.wire_translation()
 
