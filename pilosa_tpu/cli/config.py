@@ -24,6 +24,9 @@ class ClusterConfig:
     hosts: List[str] = field(default_factory=list)
     replicas: int = 1
     coordinator: bool = False
+    # coordinator liveness-probe ticker, seconds; 0 disables (the SWIM
+    # role — reference gossip probes continuously, gossip/gossip.go:364)
+    probe_interval: float = 2.0
 
 
 @dataclass

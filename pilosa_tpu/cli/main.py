@@ -167,21 +167,55 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         bind=cfg.bind,
         replica_n=cfg.cluster.replicas,
         anti_entropy_interval=cfg.anti_entropy.interval,
+        probe_interval=cfg.cluster.probe_interval,
         stats_service=cfg.metric.service,
         metric_poll_interval=cfg.metric.poll_interval,
         long_query_time=cfg.long_query_time,
         logger=new_logger(verbose=cfg.verbose, stream=log_stream),
     )
     srv.start()
-    if hosts:
-        members = [Node(id=nid, uri=uri) for nid, uri in hosts]
-        if not any(nid == node_id for nid, _ in hosts):
-            members.append(Node(id=node_id, uri=srv.node.uri))
+    # static --cluster-hosts flags SEED a cluster; once membership is on
+    # disk (.topology, written whenever a multi-node topology installs),
+    # disk wins on reboot (cluster.go:1657-1692) — otherwise a restart
+    # would silently revert a resized cluster to its stale launch config
+    # and strand the re-placed fragments. Flags still HEAL peer URIs: the
+    # membership (ids/coordinator/replicaN) comes from disk, but an
+    # operator who moved a peer to a new address updates it via flags
+    # (the reference re-learns URIs through gossip; static flags are our
+    # address channel).
+    if srv.topology_restored:
+        if hosts:
+            healed = srv.heal_peer_uris(hosts)
+            print(
+                "cluster-hosts: membership restored from .topology"
+                + (f"; healed URIs for {healed}" if healed else ""),
+                file=sys.stderr,
+            )
+    elif hosts:
+        my_uri = cfg.bind if cfg.bind.startswith("http") else f"http://{cfg.bind}"
+        members = []
+        for nid, uri in hosts:
+            if uri == my_uri and nid != srv.node.id:
+                # the entry naming THIS address keeps the durable .id —
+                # two members with one URI would give placement a phantom
+                # owner no server identifies as
+                print(
+                    f"cluster-hosts id {nid!r} for this address overridden "
+                    f"by on-disk .id {srv.node.id!r}",
+                    file=sys.stderr,
+                )
+                nid = srv.node.id
+            members.append(Node(id=nid, uri=uri))
+        if not any(m.id == srv.node.id for m in members):
+            members.append(Node(id=srv.node.id, uri=srv.node.uri))
         members[0].is_coordinator = True
         srv.set_topology(members, replica_n=cfg.cluster.replicas)
-    if join:
+    if join and not srv.topology_restored:
         _join_on_boot(srv, join)
-    print(f"pilosa-tpu node {node_id} listening on {srv.node.uri}", file=sys.stderr)
+    print(
+        f"pilosa-tpu node {srv.node.id} listening on {srv.node.uri}",
+        file=sys.stderr,
+    )
     if wait:
         stop = []
         signal.signal(signal.SIGINT, lambda *a: stop.append(1))

@@ -48,13 +48,10 @@ class DisabledError(ApiError):
     (reference: ErrClusterDoesNotOwnShard / apiMethodNotAllowedError)."""
 
 
-# methods allowed per cluster state (api.go:1379-1393): reads survive
-# DEGRADED; writes and DDL require NORMAL; RESIZING allows only status/
-# internal traffic.
-_WRITE_METHODS = {
-    "create_index", "delete_index", "create_field", "delete_field",
-    "import_bits", "import_values", "import_roaring", "apply_schema",
-}
+# Cluster-state gating (api.go:101-105,1379-1393): DEGRADED allows the
+# full NORMAL method set (writes to a down replica are best-effort and
+# repaired by anti-entropy when it returns); RESIZING allows only
+# non-write queries and internal/status traffic.
 
 
 class API:
@@ -75,7 +72,9 @@ class API:
         state = self.server.state
         if state == STATE_NORMAL:
             return
-        if state == STATE_DEGRADED and not write and method not in _WRITE_METHODS:
+        if state == STATE_DEGRADED:
+            # same method set as NORMAL (api.go:104) — the cluster keeps
+            # serving writes while < replicaN nodes are down
             return
         if state == STATE_RESIZING and method in ("query",) and not write:
             return
@@ -380,10 +379,10 @@ class API:
                 applied += 1
             else:
                 # replica fan-out is best-effort per owner: a down replica
-                # is repaired by anti-entropy after it returns (divergence
-                # from the reference, which blocks writes in DEGRADED;
-                # availability is the TPU-native choice here). Zero live
-                # owners is still an error — nothing accepted the write.
+                # is repaired by anti-entropy after it returns (the
+                # reference likewise keeps accepting writes in DEGRADED,
+                # api.go:104). Zero live owners is still an error —
+                # nothing accepted the write.
                 from pilosa_tpu.server.client import ClientError
 
                 try:

@@ -48,12 +48,18 @@ class NodeServer:
         cluster_name: str = "cluster0",
         anti_entropy_interval: float = 0.0,  # 0 = manual sync only
         cache_flush_interval: float = 60.0,  # 0 = flush on close only
+        probe_interval: float = 0.0,  # 0 = no background liveness loop
         stats_service: str = "expvar",  # expvar|prometheus|statsd|none
         metric_poll_interval: float = 0.0,  # 0 = no runtime poller
         long_query_time: float = 0.0,  # seconds; 0 = disabled
         logger=None,
     ):
         self.data_dir = data_dir
+        # durable node identity: a data dir that already carries a .id keeps
+        # it across restarts regardless of flags (reference:
+        # holder.go:599-621 loadNodeID) — placement is keyed by id, so an id
+        # change would orphan every fragment the node holds
+        node_id = self._load_or_create_id(node_id)
         # a fresh node is its own coordinator until a topology install says
         # otherwise (set_topology syncs identity from the membership list)
         self.node = Node(id=node_id, uri="", is_coordinator=True)
@@ -70,6 +76,12 @@ class NodeServer:
         )
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
+        self.probe_interval = probe_interval
+        # True once start() restored membership from the on-disk .topology;
+        # the boot layer must then NOT override membership with static
+        # flags (flags seed the first multi-node boot and still heal peer
+        # URIs on later boots; membership itself comes from disk)
+        self.topology_restored = False
         self.long_query_time = long_query_time
         self.metric_poll_interval = metric_poll_interval
         from pilosa_tpu.utils import stats as statsmod
@@ -100,6 +112,124 @@ class NodeServer:
 
         self.api = API(self)
 
+    # -- durable identity + membership -------------------------------------
+    # Reference: holder.go:599-621 (.id) and cluster.go:1657-1692
+    # (.topology): a resized cluster must reboot into its post-resize
+    # membership from disk, not the stale static flags.
+
+    def _load_or_create_id(self, node_id: str) -> str:
+        if not self.data_dir:
+            return node_id
+        path = os.path.join(os.path.expanduser(self.data_dir), ".id")
+        try:
+            with open(path) as f:
+                disk_id = f.read().strip()
+            if disk_id:
+                return disk_id
+        except OSError:
+            pass
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(node_id)
+        os.replace(tmp, path)
+        return node_id
+
+    @property
+    def _topology_path(self) -> Optional[str]:
+        if not self.data_dir:
+            return None
+        return os.path.join(os.path.expanduser(self.data_dir), ".topology")
+
+    def _save_topology(self) -> None:
+        """Persist multi-node membership; a reset to a standalone cluster
+        removes the file so static flags seed the next boot again."""
+        path = self._topology_path
+        if path is None:
+            return
+        import json
+
+        try:
+            in_cluster = any(n.id == self.node.id for n in self.cluster.nodes)
+            if len(self.cluster.nodes) <= 1 or not in_cluster:
+                # standalone again, or removed from membership: forget the
+                # old cluster so flags seed the next boot
+                if os.path.exists(path):
+                    os.remove(path)
+                return
+            doc = {
+                "clusterName": self.cluster_name,
+                "replicaN": self.cluster.replica_n,
+                "partitionN": self.cluster.partition_n,
+                "nodes": [
+                    {
+                        "id": n.id,
+                        "uri": n.uri,
+                        "isCoordinator": n.is_coordinator,
+                        # liveness is probed fresh each boot, never trusted
+                        # from disk
+                    }
+                    for n in self.cluster.nodes
+                ],
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            self.logger(f"persist .topology: {e}")
+
+    def _restore_topology(self) -> None:
+        """Reinstall persisted membership on boot (called from start() once
+        the node's own URI is known, so the self entry heals a changed
+        bind)."""
+        path = self._topology_path
+        if path is None or not os.path.exists(path):
+            return
+        import json
+
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            nodes = [
+                Node(
+                    id=n["id"],
+                    uri=n.get("uri", ""),
+                    is_coordinator=n.get("isCoordinator", False),
+                )
+                for n in doc.get("nodes", [])
+            ]
+        except (OSError, ValueError, KeyError) as e:
+            self.logger(f"restore .topology: {e} (ignored; flags will seed)")
+            return
+        if len(nodes) <= 1 or not any(n.id == self.node.id for n in nodes):
+            return
+        self.set_topology(nodes, replica_n=doc.get("replicaN"))
+        self.topology_restored = True
+        self.logger(
+            f"restored {len(nodes)}-node topology from disk "
+            f"(replicaN={self.cluster.replica_n})"
+        )
+
+    def heal_peer_uris(self, hosts) -> List[str]:
+        """Update peer addresses from (id, uri) pairs without touching the
+        restored membership — the static-flag analog of the reference
+        re-learning a moved node's address through gossip. Returns the ids
+        whose URI changed."""
+        by_id = dict(hosts)
+        healed = []
+        for n in self.cluster.nodes:
+            if n.id == self.node.id:
+                continue
+            new_uri = by_id.get(n.id)
+            if new_uri and new_uri != n.uri:
+                n.uri = new_uri
+                healed.append(n.id)
+        if healed:
+            self.wire_translation()
+            self._save_topology()
+        return healed
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "NodeServer":
@@ -127,6 +257,12 @@ class NodeServer:
             target=self._httpd.serve_forever, name=f"http-{self.node.id}", daemon=True
         )
         self._http_thread.start()
+        self._restore_topology()
+        if self.probe_interval > 0:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name=f"probe-{self.node.id}", daemon=True
+            )
+            self._probe_thread.start()
         if self.anti_entropy_interval > 0:
             self._ae_thread = threading.Thread(
                 target=self._anti_entropy_loop, daemon=True
@@ -210,6 +346,7 @@ class NodeServer:
             mine.state = "READY"
             self.node = mine
         self.wire_translation()
+        self._save_topology()
 
     def wire_translation(self) -> None:
         """Install single-writer key translation: the coordinator's stores
@@ -268,24 +405,97 @@ class NodeServer:
             self._down_ids.add(node_id)
         else:
             self._down_ids.discard(node_id)
-        self.state = self.cluster.determine_state(self._down_ids)
+        # RESIZING is owned by the resize job's status flow: a liveness
+        # probe that resolves mid-freeze must not clobber it back to
+        # NORMAL (the job's final/rollback broadcast restores the state)
+        if self.state != STATE_RESIZING:
+            self.state = self.cluster.determine_state(self._down_ids)
 
-    def probe_peers(self) -> Dict[str, bool]:
-        """One failure-detection pass: /status every peer
-        (reference: confirmNodeDown, cluster.go:1724)."""
-        alive = {}
-        for n in self.cluster.nodes:
+    def probe_peers(self, timeout: float = 2.0) -> Dict[str, bool]:
+        """One failure-detection pass: /status every peer CONCURRENTLY, so
+        a resize (or liveness tick) over a cluster with several dead nodes
+        pays one probe timeout, not one per corpse (reference:
+        confirmNodeDown, cluster.go:1724)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        peers = list(self.cluster.nodes)
+
+        def probe(n: Node) -> bool:
             if n.id == self.node.id:
-                alive[n.id] = True
+                return True
+            try:
+                self.client.status(n.uri, timeout=timeout)
+                return True
+            except ClientError:
+                return False
+
+        if len(peers) > 1:
+            with ThreadPoolExecutor(max_workers=min(16, len(peers))) as pool:
+                results = list(pool.map(probe, peers))
+        else:
+            results = [probe(n) for n in peers]
+        alive = {}
+        for n, ok in zip(peers, results):
+            alive[n.id] = ok
+            if n.id != self.node.id:
+                self.set_node_state(n.id, "READY" if ok else "DOWN")
+        return alive
+
+    # -- background liveness (the gossip/SWIM role) ------------------------
+
+    def _probe_loop(self) -> None:
+        """Continuous failure detection: the coordinator probes every member
+        on a ticker and broadcasts membership/state changes, so a node that
+        dies while the cluster idles flips the cluster NORMAL⇄DEGRADED
+        without waiting for a query to fail over (the reference gets this
+        from memberlist's SWIM loop, gossip/gossip.go:364-443; here it is
+        an explicit probe ticker on the coordinator)."""
+        while not self._closing.wait(self.probe_interval):
+            try:
+                self.run_probe_pass()
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self.logger(f"liveness probe: {e}")
+
+    def run_probe_pass(self, timeout: float = 2.0) -> bool:
+        """One coordinator liveness tick. Returns True when a state change
+        was detected and broadcast. Non-coordinators learn liveness from the
+        resulting cluster-status broadcast, not by probing themselves."""
+        if not self.node.is_coordinator or len(self.cluster.nodes) <= 1:
+            return False
+        if self.state == STATE_RESIZING:
+            return False  # the resize job owns the status flow
+        before = {n.id: n.state for n in self.cluster.nodes}
+        before_state = self.state
+        self.probe_peers(timeout=timeout)
+        # a resize may have started while we were probing (probe_peers can
+        # block up to `timeout` on a dead peer): its freeze broadcast must
+        # not be followed by our now-stale status
+        if self.state == STATE_RESIZING or (
+            self.resize_job is not None
+            and self.resize_job.get("state") == "RUNNING"
+        ):
+            return False
+        after = {n.id: n.state for n in self.cluster.nodes}
+        if before == after and before_state == self.state:
+            return False
+        changed = sorted(k for k in after if after[k] != before.get(k))
+        self.logger(
+            f"liveness: node state changes {changed}, cluster {self.state}"
+        )
+        msg = {
+            "type": "cluster-status",
+            "nodes": [m.to_json() for m in self.cluster.nodes],
+            "replicaN": self.cluster.replica_n,
+            "state": self.state,
+        }
+        for n in self.cluster.nodes:
+            if n.id == self.node.id or n.state == "DOWN":
                 continue
             try:
-                self.client.status(n.uri, timeout=2.0)
-                alive[n.id] = True
-                self.set_node_state(n.id, "READY")
-            except ClientError:
-                alive[n.id] = False
-                self.set_node_state(n.id, "DOWN")
-        return alive
+                self.client.send_message(n.uri, msg)
+            except ClientError as e:
+                self.logger(f"liveness broadcast to {n.id}: {e}")
+        return True
 
     # -- anti-entropy (holder.go:911 SyncHolder) ---------------------------
 

@@ -23,6 +23,7 @@ class ClusterHarness:
         base_dir: Optional[str] = None,
         hasher=None,
         in_memory: bool = False,
+        probe_interval: float = 0.0,
     ):
         self._own_dir = base_dir is None and not in_memory
         self.base_dir = (
@@ -36,6 +37,7 @@ class ClusterHarness:
                 f"node{i}",
                 replica_n=replica_n,
                 hasher=hasher,
+                probe_interval=probe_interval,
             )
             srv.start()
             self.nodes.append(srv)
