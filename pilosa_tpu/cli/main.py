@@ -210,8 +210,15 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
             members.append(Node(id=srv.node.id, uri=srv.node.uri))
         members[0].is_coordinator = True
         srv.set_topology(members, replica_n=cfg.cluster.replicas)
-    if join and not srv.topology_restored:
-        _join_on_boot(srv, join)
+    if join:
+        if srv.topology_restored:
+            print(
+                f"--join {join} ignored: membership restored from .topology "
+                f"(remove {srv._topology_path} to join a different cluster)",
+                file=sys.stderr,
+            )
+        else:
+            _join_on_boot(srv, join)
     print(
         f"pilosa-tpu node {srv.node.id} listening on {srv.node.uri}",
         file=sys.stderr,

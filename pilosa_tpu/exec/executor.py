@@ -2027,14 +2027,68 @@ class Executor:
         if filter_call is not None and not isinstance(filter_call, Call):
             raise ExecError("GroupBy filter must be a query")
 
-        # Pre-fetch child row id lists (cluster-wide semantics).
+        # Pagination cursor: per-child Rows(previous=) args plus the
+        # GroupBy-level previous=[...] list form; both resume the sorted
+        # cross-product strictly after the previous group (reference
+        # groupByIterator seek, executor.go:3121-3160 — per-child Seek with
+        # wrap/ignorePrev cascades is equivalent to a lexicographic ">"
+        # against the tuple (prev_i or first-row_i)).
+        prevs: List[Optional[int]] = [ch.uint_arg("previous") for ch in c.children]
+        gprev = c.args.get("previous")
+        if gprev is not None:
+            # shape errors surface in translate_call (translation.py) before
+            # execution; this guard only covers direct programmatic calls
+            if not isinstance(gprev, list) or len(gprev) != len(c.children):
+                raise ExecError(
+                    "GroupBy previous must be a list with one entry per child"
+                )
+            for i, pv in enumerate(gprev):
+                if prevs[i] is None:
+                    prevs[i] = int(pv)
+        has_prev = any(p is not None for p in prevs)
+
+        # Pre-fetch child row id lists (cluster-wide semantics). Without a
+        # child limit/column, the previous arg must NOT prune the row list:
+        # a non-last child's previous row still heads later groups (e.g.
+        # (prev, prev+1, ...)) — the cursor is applied to whole group tuples
+        # below. WITH limit or column the reference prefetches via
+        # executeRows, which applies previous before limit (executor.go:
+        # 1101-1115 + 1403), so the pruned list is the group row universe.
         child_fields = []
         child_rows: List[List[int]] = []
         for child in c.children:
             fname = child.string_arg("field") or child.args.get("_field")
             child_fields.append(fname)
-            child_rows.append(self._execute_rows(idx, child, shards))
+            saved_prev = None
+            if "limit" not in child.args and "column" not in child.args:
+                saved_prev = child.args.pop("previous", None)
+            try:
+                child_rows.append(self._execute_rows(idx, child, shards))
+            finally:
+                if saved_prev is not None:
+                    child.args["previous"] = saved_prev
             if not child_rows[-1]:
+                return []
+
+        anchor: Optional[Tuple[int, ...]] = None
+        if has_prev:
+            # The reference seek position: children without a previous value
+            # anchor at their first row, the last child seeks one past its
+            # previous value, and the landing group itself is included —
+            # i.e. the result keeps group tuples >= the anchor tuple.
+            last = len(c.children) - 1
+            anchor = tuple(
+                (prevs[i] + (1 if i == last else 0))
+                if prevs[i] is not None
+                else child_rows[i][0]
+                for i in range(len(c.children))
+            )
+            # Any tuple with first component < anchor[0] compares below the
+            # anchor regardless of deeper values, so the first child's rows
+            # can be pruned before tallying — deep pages skip the bulk of
+            # the cross-product instead of tallying and discarding it.
+            child_rows[0] = [r for r in child_rows[0] if r >= anchor[0]]
+            if not child_rows[0]:
                 return []
 
         shard_list = self._shards_for(idx, shards)
@@ -2054,6 +2108,8 @@ class Executor:
                 self._group_by_shard(
                     idx, child_fields, child_rows, fw, shard, merged
                 )
+        if anchor is not None:
+            merged = {k: v for k, v in merged.items() if k >= anchor}
         out = [
             GroupCount(
                 group=[

@@ -64,9 +64,40 @@ def translate_call(idx: Index, c: Call) -> None:
             )
         c.args[k] = f.translate_store.translate_key(v)
 
+    # GroupBy(previous=[...]) pagination cursor: one entry per child Rows
+    # call; string entries translate through that child's field row keys
+    # (reference executor.go:2742-2782).
+    if c.name == "GroupBy":
+        gprev = c.args.get("previous")
+        if gprev is not None:
+            if not isinstance(gprev, list):
+                raise TranslationError(
+                    f"'previous' argument must be list, but got {type(gprev).__name__}"
+                )
+            if len(gprev) != len(c.children):
+                raise TranslationError(
+                    f"mismatched lengths for previous: {len(gprev)} and "
+                    f"children: {len(c.children)}"
+                )
+            for i, pv in enumerate(gprev):
+                child = c.children[i]
+                fname = child.string_arg("field") or child.args.get("_field")
+                f = idx.field(fname) if fname else None
+                if f is not None and f.options.keys:
+                    if not isinstance(pv, str):
+                        raise TranslationError(
+                            "prev value must be a string when field 'keys' option enabled"
+                        )
+                    gprev[i] = f.translate_store.translate_key(pv)
+                elif isinstance(pv, str):
+                    raise TranslationError(
+                        f"got string row val {pv!r} in 'previous' for field "
+                        f"{fname} which doesn't use string keys"
+                    )
+
     # Rows(previous="key") pagination cursor
     prev = c.args.get("previous")
-    if isinstance(prev, str):
+    if isinstance(prev, str) and c.name != "GroupBy":
         fname = c.args.get("field") or c.args.get("_field")
         f = idx.field(fname) if fname else None
         if f is None or not f.options.keys:

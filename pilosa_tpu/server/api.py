@@ -74,7 +74,16 @@ class API:
             return
         if state == STATE_DEGRADED:
             # same method set as NORMAL (api.go:104) — the cluster keeps
-            # serving writes while < replicaN nodes are down
+            # serving writes while < replicaN nodes are down — EXCEPT
+            # schema deletes: the rejoin repair channel (probe-pass schema
+            # push + apply_schema) is additive-only, so a delete the down
+            # node misses would diverge it forever. Deliberate deviation
+            # from the reference, which has the same unrepaired-delete hole.
+            if method in ("delete_index", "delete_field", "delete_view"):
+                raise DisabledError(
+                    f"api method {method!r} not allowed in state {state}: "
+                    "a down node would never learn the delete"
+                )
             return
         if state == STATE_RESIZING and method in ("query",) and not write:
             return

@@ -101,6 +101,11 @@ class InternalClient:
     def schema(self, uri: str) -> List[dict]:
         return self._json("GET", uri, "/schema")["indexes"]
 
+    def post_schema(self, uri: str, schema: List[dict]) -> None:
+        """Apply a full schema dump on a peer (additive; the rejoin repair
+        channel for DDL a node missed while DOWN)."""
+        self._json("POST", uri, "/schema", json.dumps({"indexes": schema}).encode())
+
     def status(self, uri: str, timeout: Optional[float] = None) -> dict:
         return self._json("GET", uri, "/status", timeout=timeout)
 
@@ -122,9 +127,15 @@ class InternalClient:
 
     # -- cluster messages (http/client.go:1017 SendMessage) ----------------
 
-    def send_message(self, uri: str, message: dict) -> dict:
+    def send_message(
+        self, uri: str, message: dict, timeout: Optional[float] = None
+    ) -> dict:
         return self._json(
-            "POST", uri, "/internal/cluster/message", json.dumps(message).encode()
+            "POST",
+            uri,
+            "/internal/cluster/message",
+            json.dumps(message).encode(),
+            timeout=timeout,
         ) or {}
 
     # -- resize orchestration (cluster.go:1297 followResizeInstruction) ----

@@ -105,6 +105,9 @@ class NodeServer:
         # since their last pass (fresh drift repairs first under load)
         self._ae_versions: Dict[tuple, int] = {}
         self._resize_mu = threading.Lock()
+        # serializes cluster-status emission: the probe ticker's stale
+        # NORMAL must never land after a resize's RESIZING freeze
+        self._status_mu = threading.Lock()
         self._resize_abort = threading.Event()
         self._resize_thread: Optional[threading.Thread] = None
 
@@ -124,10 +127,16 @@ class NodeServer:
         try:
             with open(path) as f:
                 disk_id = f.read().strip()
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            # an existing-but-unreadable .id must never be clobbered with a
+            # fresh identity: that would orphan every fragment placement
+            # keyed to the old id — the exact failure durable ids prevent
+            raise RuntimeError(f"cannot read node id at {path}: {e}") from e
+        else:
             if disk_id:
                 return disk_id
-        except OSError:
-            pass
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -204,7 +213,11 @@ class NodeServer:
             return
         if len(nodes) <= 1 or not any(n.id == self.node.id for n in nodes):
             return
-        self.set_topology(nodes, replica_n=doc.get("replicaN"))
+        self.set_topology(
+            nodes,
+            replica_n=doc.get("replicaN"),
+            partition_n=doc.get("partitionN"),
+        )
         self.topology_restored = True
         self.logger(
             f"restored {len(nodes)}-node topology from disk "
@@ -253,11 +266,15 @@ class NodeServer:
         self._httpd = make_http_server(self, host, int(port))
         actual_port = self._httpd.server_address[1]
         self.node.uri = f"http://{host}:{actual_port}"
+        # Restore persisted membership BEFORE serving: a request landing in
+        # between would see a standalone NORMAL coordinator with wrong shard
+        # placement. The socket is already bound, so early connections just
+        # queue in the listen backlog until serve_forever picks them up.
+        self._restore_topology()
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, name=f"http-{self.node.id}", daemon=True
         )
         self._http_thread.start()
-        self._restore_topology()
         if self.probe_interval > 0:
             self._probe_thread = threading.Thread(
                 target=self._probe_loop, name=f"probe-{self.node.id}", daemon=True
@@ -319,7 +336,12 @@ class NodeServer:
 
     # -- topology ----------------------------------------------------------
 
-    def set_topology(self, nodes: List[Node], replica_n: Optional[int] = None) -> None:
+    def set_topology(
+        self,
+        nodes: List[Node],
+        replica_n: Optional[int] = None,
+        partition_n: Optional[int] = None,
+    ) -> None:
         """Install the static cluster membership (all nodes must agree; the
         test/bootstrap harness calls this after every node has bound)."""
         self.cluster = Cluster(
@@ -334,7 +356,7 @@ class NodeServer:
                 for n in nodes
             ],
             replica_n=replica_n if replica_n is not None else self.cluster.replica_n,
-            partition_n=self.cluster.partition_n,
+            partition_n=partition_n if partition_n is not None else self.cluster.partition_n,
             hasher=self.cluster.hasher,
             state=STATE_NORMAL,
         )
@@ -398,18 +420,24 @@ class NodeServer:
         self.state = msg.get("state", self.state)
 
     def set_node_state(self, node_id: str, state: str) -> None:
-        n = self.cluster.node_by_id(node_id)
-        if n is not None:
-            n.state = state
-        if state == "DOWN":
-            self._down_ids.add(node_id)
-        else:
-            self._down_ids.discard(node_id)
-        # RESIZING is owned by the resize job's status flow: a liveness
-        # probe that resolves mid-freeze must not clobber it back to
-        # NORMAL (the job's final/rollback broadcast restores the state)
-        if self.state != STATE_RESIZING:
-            self.state = self.cluster.determine_state(self._down_ids)
+        # _status_mu makes the RESIZING check-then-set atomic against a
+        # concurrent freeze broadcast (_send_status holds the same lock
+        # while applying it locally): without it a probe tick could
+        # evaluate the check pre-freeze and write NORMAL post-freeze,
+        # unfreezing the coordinator while fragments move
+        with self._status_mu:
+            n = self.cluster.node_by_id(node_id)
+            if n is not None:
+                n.state = state
+            if state == "DOWN":
+                self._down_ids.add(node_id)
+            else:
+                self._down_ids.discard(node_id)
+            # RESIZING is owned by the resize job's status flow: a liveness
+            # probe that resolves mid-freeze must not clobber it back to
+            # NORMAL (the job's final/rollback broadcast restores the state)
+            if self.state != STATE_RESIZING:
+                self.state = self.cluster.determine_state(self._down_ids)
 
     def probe_peers(self, timeout: float = 2.0) -> Dict[str, bool]:
         """One failure-detection pass: /status every peer CONCURRENTLY, so
@@ -467,34 +495,60 @@ class NodeServer:
         before = {n.id: n.state for n in self.cluster.nodes}
         before_state = self.state
         self.probe_peers(timeout=timeout)
-        # a resize may have started while we were probing (probe_peers can
-        # block up to `timeout` on a dead peer): its freeze broadcast must
-        # not be followed by our now-stale status
-        if self.state == STATE_RESIZING or (
-            self.resize_job is not None
-            and self.resize_job.get("state") == "RUNNING"
-        ):
-            return False
-        after = {n.id: n.state for n in self.cluster.nodes}
-        if before == after and before_state == self.state:
-            return False
-        changed = sorted(k for k in after if after[k] != before.get(k))
-        self.logger(
-            f"liveness: node state changes {changed}, cluster {self.state}"
-        )
-        msg = {
-            "type": "cluster-status",
-            "nodes": [m.to_json() for m in self.cluster.nodes],
-            "replicaN": self.cluster.replica_n,
-            "state": self.state,
-        }
-        for n in self.cluster.nodes:
-            if n.id == self.node.id or n.state == "DOWN":
-                continue
-            try:
-                self.client.send_message(n.uri, msg)
-            except ClientError as e:
-                self.logger(f"liveness broadcast to {n.id}: {e}")
+        with self._status_mu:
+            # a resize may have started while we were probing (probe_peers
+            # can block up to `timeout` on a dead peer): its freeze
+            # broadcast must not be followed by our now-stale status. The
+            # re-check holds _status_mu — the same lock _send_status takes —
+            # so the freeze cannot interleave between this check and the
+            # broadcast below.
+            if self.state == STATE_RESIZING or (
+                self.resize_job is not None
+                and self.resize_job.get("state") == "RUNNING"
+            ):
+                return False
+            after = {n.id: n.state for n in self.cluster.nodes}
+            if before == after and before_state == self.state:
+                return False
+            changed = sorted(k for k in after if after[k] != before.get(k))
+            self.logger(
+                f"liveness: node state changes {changed}, cluster {self.state}"
+            )
+            msg = {
+                "type": "cluster-status",
+                "nodes": [m.to_json() for m in self.cluster.nodes],
+                "replicaN": self.cluster.replica_n,
+                "state": self.state,
+            }
+            for n in self.cluster.nodes:
+                if n.id == self.node.id or n.state == "DOWN":
+                    continue
+                try:
+                    # bounded: one hung (but probe-alive) peer must not pin
+                    # _status_mu for the client's 30s default and stall a
+                    # pending resize freeze behind it
+                    self.client.send_message(n.uri, msg, timeout=5.0)
+                except ClientError as e:
+                    self.logger(f"liveness broadcast to {n.id}: {e}")
+        # a node that recovered missed every DDL broadcast while it was
+        # DOWN; push the full schema so its holder catches up (the
+        # reference replays schema through gossip NodeStatus on rejoin,
+        # gossip.go:295-362 — fragment/attr contents then converge via AE)
+        recovered = [
+            nid
+            for nid, st in after.items()
+            if st != "DOWN" and before.get(nid) == "DOWN"
+        ]
+        if recovered:
+            schema = self.api.schema()
+            for nid in recovered:
+                n = self.cluster.node_by_id(nid)
+                if n is None or n.id == self.node.id:
+                    continue
+                try:
+                    self.client.post_schema(n.uri, schema)
+                except ClientError as e:
+                    self.logger(f"schema push to recovered {nid}: {e}")
         return True
 
     # -- anti-entropy (holder.go:911 SyncHolder) ---------------------------
@@ -992,6 +1046,13 @@ class NodeServer:
             "replicaN": replica_n,
             "state": state,
         }
+        with self._status_mu:
+            return self._send_status_locked(msg, to_nodes, require, retries)
+
+    def _send_status_locked(
+        self, msg: dict, to_nodes: List[Node], require: bool, retries: int
+    ) -> List[str]:
+        state = msg["state"]
         failed: List[str] = []
         for n in to_nodes:
             if n.id == self.node.id:
@@ -1001,7 +1062,7 @@ class NodeServer:
             last: Optional[Exception] = None
             for attempt in range(max(retries, 1)):
                 try:
-                    self.client.send_message(n.uri, msg)
+                    self.client.send_message(n.uri, msg, timeout=10.0)
                     st = self.client.status(n.uri, timeout=5.0)
                     if st.get("state") == state:
                         ok = True

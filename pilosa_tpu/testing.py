@@ -62,6 +62,25 @@ class ClusterHarness:
         pause analog)."""
         self.nodes[i].stop()
 
+    def restart_node(self, i: int) -> NodeServer:
+        """Boot a fresh NodeServer on node i's data dir, id, and address
+        (the clustertests restart analog); stop_node(i) first. Membership
+        and schema re-arrive from the coordinator's probe/repair flow for
+        in-memory nodes, or from the node's own .topology on disk."""
+        old = self.nodes[i]
+        host, port = old.node.uri.removeprefix("http://").rsplit(":", 1)
+        srv = NodeServer(
+            old.data_dir,
+            old.node.id,
+            bind=f"{host}:{port}",
+            replica_n=old.cluster.replica_n,
+            hasher=old.cluster.hasher,
+            probe_interval=old.probe_interval,
+        )
+        srv.start()
+        self.nodes[i] = srv
+        return srv
+
     def close(self) -> None:
         for s in self.nodes:
             try:

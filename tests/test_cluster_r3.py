@@ -86,7 +86,7 @@ def test_slow_peer_does_not_serialize_write_broadcast():
         real = c[0].client.send_message
         delay = 0.3
 
-        def slow(uri, msg):
+        def slow(uri, msg, **kw):
             time.sleep(delay)
             return real(uri, msg)
 
@@ -184,7 +184,7 @@ def test_missed_restore_aborts_job():
         real = c[0].client.send_message
         target = c[1].node.uri
 
-        def flaky(uri, msg):
+        def flaky(uri, msg, **kw):
             # fail ONLY the restore that announces the grown (3-node)
             # membership; the rollback broadcast (old 2-node membership)
             # must still get through and unfreeze the member

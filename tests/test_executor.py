@@ -461,6 +461,103 @@ class TestRowsGroupBy:
             q(ex, "GroupBy(Row(a=0))")
 
 
+class TestGroupByPrevious:
+    """Pagination cursor semantics from the reference's wrapping tests
+    (executor_test.go:3704-3790): resume strictly after the previous group
+    in sorted cross-product order, with per-child seek/wrap behavior."""
+
+    @pytest.fixture
+    def data(self, hx):
+        h, ex = hx
+        # same bits in three fields: row0 all {0,1,2}, row1 odds {1},
+        # row2 evens {0,2}, row3 no overlap {3} (executor_test.go:3739-3758)
+        for f in ("wa", "wb", "wc"):
+            h.index("i").create_field(f)
+            for col, row in [(0, 0), (1, 0), (2, 0), (1, 1), (0, 2), (2, 2), (3, 3)]:
+                q(ex, f"Set({col}, {f}={row})")
+        return h, ex
+
+    @staticmethod
+    def groups_of(result):
+        return [
+            (tuple(fr.row_id for fr in g.group), g.count) for g in result
+        ]
+
+    def test_single_child_previous(self, data):
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(wa, previous=1))")
+        assert self.groups_of(groups) == [((2,), 2), ((3,), 1)]
+
+    def test_single_child_previous_limit(self, data):
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(wa, previous=1), limit=1)")
+        assert self.groups_of(groups) == [((2,), 2)]
+
+    def test_wrapping_with_previous(self, data):
+        """executor_test.go:3761 — seek lands on (0,0,2) inclusive."""
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(wa), Rows(wb), Rows(wc, previous=1), limit=3)")
+        assert self.groups_of(groups) == [
+            ((0, 0, 2), 2),
+            ((0, 1, 0), 1),
+            ((0, 1, 1), 1),
+        ]
+
+    def test_previous_is_last_result(self, data):
+        """executor_test.go:3771 — previous names the final group."""
+        _, ex = data
+        (groups,) = q(
+            ex,
+            "GroupBy(Rows(wa, previous=3), Rows(wb, previous=3), Rows(wc, previous=3), limit=3)",
+        )
+        assert groups == []
+
+    def test_wrapping_multiple(self, data):
+        """executor_test.go:3779 — zero groups skipped across two wraps."""
+        _, ex = data
+        (groups,) = q(
+            ex, "GroupBy(Rows(wa), Rows(wb, previous=2), Rows(wc, previous=2), limit=1)"
+        )
+        assert self.groups_of(groups) == [((1, 0, 0), 1)]
+
+    def test_previous_list_form(self, data):
+        """GroupBy-level previous=[...] resumes after that exact group."""
+        _, ex = data
+        (groups,) = q(
+            ex, "GroupBy(Rows(wa), Rows(wb), Rows(wc), previous=[0, 1, 0], limit=2)"
+        )
+        assert self.groups_of(groups) == [((0, 1, 1), 1), ((0, 2, 0), 2)]
+
+    def test_previous_missing_row_resumes_after(self, data):
+        """A previous row that no longer exists: seek lands on the next row
+        and deeper levels restart (the reference's ignorePrev cascade)."""
+        _, ex = data
+        # rows are 0..3; previous row 4/2 on wa does not change wb semantics
+        (groups,) = q(
+            ex, "GroupBy(Rows(wa), Rows(wb), previous=[1, 3], limit=2)"
+        )
+        # after (1,3): next nonzero groups are (2,0):2 then (2,2):2
+        assert self.groups_of(groups) == [((2, 0), 2), ((2, 2), 2)]
+
+    def test_previous_with_child_limit(self, data):
+        """previous + limit on one child: the reference prefetches the row
+        universe with previous applied BEFORE limit (executeRows), so the
+        page is [2, 3], not an empty set (limit over un-seeked rows)."""
+        _, ex = data
+        (groups,) = q(ex, "GroupBy(Rows(wa, previous=1, limit=2))")
+        assert self.groups_of(groups) == [((2,), 2), ((3,), 1)]
+
+    def test_previous_list_mismatch(self, data):
+        _, ex = data
+        with pytest.raises(Exception, match="mismatched lengths"):
+            q(ex, "GroupBy(Rows(wa), previous=[1, 2])")
+
+    def test_previous_not_list(self, data):
+        _, ex = data
+        with pytest.raises(Exception, match="must be list"):
+            q(ex, "GroupBy(Rows(wa), previous=1)")
+
+
 class TestStoreClearRow:
     def test_store(self, hx):
         h, ex = hx

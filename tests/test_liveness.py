@@ -121,6 +121,64 @@ def test_liveness_recovers_to_normal():
         assert c[0].cluster.node_by_id("node1").state == "READY"
 
 
+def test_degraded_blocks_schema_deletes():
+    """Creates in DEGRADED are repairable on rejoin (additive schema push);
+    deletes are not — a down node would never learn them — so they are
+    refused until the cluster is whole again (deliberate deviation from
+    api.go:104, which leaves the delete unrepaired)."""
+    import pytest
+
+    from pilosa_tpu.server.api import DisabledError
+
+    with ClusterHarness(3, replica_n=2, in_memory=True) as c:
+        c[0].api.create_index("dd")
+        c[0].api.create_field("dd", "f", {"type": "set"})
+        c[0].set_node_state("node2", "DOWN")
+        assert c[0].state == "DEGRADED"
+        with pytest.raises(DisabledError, match="delete_field"):
+            c[0].api.delete_field("dd", "f")
+        with pytest.raises(DisabledError, match="delete_index"):
+            c[0].api.delete_index("dd")
+        # creates stay allowed — the rejoin repair channel covers them
+        c[0].api.create_field("dd", "f2", {"type": "set"})
+        # whole again: deletes work
+        c[0].set_node_state("node2", "READY")
+        assert c[0].state == "NORMAL"
+        c[0].api.delete_field("dd", "f")
+        c[0].api.delete_index("dd")
+
+
+def test_schema_repair_on_rejoin():
+    """DDL issued while a node is DOWN reaches it when it recovers: the
+    probe pass pushes the full schema on the DOWN->READY transition (the
+    reference replays schema via gossip NodeStatus, gossip.go:295-362).
+    Without this, DEGRADED-mode DDL would diverge the down node forever."""
+    with ClusterHarness(
+        3, replica_n=2, in_memory=True, probe_interval=0.2
+    ) as c:
+        c[0].api.create_index("rj")
+        c[0].api.create_field("rj", "f0", {"type": "set"})
+        c.stop_node(2)
+        _wait_for(lambda: c[0].state == "DEGRADED", 3.0, "DEGRADED")
+        # schema DDL while node2 is down (allowed in DEGRADED, api.go:104)
+        c[0].api.create_field("rj", "f1", {"type": "set"})
+        c[0].api.create_index("rj2")
+        srv = c.restart_node(2)
+        _wait_for(lambda: c[0].state == "NORMAL", 3.0, "back to NORMAL")
+
+        def repaired():
+            idx = srv.holder.index("rj")
+            return (
+                idx is not None
+                and idx.field("f1") is not None
+                and srv.holder.index("rj2") is not None
+            )
+
+        _wait_for(repaired, 3.0, "schema repaired on rejoin")
+        # and the rejoined node is a full member again
+        assert {n.id for n in srv.cluster.nodes} == {"node0", "node1", "node2"}
+
+
 def test_probe_pass_defers_to_resize():
     """The liveness tick must not fight the resize job's status flow."""
     with ClusterHarness(2, in_memory=True) as c:

@@ -65,6 +65,9 @@ VALID = [
     ("Set(1, my-frame=9)", 1),
     ("Set(\n1,\na\n=9)", 1),
     ("Range(blah=1, 2019-04-07T00:00, 2019-08-07T00:00)", 1),
+    ("GroupBy(Rows(a), Rows(b), previous=[1, 2])", 1),
+    ("GroupBy(Rows(a), Rows(b), previous=['k', 2], limit=10)", 1),
+    ("GroupBy(Rows(a, previous=4), Rows(b, previous=7))", 1),
 ]
 
 # TestPEGErrors corpus — must raise

@@ -379,6 +379,9 @@ class TestStackedGroupBy:
             "GroupBy(Rows(a), Rows(b), filter=Row(c=1))",
             "GroupBy(Rows(a), Rows(b), filter=Intersect(Row(c=0), Row(c=1)))",
             "GroupBy(Rows(a), Rows(b), limit=3)",
+            "GroupBy(Rows(a), Rows(b), previous=[2, 1])",
+            "GroupBy(Rows(a, previous=1), Rows(b, previous=2), limit=4)",
+            "GroupBy(Rows(a), Rows(b, previous=3), filter=Row(c=1))",
         ],
     )
     def test_matches_serial(self, holder, monkeypatch, query):

@@ -188,3 +188,20 @@ def test_string_key_without_keys_errors(tmp_path):
     with pytest.raises(TranslationError):
         e.execute("plain", 'Set(1, f="red")')
     h.close()
+
+
+def test_groupby_previous_list_translates_keys(keyed):
+    """GroupBy(previous=[...]) entries translate through each child's field
+    row keys (reference executor.go:2742-2782)."""
+    h, e = keyed
+    for col, row in [("c1", "g1"), ("c2", "g1"), ("c1", "g2"), ("c3", "g3")]:
+        e.execute("i", f'Set("{col}", f="{row}")')
+    # row ids allocate in first-seen order: g1=1, g2=2, g3=3
+    (groups,) = e.execute("i", 'GroupBy(Rows(f), previous=["g1"])')
+    got = [(g.group[0].row_key, g.count) for g in groups]
+    assert got == [("g2", 1), ("g3", 1)]
+    # non-string previous entry on a keyed field is an error
+    from pilosa_tpu.exec.translation import TranslationError
+
+    with pytest.raises(TranslationError, match="must be a string"):
+        e.execute("i", "GroupBy(Rows(f), previous=[3])")
