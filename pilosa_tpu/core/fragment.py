@@ -220,6 +220,7 @@ class Fragment:
         self.max_op_n = max_op_n
         # row-rank cache for TopN (reference: fragment.go:131 f.cache)
         self.cache = cachemod.make_cache(cache_type, cache_size)
+        self._cache_top_arrays = None  # memoized (top, rids, cnts)
 
         self._mu = threading.RLock()
         self._rows: Dict[int, RowBits] = {}
@@ -384,6 +385,29 @@ class Fragment:
             rb = self._rows.get(row_id)
             return rb.to_positions() if rb is not None else np.empty(0, np.uint32)
 
+    def rows_sparse_concat(self, row_ids) -> Tuple[np.ndarray, np.ndarray]:
+        """One-lock bulk sparse read for the TopN tally: concatenated
+        sorted bit positions of the listed rows plus per-row lengths;
+        length -1 marks a dense-rep row (the caller routes those through
+        the plane path instead of gathering individual words)."""
+        with self._mu:
+            rows = self._rows
+            parts = []
+            lens = np.empty(len(row_ids), np.int64)
+            for i, rid in enumerate(row_ids):
+                rb = rows.get(rid)
+                if rb is None:
+                    lens[i] = 0
+                elif rb.dense is not None:
+                    lens[i] = -1
+                else:
+                    p = rb.positions
+                    lens[i] = len(p)
+                    if len(p):
+                        parts.append(p)
+            cat = np.concatenate(parts) if parts else np.empty(0, np.uint32)
+            return cat, lens
+
     def row_device(self, row_id: int) -> jax.Array:
         """Device-resident dense row; cached (budgeted LRU) until the row
         mutates."""
@@ -427,6 +451,21 @@ class Fragment:
         writer mutating the cache in _apply_positions can't tear the read."""
         with self._mu:
             return self.cache.top()
+
+    def cache_top_arrays(self):
+        """(row_ids uint64[], counts uint64[]) of the rank cache in rank
+        order, memoized against the cache's own top() snapshot — the
+        vectorized TopN paths read these instead of building 10^4s of
+        Python tuples per query."""
+        with self._mu:
+            t = self.cache.top()
+            memo = self._cache_top_arrays
+            if memo is None or memo[0] is not t:
+                n = len(t)
+                rids = np.fromiter((p[0] for p in t), np.uint64, n)
+                cnts = np.fromiter((p[1] for p in t), np.uint64, n)
+                memo = self._cache_top_arrays = (t, rids, cnts)
+            return memo[1], memo[2]
 
     def row_counts_host(self, row_ids) -> np.ndarray:
         """Cardinalities of the listed rows as one uint64 vector under one

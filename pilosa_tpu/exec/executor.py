@@ -168,7 +168,28 @@ class _TopNSpec:
 
 # TopN dispatch accounting: tests assert the batched path issues O(1)
 # device tallies per pass, never one per shard.
-TOPN_STATS = {"batched": 0, "fallback": 0, "tally_evals": 0}
+TOPN_STATS = {"batched": 0, "fallback": 0, "tally_evals": 0, "one_pass": 0}
+
+
+class _TallyBundle:
+    """Prepared filtered-TopN tally inputs (dense/sparse candidate split +
+    device gather entries). Lives in the process-wide DEVICE_CACHE —
+    thread-safe, HBM-budgeted, owner-invalidated — keyed by (view stack
+    token, candidates, shards, fragment versions); `nbytes` makes the
+    budget see the pinned device arrays."""
+
+    __slots__ = ("dense_rows", "sparse_rows", "dev")
+
+    def __init__(self, dense_rows, sparse_rows, dev):
+        self.dense_rows = dense_rows
+        self.sparse_rows = sparse_rows
+        self.dev = dev
+
+    @property
+    def nbytes(self) -> int:
+        if self.dev is None:
+            return 64
+        return sum(int(a.nbytes) for a in self.dev[:4])
 
 # Per-shard fallback accounting: host reads are fused in chunks, so a
 # 100-shard fallback query does ~2 device->host syncs, not 100.
@@ -1603,6 +1624,16 @@ class Executor:
     def _execute_topn(self, idx: Index, c: Call, shards, opt: ExecOptions) -> List[Pair]:
         ids_arg = c.args.get("ids")
         n = c.uint_arg("n")
+        if not ids_arg and not opt.remote:
+            # Local one-pass: the batched tally already computes exact
+            # intersection counts for every candidate across every present
+            # shard, so pass 2 is a pure host-side re-select over the same
+            # [R, S] matrix — ONE device read per query instead of two.
+            pairs = self._topn_local_full(idx, c, shards)
+            if pairs is not None:
+                if n and len(pairs) > n:
+                    pairs = pairs[:n]
+                return pairs
         pairs = self._topn_shards(idx, c, shards)
         # ids/remote paths return untrimmed (reference executor.go:881): the
         # caller (or coordinating node) needs exact counts for every
@@ -1616,6 +1647,131 @@ class Executor:
         if n and len(trimmed) > n:
             trimmed = trimmed[:n]
         return trimmed
+
+    def _topn_local_full(self, idx: Index, c: Call, shards) -> Optional[List[Pair]]:
+        """Both TopN passes (executor.go:860-999) against ONE device tally,
+        with the host side fully vectorized.
+
+        Pass 1 selects candidates per shard from the rank caches; the
+        batched tally produces exact filter-intersection counts for the
+        whole candidate union across all present shards, so the pass-2
+        exact recount of the merged ids is answerable from the same
+        [R, S] ic matrix plus the bundle's cardinality matrix — no second
+        dispatch, no second read, and no per-(row, shard) Python loops
+        (the classic per-shard heap walk only runs for shards whose
+        survivor pool exceeds n, where the reference's early-stop
+        semantics actually bind). Returns None when the filter child has
+        no stacked form or the query uses Tanimoto (both fall back to the
+        classic two-pass)."""
+        spec = self._topn_parse(idx, c)
+        if spec.src_call is None:
+            return None  # hostfast path is already zero-dispatch
+        if spec.tanimoto > 0:
+            return None  # rare; per-shard src counts need their own read
+        shard_list = self._shards_for(idx, shards)
+        vp = self._topn_present(spec, shard_list)
+        if vp is None:
+            return []
+        v, present = vp
+        lowered = self._stacked_filter(idx, spec.src_call, present)
+        if lowered is None:
+            return None
+        present, sp = lowered
+        if not present:
+            return []
+        TOPN_STATS["one_pass"] += 1
+        src_stack = sp.rows_full()  # one plan dispatch, stays on device
+        thr = np.uint64(max(spec.threshold, 1))
+        # Pass 1 survivors: vectorized threshold/attr prunes over the
+        # memoized rank-cache arrays.
+        tops = [frag.cache_top_arrays() for _, frag in present]
+        allowed_of = None
+        if spec.filters is not None:
+            store = spec.f.row_attr_store
+            uniq = np.unique(
+                np.concatenate([r for r, _ in tops])
+                if tops
+                else np.empty(0, np.uint64)
+            )
+            ok = np.fromiter(
+                (
+                    (val := (store.attrs(int(rid)) or {}).get(spec.attr_name))
+                    is not None
+                    and val in spec.filters
+                    for rid in uniq
+                ),
+                bool,
+                len(uniq),
+            )
+
+            def allowed_of(rids):
+                return ok[np.searchsorted(uniq, rids)]
+
+        surv = []
+        for rids, cnts in tops:
+            m = cnts >= thr
+            if allowed_of is not None and m.any():
+                m &= allowed_of(rids)
+            surv.append((rids[m], cnts[m]))
+        if not any(len(s[0]) for s in surv):
+            return []
+        cand = np.unique(np.concatenate([s[0] for s in surv]))
+        order, fused, bundle = self._topn_icounts_raw(
+            v, [int(x) for x in cand], present, src_stack
+        )
+        # reindex the fused tally into cand (sorted) order
+        pos_of = np.empty(len(order), np.int64)
+        pos_of[np.searchsorted(cand, np.asarray(order, np.uint64))] = np.arange(
+            len(order)
+        )
+        ic_mat = fused[pos_of]  # uint64[R, S] in cand order
+        # Pass 1 select per shard. Fast path: when the survivor pool fits
+        # in n, the heap never fills and selection degenerates to
+        # "every survivor with ic >= max(threshold, 1)" — pure numpy.
+        n1 = spec.n
+        merged_mask = np.zeros(len(cand), bool)
+        for j, (srids, scnts) in enumerate(surv):
+            if not len(srids):
+                continue
+            pos = np.searchsorted(cand, srids)
+            ic = ic_mat[pos, j]
+            if n1 == 0 or len(srids) <= n1:
+                merged_mask[pos[ic >= thr]] = True
+                continue
+            # exact cache-order walk preserving the reference's early-stop
+            # semantics (fragment.go:1570-1704) for oversized pools
+            taken = 0
+            low = None
+            for i in range(len(srids)):
+                count = int(ic[i])
+                if taken < n1:
+                    if count < int(thr):
+                        continue
+                    merged_mask[pos[i]] = True
+                    taken += 1
+                    low = count if low is None or count < low else low
+                    continue
+                if low < int(thr) or int(scnts[i]) < low:
+                    break
+                if count < low:
+                    continue
+                merged_mask[pos[i]] = True
+        if not merged_mask.any():
+            return []
+        # Pass 2: exact totals for the merged ids — pure matrix ops. The
+        # explicit-ids semantics reduce to: a (row, shard) cell contributes
+        # its intersection count iff it passes the threshold (the
+        # cardinality prune is implied — ic <= cardinality always).
+        sel = np.flatnonzero(merged_mask)
+        take = ic_mat[sel] >= thr
+        totals = (ic_mat[sel] * take).sum(axis=1, dtype=np.uint64)
+        pairs = [
+            Pair(id=int(cand[i]), count=int(t))
+            for i, t in zip(sel, totals)
+            if t > 0
+        ]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        return pairs
 
     def _topn_parse(self, idx: Index, c: Call) -> "_TopNSpec":
         """Validate TopN args once per pass (semantic errors raise
@@ -1760,16 +1916,10 @@ class Executor:
         popcount(planes & src) in O(candidates/tile) chunked dispatches
         with a single host read — never one dispatch per shard. Returns
         None when the child has no stacked form (per-shard fallback)."""
-        v = spec.f.view(VIEW_STANDARD)
-        if v is None:
+        vp = self._topn_present(spec, shard_list)
+        if vp is None:
             return {}
-        present = [
-            (s, frag)
-            for s in shard_list
-            if (frag := v.fragment_if_exists(s)) is not None
-        ]
-        if not present:
-            return {}
+        v, present = vp
         has_src = spec.src_call is not None
         if not has_src:
             TOPN_STATS["batched"] += 1
@@ -1871,6 +2021,19 @@ class Executor:
                     break
         return merged
 
+    def _topn_present(self, spec: "_TopNSpec", shard_list):
+        """Shared TopN preamble: (standard view, present fragments), or
+        None when the view or every listed fragment is absent."""
+        v = spec.f.view(VIEW_STANDARD)
+        if v is None:
+            return None
+        present = [
+            (s, frag)
+            for s in shard_list
+            if (frag := v.fragment_if_exists(s)) is not None
+        ]
+        return (v, present) if present else None
+
     def _stacked_filter(self, idx: Index, filter_call: Call, present):
         """Lower a filter bitmap over the present (shard, fragment) pairs
         for a batched tally. Returns (present, plan) with `present`
@@ -1890,40 +2053,163 @@ class Executor:
     def _topn_icounts(
         self, view, cand: List[int], present, src_stack
     ) -> Dict[int, np.ndarray]:
+        order, fused, _ = self._topn_icounts_raw(view, cand, present, src_stack)
+        return {rid: fused[k] for k, rid in enumerate(order)}
+
+    def _topn_icounts_raw(
+        self, view, cand: List[int], present, src_stack
+    ) -> Tuple[List[int], np.ndarray, "_TallyBundle"]:
         """Intersection counts for every candidate row across all present
-        shards: chunked [R_c, S, W] plane stacks tallied against the src
-        stack on device — O(candidates/tile) dispatches and ONE [R, S]
-        host read, replacing the per-shard frag.row_counts loop."""
+        shards with ONE blocking device read (per-chunk reads would cost
+        one tunnel RTT each): (row order, uint64[R, S] matrix). Candidates
+        split by host representation: rows sparse in every present shard
+        contribute only their live words (device gather + sorted-segment
+        cumsum — HBM traffic ~ bytes of live words, not full zero-padded
+        planes, and no TPU scatter); rows dense anywhere go through
+        chunked [R_c, S, W] plane stacks. All partial counts concatenate
+        on device into a single fused [R, S] read."""
         from pilosa_tpu.exec import groupby as gb
 
         import jax.numpy as jnp
 
         pshards = tuple(s for s, _ in present)
+        n_present = len(present)
         s_pad, w = src_stack.shape
-        r_c = gb._gmax(s_pad, w)
-        chunks = []
-        for i in range(0, len(cand), r_c):
-            ids = cand[i : i + r_c]
-            pad_ids = [int(x) for x in gb._pad_pow2(np.asarray(ids))]
-            planes = view.plane_stack(pad_ids, pshards)
-            if planes.shape[1] != s_pad:
-                # stacked src may carry extra Shift-predecessor shards
-                src_stack = src_stack[: planes.shape[1]]
-            TOPN_STATS["tally_evals"] += 1
-            counts = gb._counts_cross(src_stack[None], planes)[0]
-            chunks.append((ids, counts[: len(ids)]))
-        # ONE device->host read for all chunks: per-chunk reads would cost
-        # one RTT each on tunneled hardware (~8 RTT/query at bench scale)
+        bundle = self._topn_tally_bundle(view, cand, present, w)
+        dense_rows, sparse_rows, dev = (
+            bundle.dense_rows,
+            bundle.sparse_rows,
+            bundle.dev,
+        )
+        parts = []  # device uint32 [*, n_present] blocks
+        order: List[int] = []  # row ids aligned with the fused row axis
+        if dense_rows:
+            r_c = gb._gmax(s_pad, w)
+            for i in range(0, len(dense_rows), r_c):
+                ids = dense_rows[i : i + r_c]
+                pad_ids = [int(x) for x in gb._pad_pow2(np.asarray(ids))]
+                planes = view.plane_stack(pad_ids, pshards)
+                src = src_stack
+                if planes.shape[1] != s_pad:
+                    # stacked src may carry extra Shift-predecessor shards
+                    src = src_stack[: planes.shape[1]]
+                TOPN_STATS["tally_evals"] += 1
+                counts = gb._counts_cross(src[None], planes)[0]
+                parts.append(counts[: len(ids), :n_present])
+                order.extend(ids)
+        if sparse_rows:
+            if dev is None:
+                parts.append(jnp.zeros((len(sparse_rows), n_present), jnp.uint32))
+            else:
+                idx, mask, starts, ends, r_pad, s_pow2 = dev
+                TOPN_STATS["tally_evals"] += 1
+                counts = ob.gather_tally_sorted(
+                    src_stack, idx, mask, starts, ends
+                ).reshape(r_pad, s_pow2)
+                parts.append(counts[: len(sparse_rows), :n_present])
+            order.extend(sparse_rows)
+        if not order:
+            return [], np.empty((0, n_present), np.uint64), bundle
         fused = np.asarray(
-            jnp.concatenate([c for _, c in chunks], axis=0), dtype=np.uint64
-        )[:, : len(present)]
-        out: Dict[int, np.ndarray] = {}
-        k = 0
-        for ids, _ in chunks:
-            for rid in ids:
-                out[rid] = fused[k]
-                k += 1
-        return out
+            parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0),
+            dtype=np.uint64,
+        )
+        return order, fused, bundle
+
+    def _topn_tally_bundle(self, view, cand: List[int], present, w: int) -> "_TallyBundle":
+        """Prepared inputs for the candidate tally (see _TallyBundle).
+
+        Sparse rows' live bits are folded to per-(row, shard) word entries
+        in ONE vectorized host pass (sort + reduceat over every bit of
+        every sparse candidate — no per-(row, shard) numpy calls), then
+        cached in DEVICE_CACHE keyed by fragment versions, so warm queries
+        skip the host build entirely. Cardinalities ride along because the
+        pass-2 prunes need them for every (merged id, shard) cell and they
+        are already known here (sparse rows: the position-array lengths;
+        dense rows: one bulk row_counts_host per shard, once per version
+        epoch)."""
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+        key = view._stack_key(
+            "topn_sparse", tuple(cand), tuple(s for s, _ in present)
+        )
+        return DEVICE_CACHE.get_or_build(
+            key, lambda: self._topn_tally_build(cand, present, w)
+        )
+
+    def _topn_tally_build(self, cand: List[int], present, w: int) -> "_TallyBundle":
+        import jax
+
+        r_all = len(cand)
+        n_present = len(present)
+        cats, lens = [], []
+        for _, frag in present:
+            c_, l_ = frag.rows_sparse_concat(cand)
+            cats.append(c_)
+            lens.append(l_)
+        lens_mat = np.stack(lens)  # [S, R]; -1 marks dense-rep
+        dense_mask = (lens_mat < 0).any(axis=0)
+        n_bits = int(np.clip(lens_mat, 0, None).sum())
+        if n_bits >= 1 << 27:
+            # uint32 cumsum headroom (gather_tally_sorted): route everything
+            # through the plane path instead
+            dense_mask = np.ones(r_all, bool)
+        dense_rows = [rid for i, rid in enumerate(cand) if dense_mask[i]]
+        sparse_rows = [rid for i, rid in enumerate(cand) if not dense_mask[i]]
+        dev = None
+        if sparse_rows:
+            # pow2-pad BOTH segment axes (rows and shards): every distinct
+            # input shape forces a fresh XLA compile of gather_tally_sorted,
+            # so shapes must come from a log-bounded family
+            s_pow2 = 1 << max(n_present - 1, 0).bit_length()
+            k_of = np.full(r_all, -1, np.int64)
+            k_of[~dense_mask] = np.arange(len(sparse_rows))
+            wkey_parts, bit_parts = [], []
+            for j in range(n_present):
+                l_ = np.clip(lens_mat[j], 0, None)
+                if not l_.sum():
+                    continue
+                rows_per_el = np.repeat(np.arange(r_all), l_)
+                keep = ~dense_mask[rows_per_el]
+                pos = cats[j][keep].astype(np.int64)
+                seg = k_of[rows_per_el[keep]] * s_pow2 + j
+                wkey_parts.append(seg * w + (pos >> 5))
+                bit_parts.append(
+                    np.uint32(1) << (pos & np.int64(31)).astype(np.uint32)
+                )
+            if wkey_parts:
+                wkeys = np.concatenate(wkey_parts)
+                bits = np.concatenate(bit_parts)
+                o = np.argsort(wkeys, kind="stable")
+                sk, sb = wkeys[o], bits[o]
+                new_grp = np.empty(len(sk), bool)
+                new_grp[0] = True
+                np.not_equal(sk[1:], sk[:-1], out=new_grp[1:])
+                gstart = np.flatnonzero(new_grp)
+                masks = np.bitwise_or.reduceat(sb, gstart)
+                uk = sk[gstart]
+                seg_of = uk // w
+                idx = ((seg_of % s_pow2) * w + uk % w).astype(np.int32)
+                # pad the entry axis to pow2 too; padding lands after every
+                # segment end, so sums are unaffected
+                k_pad = 1 << max(len(idx) - 1, 0).bit_length()
+                if k_pad != len(idx):
+                    padn = k_pad - len(idx)
+                    idx = np.concatenate([idx, np.zeros(padn, np.int32)])
+                    masks = np.concatenate([masks, np.zeros(padn, np.uint32)])
+                r_pad = 1 << max(len(sparse_rows) - 1, 0).bit_length()
+                segs = np.arange(r_pad * s_pow2)
+                starts = np.searchsorted(seg_of, segs, "left").astype(np.int32)
+                ends = np.searchsorted(seg_of, segs, "right").astype(np.int32)
+                dev = (
+                    jax.device_put(idx),
+                    jax.device_put(masks),
+                    jax.device_put(starts),
+                    jax.device_put(ends),
+                    r_pad,
+                    s_pow2,
+                )
+        return _TallyBundle(dense_rows, sparse_rows, dev)
 
     def _topn_shard(self, idx: Index, spec: "_TopNSpec", shard: int) -> List[Tuple[int, int]]:
         """One shard's TopN candidates (the per-shard fallback when the

@@ -172,6 +172,28 @@ def count_and_rows(a, b) -> jnp.ndarray:
 
 
 @jax.jit
+def gather_tally_sorted(src, idx, mask, starts, ends) -> jnp.ndarray:
+    """Segment sums of popcount(src.flat[idx] & mask), segments given as
+    sorted half-open [starts, ends) ranges over the entry axis ->
+    uint32[n_seg].
+
+    The sparse half of the TopN filtered tally: each entry is one live
+    word of a sparse candidate row, so the filter stack is gathered at
+    just those words instead of streaming full zero-padded candidate
+    planes from HBM (the reference recounts candidate rows per shard on
+    the CPU instead, fragment.go:1570-1743). Segment reduction is
+    cumsum + two boundary gathers — NOT scatter-add (segment_sum), which
+    serializes on TPU. uint32 cumsum is exact while the entry count stays
+    under 2^27 (each entry contributes <= 32); the caller enforces that
+    bound when building entries."""
+    vals = jax.lax.population_count(jnp.bitwise_and(src.reshape(-1)[idx], mask))
+    cum = jnp.concatenate(
+        [jnp.zeros(1, jnp.uint32), jnp.cumsum(vals, dtype=jnp.uint32)]
+    )
+    return cum[ends] - cum[starts]
+
+
+@jax.jit
 def _count_andnot_jnp(a, b) -> jnp.ndarray:
     return jnp.sum(
         jax.lax.population_count(jnp.bitwise_and(a, jnp.bitwise_not(b))), dtype=jnp.uint32
