@@ -49,6 +49,19 @@ class TracingConfig:
 
 
 @dataclass
+class TLSConfig:
+    # Serve the whole HTTP plane (client API + internode) over TLS when
+    # certificate+key are set (reference: server/config.go:151-157 TLS
+    # block, applied in server.go:222-295). skip_verify disables peer cert
+    # verification in the internode client (self-signed deployments);
+    # ca_certificate pins a CA instead — the verified alternative.
+    certificate: str = ""
+    key: str = ""
+    skip_verify: bool = False
+    ca_certificate: str = ""
+
+
+@dataclass
 class Config:
     data_dir: str = "~/.pilosa-tpu"
     bind: str = "localhost:10101"
@@ -61,6 +74,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
 
     # -- sources -----------------------------------------------------------
 
@@ -131,6 +145,7 @@ class Config:
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
             ("tracing", self.tracing),
+            ("tls", self.tls),
         ):
             out.append(f"\n[{sect_name}]")
             for f_ in dataclasses.fields(sect):
@@ -167,14 +182,18 @@ def _toml_value(v) -> str:
     return f'"{v}"'
 
 
-def parse_hosts(hosts: List[str]):
-    """'node_id@http://host:port' entries -> [(id, uri)]."""
+def parse_hosts(hosts: List[str], default_scheme: str = "http"):
+    """'node_id@http://host:port' entries -> [(id, uri)]. Bare host:port
+    entries get default_scheme — a TLS cluster must seed https:// peer
+    URIs or every internode request would send plaintext to a TLS socket."""
     out = []
     for h in hosts:
         if "@" in h:
             nid, uri = h.split("@", 1)
+            if not uri.startswith("http"):
+                uri = f"{default_scheme}://{uri}"
         else:
-            uri = h if h.startswith("http") else f"http://{h}"
+            uri = h if h.startswith("http") else f"{default_scheme}://{h}"
             nid = uri.split("//", 1)[-1].replace(":", "-")
         out.append((nid, uri))
     return out

@@ -40,6 +40,18 @@ def _build_parser() -> argparse.ArgumentParser:
         "the resize job; the listenForJoins role, cluster.go:1141)",
     )
     sp.add_argument("--verbose", action="store_true", default=None)
+    sp.add_argument("--tls-certificate", help="PEM cert chain; serve HTTPS")
+    sp.add_argument("--tls-key", help="PEM private key for --tls-certificate")
+    sp.add_argument(
+        "--tls-skip-verify",
+        action="store_true",
+        default=None,
+        help="internode client trusts any peer certificate (self-signed)",
+    )
+    sp.add_argument(
+        "--tls-ca-certificate",
+        help="internode client verifies peers against this CA bundle",
+    )
 
     ip = sub.add_parser("import", help="bulk-import CSV rows (row,col[,ts])")
     ip.add_argument("--host", default="http://localhost:10101")
@@ -92,12 +104,30 @@ def _load_config(args) -> Config:
         overrides["cluster"] = cluster
     if getattr(args, "anti_entropy_interval", None) is not None:
         overrides["anti_entropy"] = {"interval": args.anti_entropy_interval}
+    tls = {}
+    for attr, key in (
+        ("tls_certificate", "certificate"),
+        ("tls_key", "key"),
+        ("tls_skip_verify", "skip_verify"),
+        ("tls_ca_certificate", "ca_certificate"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            tls[key] = v
+    if tls:
+        overrides["tls"] = tls
     return Config.load(path=args.config, overrides=overrides)
 
 
 # ---------------------------------------------------------------------------
 # subcommands
 # ---------------------------------------------------------------------------
+
+
+def _scheme(cfg: Config) -> str:
+    """URI scheme this node serves on (TLS flips the whole plane to https,
+    including the id derivation from --cluster-hosts entries)."""
+    return "https" if cfg.tls.certificate else "http"
 
 
 def _join_on_boot(srv, coordinator_uri: str, timeout: float = 180.0) -> None:
@@ -150,12 +180,12 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
     from pilosa_tpu.server.node import NodeServer
 
     data_dir = os.path.expanduser(cfg.data_dir) if cfg.data_dir else None
-    hosts = parse_hosts(cfg.cluster.hosts)
+    hosts = parse_hosts(cfg.cluster.hosts, default_scheme=_scheme(cfg))
     node_id = cfg.node_id
     if not node_id:
         # derive the same id parse_hosts would give this bind address, so a
         # '--cluster-hosts host:port,...' entry naming us matches our id
-        my_uri = cfg.bind if cfg.bind.startswith("http") else f"http://{cfg.bind}"
+        my_uri = cfg.bind if cfg.bind.startswith("http") else f"{_scheme(cfg)}://{cfg.bind}"
         matched = [nid for nid, uri in hosts if uri == my_uri]
         node_id = matched[0] if matched else cfg.bind.replace(":", "-")
     from pilosa_tpu.utils.logger import new_logger
@@ -172,6 +202,10 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         metric_poll_interval=cfg.metric.poll_interval,
         long_query_time=cfg.long_query_time,
         logger=new_logger(verbose=cfg.verbose, stream=log_stream),
+        tls_cert=os.path.expanduser(cfg.tls.certificate) if cfg.tls.certificate else "",
+        tls_key=os.path.expanduser(cfg.tls.key) if cfg.tls.key else "",
+        tls_skip_verify=cfg.tls.skip_verify,
+        tls_ca_cert=os.path.expanduser(cfg.tls.ca_certificate) if cfg.tls.ca_certificate else "",
     )
     srv.start()
     # static --cluster-hosts flags SEED a cluster; once membership is on
@@ -192,7 +226,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
                 file=sys.stderr,
             )
     elif hosts:
-        my_uri = cfg.bind if cfg.bind.startswith("http") else f"http://{cfg.bind}"
+        my_uri = cfg.bind if cfg.bind.startswith("http") else f"{_scheme(cfg)}://{cfg.bind}"
         members = []
         for nid, uri in hosts:
             if uri == my_uri and nid != srv.node.id:

@@ -1656,13 +1656,14 @@ class Executor:
         batched tally produces exact filter-intersection counts for the
         whole candidate union across all present shards, so the pass-2
         exact recount of the merged ids is answerable from the same
-        [R, S] ic matrix plus the bundle's cardinality matrix — no second
-        dispatch, no second read, and no per-(row, shard) Python loops
-        (the classic per-shard heap walk only runs for shards whose
-        survivor pool exceeds n, where the reference's early-stop
-        semantics actually bind). Returns None when the filter child has
-        no stacked form or the query uses Tanimoto (both fall back to the
-        classic two-pass)."""
+        [R, S] ic matrix alone (the classic cardinality prune is implied:
+        ic <= cardinality always, so ic >= threshold decides every cell)
+        — no second dispatch, no second read, and no per-(row, shard)
+        Python loops (the classic per-shard heap walk only runs for
+        shards whose survivor pool exceeds n, where the reference's
+        early-stop semantics actually bind). Returns None when the filter
+        child has no stacked form or the query uses Tanimoto (both fall
+        back to the classic two-pass)."""
         spec = self._topn_parse(idx, c)
         if spec.src_call is None:
             return None  # hostfast path is already zero-dispatch
@@ -2123,11 +2124,10 @@ class Executor:
         in ONE vectorized host pass (sort + reduceat over every bit of
         every sparse candidate — no per-(row, shard) numpy calls), then
         cached in DEVICE_CACHE keyed by fragment versions, so warm queries
-        skip the host build entirely. Cardinalities ride along because the
-        pass-2 prunes need them for every (merged id, shard) cell and they
-        are already known here (sparse rows: the position-array lengths;
-        dense rows: one bulk row_counts_host per shard, once per version
-        epoch)."""
+        skip the host build entirely. No cardinality data is stored: the
+        pass-2 cardinality prune is implied by ic <= cardinality, so the
+        ic matrix alone decides every cell (Tanimoto, which genuinely
+        needs per-shard cardinalities, takes the classic two-pass)."""
         from pilosa_tpu.core.devcache import DEVICE_CACHE
 
         key = view._stack_key(

@@ -153,8 +153,12 @@ class API:
             span.set_tag("index", index)
             span.set_tag("remote", remote)
             try:
+                batched, parsed = self._query_batched(index, query, shards, opt)
+                if batched is not None:
+                    return batched
                 return self.server.executor.execute_response(
-                    index, query, shards=shards, opt=opt
+                    index, parsed if parsed is not None else query,
+                    shards=shards, opt=opt,
                 )
             finally:
                 dt = _time.perf_counter() - t0
@@ -167,6 +171,38 @@ class API:
                         f"slow query ({dt:.3f}s > {lqt:.3f}s) on {index!r}: "
                         f"{query[:200]}"
                     )
+
+    def _query_batched(self, index, query, shards, opt):
+        """Route pure-Count requests through the group-commit batcher
+        (exec/batcher.py): concurrent single-Count clients share one
+        multi-root dispatch. Returns (response, parsed_query); response is
+        None when the request is not batchable, and the caller reuses
+        parsed_query so the hot path parses the PQL exactly once."""
+        if (
+            shards is not None
+            or opt.remote
+            or opt.column_attrs
+            or opt.exclude_row_attrs
+            or opt.exclude_columns
+        ):
+            return None, None
+        import dataclasses
+
+        from pilosa_tpu.exec import batcher as batchmod
+        from pilosa_tpu.exec.executor import QueryResponse
+        from pilosa_tpu.pql import parse
+
+        q = parse(query) if isinstance(query, str) else query
+        if not batchmod.batchable(q):
+            return None, q
+        results = self.server.count_batcher.run(
+            index,
+            q,
+            lambda merged: self.server.executor.execute_response(
+                index, merged, shards=None, opt=dataclasses.replace(opt)
+            ).results,
+        )
+        return QueryResponse(results=results), q
 
     # -- schema DDL (api.go:206-368) ---------------------------------------
 

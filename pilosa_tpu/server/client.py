@@ -11,6 +11,7 @@ can re-map shards."""
 from __future__ import annotations
 
 import json
+import ssl
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -29,8 +30,25 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = DEFAULT_TIMEOUT):
+    def __init__(
+        self,
+        timeout: float = DEFAULT_TIMEOUT,
+        tls_skip_verify: bool = False,
+        tls_ca_cert: str = "",
+    ):
+        """TLS options mirror the reference internode client
+        (server/config.go:151-157 applied via http.GetHTTPClient): a
+        pinned CA verifies self-hosted clusters; skip_verify turns off
+        verification entirely for self-signed deployments."""
         self.timeout = timeout
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if tls_ca_cert:
+            self._ssl_ctx = ssl.create_default_context(cafile=tls_ca_cert)
+        elif tls_skip_verify:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx = ctx
 
     # -- plumbing ----------------------------------------------------------
 
@@ -61,7 +79,9 @@ class InternalClient:
             req.add_header(tracing.TRACE_HEADER, span.trace_id)
             req.add_header(tracing.SPAN_HEADER, span.span_id)
         try:
-            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ssl_ctx
+            ) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode("utf-8", "replace")[:500]

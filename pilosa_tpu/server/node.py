@@ -53,6 +53,10 @@ class NodeServer:
         metric_poll_interval: float = 0.0,  # 0 = no runtime poller
         long_query_time: float = 0.0,  # seconds; 0 = disabled
         logger=None,
+        tls_cert: str = "",  # PEM chain; with tls_key, serve HTTPS
+        tls_key: str = "",
+        tls_skip_verify: bool = False,  # internode client: trust any cert
+        tls_ca_cert: str = "",  # internode client: pin this CA instead
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -70,10 +74,24 @@ class NodeServer:
         self.cluster_name = cluster_name
         self.state = STATE_NORMAL
         self.holder = Holder(data_dir)
-        self.client = InternalClient()
+        # TLS plane (reference: server/config.go:151-157 applied in
+        # server.go:222-295): one cert/key pair serves both the client API
+        # and the internode plane; the internode client carries the trust
+        # config so replication/AE/resize all ride the same channel.
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError("tls_cert and tls_key must be set together")
+        self.client = InternalClient(
+            tls_skip_verify=tls_skip_verify, tls_ca_cert=tls_ca_cert
+        )
         self.executor = DistributedExecutor(
             self.holder, lambda: self.cluster, self.client, node_id
         )
+        # cross-request group-commit Count batching (exec/batcher.py)
+        from pilosa_tpu.exec.batcher import CountBatcher
+
+        self.count_batcher = CountBatcher()
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.probe_interval = probe_interval
@@ -264,8 +282,18 @@ class NodeServer:
 
         host, port = self.bind.rsplit(":", 1)
         self._httpd = make_http_server(self, host, int(port))
+        scheme = "http"
+        if self.tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert, self.tls_key)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+            scheme = "https"
         actual_port = self._httpd.server_address[1]
-        self.node.uri = f"http://{host}:{actual_port}"
+        self.node.uri = f"{scheme}://{host}:{actual_port}"
         # Restore persisted membership BEFORE serving: a request landing in
         # between would see a standalone NORMAL coordinator with wrong shard
         # placement. The socket is already bound, so early connections just

@@ -3,13 +3,16 @@
 Reference: /root/reference/test/pilosa.go:352-399 MustRunCluster — boots N
 real in-process Server+API+HTTP nodes on random localhost ports; here each
 node is a NodeServer with a real HTTP listener, so internode traffic goes
-over genuine TCP just like the reference's harness (no containers)."""
+over genuine TCP just like the reference's harness (no containers). Pass
+tls=(cert_path, key_path) to boot the whole cluster plane over TLS
+(internode clients run with skip_verify, the self-signed deployment
+shape — reference clustertests TLS variant, server/config.go:151-157)."""
 
 from __future__ import annotations
 
 import shutil
 import tempfile
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from pilosa_tpu.cluster.topology import Node
 from pilosa_tpu.server.node import NodeServer
@@ -24,11 +27,13 @@ class ClusterHarness:
         hasher=None,
         in_memory: bool = False,
         probe_interval: float = 0.0,
+        tls: Optional[Tuple[str, str]] = None,
     ):
         self._own_dir = base_dir is None and not in_memory
         self.base_dir = (
             None if in_memory else (base_dir or tempfile.mkdtemp(prefix="ptc-"))
         )
+        self.tls = tls
         self.nodes: List[NodeServer] = []
         for i in range(n):
             data_dir = None if in_memory else f"{self.base_dir}/node{i}"
@@ -38,10 +43,17 @@ class ClusterHarness:
                 replica_n=replica_n,
                 hasher=hasher,
                 probe_interval=probe_interval,
+                **self._tls_kwargs(),
             )
             srv.start()
             self.nodes.append(srv)
         self.sync_topology(replica_n)
+
+    def _tls_kwargs(self) -> dict:
+        if not self.tls:
+            return {}
+        cert, key = self.tls
+        return {"tls_cert": cert, "tls_key": key, "tls_skip_verify": True}
 
     def sync_topology(self, replica_n: Optional[int] = None) -> None:
         members = [
@@ -68,7 +80,11 @@ class ClusterHarness:
         and schema re-arrive from the coordinator's probe/repair flow for
         in-memory nodes, or from the node's own .topology on disk."""
         old = self.nodes[i]
-        host, port = old.node.uri.removeprefix("http://").rsplit(":", 1)
+        host, port = (
+            old.node.uri.removeprefix("http://")
+            .removeprefix("https://")
+            .rsplit(":", 1)
+        )
         srv = NodeServer(
             old.data_dir,
             old.node.id,
@@ -76,6 +92,7 @@ class ClusterHarness:
             replica_n=old.cluster.replica_n,
             hasher=old.cluster.hasher,
             probe_interval=old.probe_interval,
+            **self._tls_kwargs(),
         )
         srv.start()
         self.nodes[i] = srv
