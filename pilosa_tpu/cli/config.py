@@ -37,8 +37,9 @@ class AntiEntropyConfig:
 @dataclass
 class MetricConfig:
     service: str = "expvar"  # none | expvar | prometheus | statsd
-    # (reference default: expvar, stats/stats.go:84; statsd selects the
-    # same scrape registry here — no UDP push daemon in this build)
+    # (reference default: expvar, stats/stats.go:84; statsd pushes
+    # DogStatsD datagrams to `host` AND feeds the scrape registry)
+    host: str = "localhost:8125"  # statsd daemon address
     poll_interval: float = 30.0
 
 

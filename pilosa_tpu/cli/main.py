@@ -199,6 +199,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         anti_entropy_interval=cfg.anti_entropy.interval,
         probe_interval=cfg.cluster.probe_interval,
         stats_service=cfg.metric.service,
+        stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,
         long_query_time=cfg.long_query_time,
         logger=new_logger(verbose=cfg.verbose, stream=log_stream),

@@ -172,6 +172,7 @@ class Field:
             for v in self.views.values():
                 v.close()
             self.translate_store.close()
+            self.row_attr_store.close()
 
     def save_meta(self) -> None:
         if self.path is None:

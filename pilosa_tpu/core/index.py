@@ -86,6 +86,7 @@ class Index:
             for f in self._fields.values():
                 f.close()
             self.translate_store.close()
+            self.column_attr_store.close()
 
     def save_meta(self) -> None:
         if self.path is None:

@@ -32,6 +32,14 @@ from pilosa_tpu.pql import Query
 MAX_BATCH_CALLS = 64
 
 STATS = {"leader": 0, "batched": 0, "merged_execs": 0, "fallback_splits": 0}
+_STATS_MU = threading.Lock()
+
+
+def _bump(key: str) -> None:
+    # '+=' from concurrent request threads loses increments across GIL
+    # preemption; tests assert exact totals
+    with _STATS_MU:
+        STATS[key] += 1
 
 
 def batchable(query: Query) -> bool:
@@ -81,7 +89,7 @@ class CountBatcher:
             w.event.wait()
             if w.promoted:
                 return self._lead(index, w.query, execute)
-            STATS["batched"] += 1
+            _bump("batched")
             if w.error is not None:
                 raise w.error
             return w.results
@@ -90,7 +98,7 @@ class CountBatcher:
     # -- internals ---------------------------------------------------------
 
     def _lead(self, index: str, query: Query, execute):
-        STATS["leader"] += 1
+        _bump("leader")
         try:
             return execute(query)
         finally:
@@ -142,7 +150,7 @@ class CountBatcher:
         calls = calls + [calls[-1]] * (target - n_real)
         merged = Query(calls=calls)
         try:
-            STATS["merged_execs"] += 1
+            _bump("merged_execs")
             res = execute(merged)
             k = 0
             for w in batch:
@@ -152,7 +160,7 @@ class CountBatcher:
                 w.event.set()
         except Exception:
             # error isolation: one bad query must not fail its batchmates
-            STATS["fallback_splits"] += 1
+            _bump("fallback_splits")
             for w in batch:
                 try:
                     w.results = execute(w.query)

@@ -50,6 +50,7 @@ class NodeServer:
         cache_flush_interval: float = 60.0,  # 0 = flush on close only
         probe_interval: float = 0.0,  # 0 = no background liveness loop
         stats_service: str = "expvar",  # expvar|prometheus|statsd|none
+        stats_host: str = "localhost:8125",  # statsd daemon (service="statsd")
         metric_poll_interval: float = 0.0,  # 0 = no runtime poller
         long_query_time: float = 0.0,  # seconds; 0 = disabled
         logger=None,
@@ -105,7 +106,7 @@ class NodeServer:
         from pilosa_tpu.utils import stats as statsmod
         from pilosa_tpu.utils import tracing as tracingmod
 
-        self.stats = statsmod.new_stats_client(stats_service)
+        self.stats = statsmod.new_stats_client(stats_service, host=stats_host)
         self.tracer = tracingmod.global_tracer()
         self.logger = logger or (lambda msg: None)
         self._httpd = None

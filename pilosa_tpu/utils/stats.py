@@ -1,20 +1,24 @@
-"""Metrics: StatsClient interface + registry with expvar/prometheus views.
+"""Metrics: StatsClient interface + registry with expvar/prometheus views
+and a real statsd (DogStatsD) UDP push client.
 
 Reference: stats/stats.go:31-64 StatsClient (Count/Gauge/Histogram/Set/
 Timing, WithTags child clients), chosen by config `metric.service`:
 expvar (default), prometheus (served at /metrics, prometheus/prometheus.go),
-statsd (DataDog, statsd/statsd.go), none. Tagged per-index/field children
-are used throughout the hot paths (fragment.go stats, executor.go:295).
+statsd (DataDog, statsd/statsd.go:48), none. Tagged per-index/field
+children are used throughout the hot paths (fragment.go stats,
+executor.go:295).
 
-Here one thread-safe Registry backs every view: /debug/vars renders it as
-expvar-style JSON, /metrics renders prometheus text (no external push —
-statsd's UDP push model maps to "scrape the same registry"; requesting
-`statsd` selects the registry client too rather than dialing a daemon).
+Here one thread-safe Registry backs the scrape views: /debug/vars renders
+it as expvar-style JSON, /metrics renders prometheus text. `statsd`
+additionally pushes DogStatsD datagrams over UDP to metric.host
+(fire-and-forget, best-effort — a down daemon never blocks a query),
+while still feeding the registry so the scrape endpoints keep working.
 `none` selects the no-op client.
 """
 
 from __future__ import annotations
 
+import socket
 import threading
 import time
 from collections import defaultdict
@@ -207,10 +211,74 @@ class _NopTimer:
         pass
 
 
-def new_stats_client(service: str = "expvar"):
+class StatsdClient(StatsClient):
+    """DogStatsD UDP push client (reference: statsd/statsd.go:48 uses the
+    DataDog client). Every metric still lands in the shared Registry (so
+    /metrics and /debug/vars work), and is ALSO pushed as a datagram:
+    `name:value|type|#tag1,tag2`. UDP is fire-and-forget; serialization
+    errors and unreachable daemons are swallowed — metrics must never
+    take down a query."""
+
+    def __init__(
+        self,
+        host: str = "localhost:8125",
+        registry: Optional[Registry] = None,
+        tags: Iterable[str] = (),
+        prefix: str = "pilosa_tpu.",
+        sock: Optional[socket.socket] = None,
+    ):
+        super().__init__(registry, tags)
+        self.host = host
+        self.prefix = prefix
+        h, _, p = host.partition(":")
+        self._addr = (h or "localhost", int(p or 8125))
+        self._sock = sock or socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def with_tags(self, *tags: str) -> "StatsdClient":
+        return StatsdClient(
+            self.host,
+            self.registry,
+            self.tags + tags,
+            self.prefix,
+            sock=self._sock,  # children share the socket
+        )
+
+    def _push(self, name: str, value, mtype: str) -> None:
+        datagram = f"{self.prefix}{name}:{value}|{mtype}"
+        if self.tags:
+            datagram += "|#" + ",".join(self.tags)
+        try:
+            self._sock.sendto(datagram.encode(), self._addr)
+        except OSError:
+            pass  # best-effort: never block or fail the caller
+
+    def count(self, name: str, value: float = 1, rate: float = 1.0) -> None:
+        super().count(name, value, rate)
+        self._push(name, value, "c")
+
+    def gauge(self, name: str, value: float) -> None:
+        super().gauge(name, value)
+        self._push(name, value, "g")
+
+    def histogram(self, name: str, value: float) -> None:
+        super().histogram(name, value)
+        self._push(name, value, "h")
+
+    def set_value(self, name: str, value: str) -> None:
+        super().set_value(name, value)
+        self._push(name, value, "s")
+
+    def timing(self, name: str, seconds: float) -> None:
+        super().timing(name, seconds)
+        self._push(name, round(seconds * 1000.0, 3), "ms")
+
+
+def new_stats_client(service: str = "expvar", host: str = "localhost:8125"):
     """reference: server/server.go:419 newStatsClient."""
-    if service in ("expvar", "prometheus", "statsd", ""):
+    if service in ("expvar", "prometheus", ""):
         return StatsClient()
+    if service == "statsd":
+        return StatsdClient(host=host)
     if service in ("none", "nostats"):
         return NopStatsClient()
     raise ValueError(f"unknown metric service {service!r}")

@@ -1,0 +1,136 @@
+"""Attr store: incremental append-log persistence + compaction
+(VERDICT r4 #5 — set_attrs must stop rewriting the whole store per write;
+reference: boltdb/attrstore.go:82-332 page writes).
+"""
+
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.core import attrs as attrsmod
+from pilosa_tpu.core.attrs import AttrStore
+
+
+@pytest.fixture()
+def path(tmp_path):
+    return str(tmp_path / "attrs" / "store.json")
+
+
+class TestIncremental:
+    def test_set_appends_instead_of_rewriting_base(self, path):
+        st = AttrStore(path)
+        st.set_attrs(1, {"a": 1})
+        base_exists = os.path.exists(path)
+        log_size1 = os.path.getsize(st._log_path)
+        for i in range(50):
+            st.set_attrs(i, {"x": i})
+        # base snapshot untouched by incremental writes; log grew
+        assert os.path.exists(path) == base_exists
+        assert not os.path.exists(path)  # never written until compaction
+        assert os.path.getsize(st._log_path) > log_size1
+
+    def test_reopen_replays_log(self, path):
+        st = AttrStore(path)
+        st.set_attrs(7, {"name": "x", "n": 3})
+        st.set_attrs(7, {"n": 4, "gone": "y"})
+        st.set_attrs(7, {"gone": None})
+        st.set_attrs(205, {"z": True})
+        st2 = AttrStore(path)
+        assert st2.attrs(7) == {"name": "x", "n": 4}
+        assert st2.attrs(205) == {"z": True}
+        assert st2.ids() == [7, 205]
+        assert st2.blocks() == st.blocks()
+
+    def test_bulk_none_is_not_delete_across_reopen(self, path):
+        st = AttrStore(path)
+        st.set_attrs(3, {"keep": 1})
+        st.set_bulk_attrs({3: {"keep": None, "new": 2}})
+        assert st.attrs(3) == {"keep": 1, "new": 2}
+        st2 = AttrStore(path)
+        assert st2.attrs(3) == {"keep": 1, "new": 2}
+
+    def test_torn_tail_ignored(self, path):
+        st = AttrStore(path)
+        st.set_attrs(1, {"a": 1})
+        st.set_attrs(2, {"b": 2})
+        with open(st._log_path, "a") as f:
+            f.write('{"3": {"c"')  # crash mid-append: no newline
+        st2 = AttrStore(path)
+        assert st2.attrs(1) == {"a": 1}
+        assert st2.attrs(2) == {"b": 2}
+        assert 3 not in st2.ids()
+
+    def test_write_after_torn_tail_survives_next_restart(self, path):
+        """The torn tail must be TRUNCATED on replay: otherwise the next
+        append concatenates onto the torn line and an ACKNOWLEDGED write
+        silently vanishes on the restart after that (code-review r5
+        confirmed repro)."""
+        st = AttrStore(path)
+        st.set_attrs(1, {"a": 1})
+        with open(st._log_path, "a") as f:
+            f.write('{"3": {"c"')  # torn append
+        st2 = AttrStore(path)  # replay truncates the torn tail
+        st2.set_attrs(9, {"ok": True})  # acknowledged write
+        st3 = AttrStore(path)
+        assert st3.attrs(9) == {"ok": True}
+        assert st3.attrs(1) == {"a": 1}
+
+    def test_close_releases_log_fd_and_reopens_on_write(self, path):
+        st = AttrStore(path)
+        st.set_attrs(1, {"a": 1})
+        assert st._log_f is not None
+        st.close()
+        assert st._log_f is None
+        st.set_attrs(2, {"b": 2})  # reopens transparently
+        st2 = AttrStore(path)
+        assert st2.attrs(2) == {"b": 2}
+
+    def test_compaction_folds_log_into_base(self, path, monkeypatch):
+        monkeypatch.setattr(attrsmod, "COMPACT_THRESHOLD", 10)
+        st = AttrStore(path)
+        for i in range(25):
+            st.set_attrs(i % 4, {"v": i})
+        # compacted at least twice: base exists, log is short again
+        assert os.path.exists(path)
+        with open(st._log_path) as f:
+            assert len(f.readlines()) < 10
+        with open(path) as f:
+            base = json.load(f)
+        # base holds state as of the LAST compaction (i=19); later writes
+        # live only in the log until the next fold
+        assert base["0"]["v"] == 16
+        st2 = AttrStore(path)
+        assert st2.attrs(0) == {"v": 24}
+        assert st2.attrs(3) == {"v": 23}
+
+    def test_compaction_on_reopen(self, path, monkeypatch):
+        st = AttrStore(path)
+        for i in range(30):
+            st.set_attrs(i, {"v": i})
+        monkeypatch.setattr(attrsmod, "COMPACT_THRESHOLD", 10)
+        st2 = AttrStore(path)  # 30 logged lines >= 10: compacts on open
+        with open(st2._log_path) as f:
+            assert f.read() == ""
+        assert os.path.exists(path)
+        st3 = AttrStore(path)
+        assert st3.attrs(29) == {"v": 29}
+
+    def test_crash_between_base_replace_and_truncate(self, path):
+        """Replaying an already-compacted delta over the new base must be
+        idempotent (the documented crash window in _compact)."""
+        st = AttrStore(path)
+        st.set_attrs(5, {"a": 1, "d": "x"})
+        st.set_attrs(5, {"d": None, "b": 2})
+        log = open(st._log_path).read()
+        st._compact()
+        # simulate the crash: log restored as if truncate never happened
+        with open(st._log_path, "w") as f:
+            f.write(log)
+        st2 = AttrStore(path)
+        assert st2.attrs(5) == {"a": 1, "b": 2}
+
+    def test_in_memory_store_has_no_files(self):
+        st = AttrStore(None)
+        st.set_attrs(1, {"a": 1})
+        assert st.attrs(1) == {"a": 1}

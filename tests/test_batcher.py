@@ -193,30 +193,34 @@ class TestEndToEnd:
             f.import_bits(np.full(len(cols), row, np.uint64), cols)
         q = "Count(Intersect(Row(f=1), Row(f=2)))"
         (expect,) = api.query("bi", q)  # warm + truth
-        _reset_stats()
-        results = []
-        errs = []
+        # overlap is timing-dependent, so retry the round until at least
+        # one batch forms (locked STATS make the totals exact per round)
+        for _ in range(5):
+            _reset_stats()
+            results = []
+            errs = []
 
-        def client():
-            try:
-                results.append(api.query("bi", q)[0])
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
+            def client():
+                try:
+                    for _ in range(3):
+                        results.append(api.query("bi", q)[0])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
 
-        threads = [threading.Thread(target=client) for _ in range(8)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(10)
-        assert not errs
-        assert results == [expect] * 8
-        s = batchmod.STATS
-        # all 8 went through the batcher; at least one merged execution
-        # coalesced concurrent clients (exact split is timing-dependent)
-        assert s["leader"] + s["batched"] == 8
+            threads = [threading.Thread(target=client) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            assert not errs
+            assert results == [expect] * 24
+            s = batchmod.STATS
+            assert s["leader"] + s["batched"] == 24
+            assert s["fallback_splits"] == 0
+            if s["batched"] >= 1:
+                break
         assert s["leader"] >= 1
-        assert s["batched"] >= 1
-        assert s["fallback_splits"] == 0
+        assert s["batched"] >= 1  # some clients coalesced
 
     def test_non_count_queries_bypass(self, server):
         api = server.api
