@@ -2005,21 +2005,35 @@ class Executor:
                 if cnt:
                     merged[rid] = merged.get(rid, 0) + int(cnt)
             return merged
-        # pass 1: per-shard top-n of the rank cache. cache_top is sorted
-        # descending, so the threshold cut is a prefix and the n-bound is an
-        # early break — the same contract as the select heap with no src.
+        # pass 1: per-shard top-n of the rank cache, fully vectorized: the
+        # cache arrays are sorted descending so the threshold cut is a
+        # prefix, the attr filter is a boolean mask, and the n-bound is a
+        # cumsum cut — same contract as the select heap with no src. The
+        # merge is one bincount over the concatenated selections.
         n = spec.n
+        thr = np.uint64(max(spec.threshold, 1))
+        sel_rids, sel_cnts = [], []
         for _, frag in present:
-            taken = 0
-            for rid, cnt in frag.cache_top():
-                if cnt < spec.threshold:
-                    break  # sorted desc: everything after is below too
-                if allowed is not None and not allowed(rid):
-                    continue
-                merged[rid] = merged.get(rid, 0) + cnt
-                taken += 1
-                if n and taken == n:
-                    break
+            rids, cnts = frag.cache_top_arrays()
+            end = int(np.searchsorted(-cnts.view(np.int64), -int(thr), "right"))
+            rids, cnts = rids[:end], cnts[:end]
+            if allowed is not None and len(rids):
+                m = np.fromiter((allowed(int(r)) for r in rids), bool, len(rids))
+                rids, cnts = rids[m], cnts[m]
+            if n and len(rids) > n:
+                rids, cnts = rids[:n], cnts[:n]
+            if len(rids):
+                sel_rids.append(rids)
+                sel_cnts.append(cnts)
+        if sel_rids:
+            all_r = np.concatenate(sel_rids)
+            all_c = np.concatenate(sel_cnts).astype(np.uint64)
+            uniq, inv = np.unique(all_r, return_inverse=True)
+            totals = np.bincount(inv, weights=all_c.astype(np.float64))
+            # float64 weights are exact below 2^53; per-row totals are
+            # bounded by n_shards * SHARD_WIDTH, far under that
+            for rid, t in zip(uniq, totals):
+                merged[int(rid)] = int(t)
         return merged
 
     def _topn_present(self, spec: "_TopNSpec", shard_list):

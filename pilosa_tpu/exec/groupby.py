@@ -104,6 +104,18 @@ def _select_pairs(acc, planes, g_idx, r_idx):
     return jnp.bitwise_and(acc[g_idx], planes[r_idx])
 
 
+@jax.jit
+def _cross_expand(acc, planes):
+    """uint32[G, S, W] x uint32[R, S, W] -> uint32[G*R, S, W], row-major
+    (group g, row r) -> g*R + r."""
+    out = jnp.bitwise_and(acc[:, None], planes[None])
+    return out.reshape(-1, acc.shape[1], acc.shape[2])
+
+
+# Cap on the fused [G, R_last, S] count read of the one-shot path.
+_ONESHOT_READ_BYTES = 64 << 20
+
+
 def group_by_device(
     planes_list: Sequence[jax.Array],
     row_lists: Sequence[Sequence[int]],
@@ -122,6 +134,19 @@ def group_by_device(
     depth_n = len(planes_list)
     s, w = planes_list[0].shape[-2], planes_list[0].shape[-1]
     gmax = _gmax(s, w)
+
+    # One-shot path for small cross-products: build the full prefix
+    # accumulator on device with NO intermediate host reads, tally the
+    # last level, read ONCE. The pruned descent below costs one blocking
+    # read per depth — on tunneled hardware that is ~RTT x depth of pure
+    # latency — and pruning only pays when the cross-product is too big
+    # to materialize anyway.
+    g_pre = 1
+    for p in planes_list[:-1]:
+        g_pre *= int(p.shape[0])
+    read_cells = g_pre * int(planes_list[-1].shape[0]) * s * 4
+    if g_pre <= gmax and read_cells <= _ONESHOT_READ_BYTES:
+        return _group_by_oneshot(planes_list, row_lists, filt)
 
     # Depth 0: counts for every candidate row of the first child.
     if filt is not None:
@@ -145,6 +170,40 @@ def group_by_device(
         STATS["evals"] += 1
         prefixes = [(int(row_lists[0][i]),) for i in idx]
         _descend(1, acc, prefixes, planes_list, row_lists, merged, gmax)
+    return merged
+
+
+def _group_by_oneshot(
+    planes_list: Sequence[jax.Array],
+    row_lists: Sequence[Sequence[int]],
+    filt: Optional[jax.Array],
+) -> Dict[Tuple[int, ...], int]:
+    """Whole cross-product in one fused device pipeline + ONE host read.
+    Zero-count groups are pruned at merge (same contract as the descent).
+    All dispatches are async; only the final np.asarray blocks."""
+    merged: Dict[Tuple[int, ...], int] = {}
+    acc = planes_list[0]
+    if filt is not None:
+        acc = _select_rows_filtered(acc, np.arange(acc.shape[0]), filt)
+        STATS["evals"] += 1
+    keys: List[Tuple[int, ...]] = [(int(r),) for r in row_lists[0]]
+    for d in range(1, len(planes_list) - 1):
+        acc = _cross_expand(acc, planes_list[d])
+        STATS["evals"] += 1
+        keys = [k + (int(r),) for k in keys for r in row_lists[d]]
+    if len(planes_list) == 1:
+        h = _host_sum(_counts_planes(acc))
+        STATS["evals"] += 1
+        for i, cnt in enumerate(h):
+            if cnt:
+                merged[keys[i]] = int(cnt)
+        return merged
+    last_rows = row_lists[-1]
+    h = _host_sum(_counts_cross(acc, planes_list[-1]))  # [G, R_last]
+    STATS["evals"] += 1
+    gs, rs = np.nonzero(h)
+    for g, r in zip(gs, rs):
+        merged[keys[g] + (int(last_rows[r]),)] = int(h[g, r])
     return merged
 
 

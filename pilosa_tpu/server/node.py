@@ -362,6 +362,7 @@ class NodeServer:
             self._httpd.server_close()
             self._httpd = None
         self.holder.close()
+        self.stats.close()  # statsd clients own a UDP socket
 
     # -- topology ----------------------------------------------------------
 

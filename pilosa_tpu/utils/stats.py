@@ -161,6 +161,9 @@ class StatsClient:
         """Context manager recording elapsed ms into a timing series."""
         return _Timer(self, name)
 
+    def close(self) -> None:
+        pass  # registry client holds no OS resources
+
 
 class _Timer:
     def __init__(self, client: StatsClient, name: str):
@@ -202,6 +205,9 @@ class NopStatsClient:
     def timer(self, name):
         return _NopTimer()
 
+    def close(self):
+        pass
+
 
 class _NopTimer:
     def __enter__(self):
@@ -230,9 +236,47 @@ class StatsdClient(StatsClient):
         super().__init__(registry, tags)
         self.host = host
         self.prefix = prefix
-        h, _, p = host.partition(":")
-        self._addr = (h or "localhost", int(p or 8125))
-        self._sock = sock or socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._addr, family = self._parse_host(host)
+        self._sock = sock or socket.socket(family, socket.SOCK_DGRAM)
+
+    @staticmethod
+    def _parse_host(host: str):
+        """'host', 'host:port', '[v6]:port', or bare 'v6' -> (sockaddr,
+        family), resolved via getaddrinfo so IPv6 daemons work. Raises a
+        config-shaped ValueError instead of a bare int() traceback."""
+        h, p = host, 8125
+        if host.startswith("["):  # [v6]:port
+            end = host.find("]")
+            if end < 0:
+                raise ValueError(f"metric.host {host!r}: unclosed '[' in address")
+            h = host[1:end]
+            rest = host[end + 1 :]
+            if rest.startswith(":"):
+                p = rest[1:]
+        elif host.count(":") == 1:  # host:port
+            h, _, p = host.partition(":")
+        # else: bare hostname or bare IPv6 literal, default port
+        try:
+            p = int(p)
+        except ValueError:
+            raise ValueError(
+                f"metric.host {host!r}: port {p!r} is not an integer"
+            ) from None
+        try:
+            info = socket.getaddrinfo(
+                h or "localhost", p, type=socket.SOCK_DGRAM
+            )[0]
+        except socket.gaierror as e:
+            raise ValueError(f"metric.host {host!r}: cannot resolve: {e}") from None
+        return info[4], info[0]
+
+    def close(self) -> None:
+        """Release the UDP socket (NodeServer.stop calls this; with_tags
+        children share the parent's socket, so close only the root)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
     def with_tags(self, *tags: str) -> "StatsdClient":
         return StatsdClient(
