@@ -88,8 +88,14 @@ class CountBatcher:
         if w is not None:
             w.event.wait()
             if w.promoted:
-                return self._lead(index, w.query, execute)
-            _bump("batched")
+                # took over leadership: this thread executes the next
+                # round MERGED WITH ITS OWN QUERY (a solo promoted leader
+                # would make every other round a batch of one under
+                # sustained load), then hands off again
+                _bump("leader")
+                self._serve_round(index, execute, first=w)
+            else:
+                _bump("batched")
             if w.error is not None:
                 raise w.error
             return w.results
@@ -104,13 +110,16 @@ class CountBatcher:
         finally:
             self._serve_round(index, execute)
 
-    def _serve_round(self, index: str, execute) -> None:
+    def _serve_round(self, index: str, execute, first: "_Waiter" = None) -> None:
         """Serve the waiters present right now (in MAX_BATCH_CALLS-sized
-        merges), then hand leadership to the first later arrival — or
+        merges, `first` prepended when a promoted leader brings its own
+        query), then hand leadership to the first later arrival — or
         release the slot when the queue is empty."""
         with self._mu:
             round_ = self._queue.get(index, [])
             self._queue[index] = []
+        if first is not None:
+            round_.insert(0, first)
         while round_:
             batch: List[_Waiter] = []
             n = 0

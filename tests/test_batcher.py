@@ -81,6 +81,57 @@ class TestGroupCommit:
         for i in range(4):
             assert results[f"f{i}"] == [i]  # sliced back in queue order
 
+    def test_promoted_leader_merges_own_query(self):
+        """Arrivals during a batch round get served by a PROMOTED leader
+        that merges its own query into the next round — under sustained
+        load every round is a full batch, not leader-solo alternation."""
+        b = CountBatcher()
+        entered = [threading.Event(), threading.Event()]
+        gates = [threading.Event(), threading.Event()]
+        execs = []
+
+        def execute(q):
+            i = len(execs)
+            execs.append(len(q.calls))
+            if i < len(gates):
+                entered[i].set()
+                gates[i].wait(5)
+            return list(range(len(q.calls)))
+
+        results = {}
+
+        def client(name):
+            results[name] = b.run("i", parse("Count(Row(f=1))"), execute)
+
+        def enqueue_until(n):
+            # deterministically wait until n waiters sit in the queue
+            for _ in range(500):
+                with b._mu:
+                    if len(b._queue.get("i", [])) >= n:
+                        return
+                time.sleep(0.005)
+            raise AssertionError("waiters never queued")
+
+        leader = threading.Thread(target=client, args=("L",))
+        leader.start()
+        assert entered[0].wait(5)  # leader inside exec 0
+        ab = [threading.Thread(target=client, args=(n,)) for n in ("A", "B")]
+        for t in ab:
+            t.start()
+        enqueue_until(2)  # A, B queued
+        gates[0].set()  # leader finishes; round [A, B] starts (exec 1)
+        assert entered[1].wait(5)
+        cd = [threading.Thread(target=client, args=(n,)) for n in ("C", "D")]
+        for t in cd:
+            t.start()
+        enqueue_until(2)  # C, D queued behind the running round
+        gates[1].set()  # round [A, B] finishes -> C promoted
+        for t in [leader] + ab + cd:
+            t.join(5)
+        # exec 2 must carry BOTH C and D (merged), not C solo then D
+        assert execs == [1, 2, 2], execs
+        assert results["C"] == [0] and results["D"] == [1]
+
     def test_error_isolation(self):
         b = CountBatcher()
         release = threading.Event()
