@@ -157,10 +157,14 @@ def main():
             f.import_row_words(1, s, a_h[s])
             f.import_row_words(2, s, b_h[s])
         # TopN corpus: 30 extra sparse rows so the rank-cache merge is real
+        # (timed: this is the position-wise ingest path, the analog of the
+        # reference's fragment import benchmarks, fragment_internal_test.go)
         n_bits = 200_000
         rows = rng.integers(3, 33, n_bits).astype(np.uint64)
         cols = rng.integers(0, n_shards * SHARD_WIDTH, n_bits).astype(np.uint64)
+        t0 = time.perf_counter()
         f.import_bits(rows, cols)
+        ingest_bits_mps = n_bits / (time.perf_counter() - t0) / 1e6
         # BSI field: 8 planes ingested word-level straight into the bsig
         # view (synthetic planes ⊆ exists; value = Σ 2^d · plane_d bits)
         api.create_field(
@@ -418,6 +422,7 @@ def main():
                     "device_mq4_gbps_effective": round(mq_gbps_effective, 1),
                     "system_mq4_ms": round(system_mq4_ms, 3),
                     "cpu_baseline_ms": round(cpu_ms, 3),
+                    "ingest_bits_mps": round(ingest_bits_mps, 2),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),
                     "bsi_sum_1b_cols_ms": round(sum_ms, 3),

@@ -25,6 +25,7 @@ from pilosa_tpu.core.cache import (  # single source of truth: core/cache.py
     DEFAULT_CACHE_SIZE,
 )
 from pilosa_tpu.core.view import VIEW_BSI_PREFIX, VIEW_STANDARD, View
+from pilosa_tpu.utils.arrays import group_slices
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 FIELD_TYPE_SET = "set"
@@ -337,12 +338,15 @@ class Field:
         cols = np.asarray(cols, dtype=np.uint64)
         shards = cols // SHARD_WIDTH
 
-        # standard view
+        # standard view — one argsort groups the batch by shard
+        # (utils/arrays.group_slices; a mask per shard would rescan the
+        # whole batch n_shards times)
         if not self.options.no_standard_view:
             std = self._view_create(VIEW_STANDARD)
-            for shard in np.unique(shards):
-                m = shards == shard
-                std.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
+            for shard, sl in group_slices(shards):
+                std.fragment(int(shard)).bulk_import(
+                    row_ids[sl], cols[sl], clear=clear
+                )
 
         # time views
         if timestamps is not None and self.options.time_quantum:
@@ -357,9 +361,8 @@ class Field:
             for vname, idxs in by_view.items():
                 v = self._view_create(vname)
                 idx = np.array(idxs)
-                vshards = shards[idx]
-                for shard in np.unique(vshards):
-                    m = idx[vshards == shard]
+                for shard, sl in group_slices(shards[idx]):
+                    m = idx[sl]
                     v.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
 
     def import_row_words(self, row_id: int, shard: int, words: np.ndarray) -> int:
@@ -390,8 +393,7 @@ class Field:
                 self.save_meta()
         v = self._view_create(self.bsi_view_name())
         shards = cols // SHARD_WIDTH
-        for shard in np.unique(shards):
-            m = shards == shard
+        for shard, m in group_slices(shards):
             v.fragment(int(shard)).import_values(
                 cols[m], base_values[m], self.options.bit_depth
             )

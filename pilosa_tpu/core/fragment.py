@@ -33,6 +33,7 @@ from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core import rowstore as rowstore_mod
 from pilosa_tpu.core.rowstore import RowBits
+from pilosa_tpu.utils.arrays import group_slices
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.ops import bsi as obsi
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXPONENT
@@ -544,31 +545,34 @@ class Fragment:
         # nowhere else.
         n_set = n_clear = 0
         touched = set()
+
+        def _by_row(positions):
+            """(row_id, cols) groups via one sort (utils/arrays) — a
+            boolean mask per row would rescan the batch n_rows times."""
+            rows = (positions // SHARD_WIDTH).astype(np.int64)
+            cols = (positions % SHARD_WIDTH).astype(np.uint32)
+            for row_id, sl in group_slices(rows):
+                yield int(row_id), cols[sl]
+
         if len(to_set):
-            rows = (to_set // SHARD_WIDTH).astype(np.int64)
-            cols = (to_set % SHARD_WIDTH).astype(np.uint32)
-            for row_id in np.unique(rows):
-                rb = self._rows.get(int(row_id))
+            for row_id, row_cols in _by_row(to_set):
+                rb = self._rows.get(row_id)
                 if rb is None:
-                    rb = self._rows[int(row_id)] = RowBits(SHARD_WIDTH)
-                row_cols = cols[rows == row_id]
+                    rb = self._rows[row_id] = RowBits(SHARD_WIDTH)
                 n_set += rb.add(row_cols)
-                touched.add(int(row_id))
+                touched.add(row_id)
                 if self._mutex_map is not None:
                     for c in row_cols:
-                        self._mutex_map[int(c)] = int(row_id)
+                        self._mutex_map[int(c)] = row_id
         if len(to_clear):
-            rows = (to_clear // SHARD_WIDTH).astype(np.int64)
-            cols = (to_clear % SHARD_WIDTH).astype(np.uint32)
-            for row_id in np.unique(rows):
-                rb = self._rows.get(int(row_id))
-                row_cols = cols[rows == row_id]
+            for row_id, row_cols in _by_row(to_clear):
+                rb = self._rows.get(row_id)
                 if rb is not None:
                     n_clear += rb.discard(row_cols)
-                    touched.add(int(row_id))
+                    touched.add(row_id)
                 if self._mutex_map is not None:
                     for c in row_cols:
-                        if self._mutex_map.get(int(c)) == int(row_id):
+                        if self._mutex_map.get(int(c)) == row_id:
                             del self._mutex_map[int(c)]
         for row_id in touched:
             rb = self._rows.get(row_id)

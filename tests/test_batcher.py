@@ -273,6 +273,47 @@ class TestEndToEnd:
         assert s["leader"] >= 1
         assert s["batched"] >= 1  # some clients coalesced
 
+    def test_batched_counts_survive_node_failover(self):
+        """Concurrent batched Counts against a replicated cluster keep
+        answering correctly while a node dies mid-stream: the merged
+        executions fan out through the distributed executor, which
+        re-maps dead owners to live replicas; a failing merged exec
+        splits per-query rather than poisoning batchmates."""
+        from pilosa_tpu.testing import ClusterHarness
+
+        with ClusterHarness(3, replica_n=2, in_memory=True) as cluster:
+            api = cluster[0].api
+            api.create_index("fi")
+            api.create_field("fi", "f")
+            rng = np.random.default_rng(12)
+            cols = rng.integers(0, 6 * SHARD_WIDTH, 2500).astype(np.uint64)
+            q = "".join(f"Set({int(c)}, f=1)" for c in cols[:400])
+            api.query("fi", q)
+            expect = len({int(c) for c in cols[:400]})
+            qc = "Count(Row(f=1))"
+            assert api.query("fi", qc)[0] == expect  # warm
+            stop_at = threading.Event()
+            errs, got = [], []
+
+            def client():
+                try:
+                    for i in range(6):
+                        got.append(api.query("fi", qc)[0])
+                        if i == 1:
+                            stop_at.set()
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=client) for _ in range(6)]
+            for t in threads:
+                t.start()
+            assert stop_at.wait(10)
+            cluster.stop_node(2)  # mid-stream kill; replicas hold the data
+            for t in threads:
+                t.join(30)
+            assert not errs, errs[:1]
+            assert got == [expect] * 36
+
     def test_non_count_queries_bypass(self, server):
         api = server.api
         api.create_index("bj")
