@@ -111,6 +111,7 @@ _CORE_SUITES = [
     "tests/test_storage.py",
     "tests/test_executor.py",
     "tests/test_roaring_io.py",
+    "tests/test_topn_batched.py",  # r5 gather-tally bit packing
 ]
 
 
