@@ -31,7 +31,9 @@ DEFAULT_CACHE_SIZE = 50_000  # reference: field.go:48 DefaultCacheSize
 # (reference: cache.go thresholdFactor)
 _RECALC_FACTOR = 0.1
 
-_MAGIC = b"PTCACHE1"
+# v2 adds the pruned-completeness byte; v1 files fail the magic check and
+# the cache rebuilds from exact counts on open (correct, one-time cost)
+_MAGIC = b"PTCACHE2"
 
 
 class RankCache:
@@ -44,6 +46,10 @@ class RankCache:
         self._counts: Dict[int, int] = {}
         self._updates = 0
         self._top: Optional[List[Tuple[int, int]]] = None  # desc (count, id)
+        # True once any row was dropped for capacity: the cache is then an
+        # approximation, not a complete row->count map. TopN's pass-2 fast
+        # path reads exact cardinalities straight from an unpruned cache.
+        self.pruned = False
 
     def __len__(self) -> int:
         return len(self._counts)
@@ -79,6 +85,7 @@ class RankCache:
         if len(self._counts) > self.max_size:
             keep = sorted(self._counts.items(), key=lambda kv: (-kv[1], kv[0]))
             self._counts = dict(keep[: self.max_size])
+            self.pruned = True
         self._updates = 0
         self._top = None
 
@@ -93,6 +100,7 @@ class RankCache:
         self._counts.clear()
         self._updates = 0
         self._top = None
+        self.pruned = False
 
 
 class LRUCache(RankCache):
@@ -115,6 +123,7 @@ class LRUCache(RankCache):
     def _evict(self) -> None:
         while len(self._counts) > self.max_size:
             self._counts.pop(next(iter(self._counts)))
+            self.pruned = True
 
     def recalculate(self) -> None:
         self._evict()  # bulk loads must still honor the lru bound
@@ -171,7 +180,10 @@ def write_cache(path: str, cache) -> None:
     tmp = path + ".temp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC)
-        f.write(struct.pack("<I", len(pairs)))
+        # the completeness flag must survive restarts: a pruned cache
+        # reloaded as "complete" would let cache_counts_exact() return 0
+        # for rows the sidecar dropped (silent TopN undercounts)
+        f.write(struct.pack("<BI", 1 if cache.pruned else 0, len(pairs)))
         for row_id, count in pairs:
             f.write(struct.pack("<QQ", row_id, count))
     os.replace(tmp, path)
@@ -184,15 +196,17 @@ def read_cache(path: str, cache) -> bool:
             data = f.read()
     except OSError:
         return False
-    if len(data) < 12 or data[:8] != _MAGIC:
+    if len(data) < 13 or data[:8] != _MAGIC:
         return False
-    (n,) = struct.unpack_from("<I", data, 8)
-    if len(data) < 12 + 16 * n:
+    pruned, n = struct.unpack_from("<BI", data, 8)
+    if len(data) < 13 + 16 * n:
         return False
     pairs = []
     for i in range(n):
-        row_id, count = struct.unpack_from("<QQ", data, 12 + 16 * i)
+        row_id, count = struct.unpack_from("<QQ", data, 13 + 16 * i)
         pairs.append((row_id, count))
     cache.clear()
     cache.bulk_add(pairs)
+    if pruned:
+        cache.pruned = True
     return True

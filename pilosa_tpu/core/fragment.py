@@ -222,6 +222,7 @@ class Fragment:
         # row-rank cache for TopN (reference: fragment.go:131 f.cache)
         self.cache = cachemod.make_cache(cache_type, cache_size)
         self._cache_top_arrays = None  # memoized (top, rids, cnts)
+        self._cache_id_arrays = None  # memoized id-sorted (top, rids, cnts)
 
         self._mu = threading.RLock()
         self._rows: Dict[int, RowBits] = {}
@@ -467,6 +468,34 @@ class Fragment:
                 cnts = np.fromiter((p[1] for p in t), np.uint64, n)
                 memo = self._cache_top_arrays = (t, rids, cnts)
             return memo[1], memo[2]
+
+    def cache_counts_exact(self, row_ids: np.ndarray) -> Optional[np.ndarray]:
+        """uint64 cardinalities for row_ids straight from the rank cache,
+        or None unless the cache is provably complete (never pruned for
+        capacity): every write path maintains cache.add with the exact
+        count and open rebuilds from exact counts, so an unpruned cache
+        IS the full row->count map. Saves TopN pass-2's O(rows x shards)
+        count() walk; pruned caches fall back to row_counts_host."""
+        with self._mu:
+            cache = self.cache
+            t = cache.top() if hasattr(cache, "top") else []
+            if getattr(cache, "pruned", True):
+                return None  # checked AFTER top(): recalculate may prune
+            memo = self._cache_id_arrays
+            if memo is None or memo[0] is not t:
+                n = len(t)
+                rids = np.fromiter((p[0] for p in t), np.uint64, n)
+                cnts = np.fromiter((p[1] for p in t), np.uint64, n)
+                o = np.argsort(rids)
+                memo = self._cache_id_arrays = (t, rids[o], cnts[o])
+            _, rs, cs = memo
+            ids = np.asarray(row_ids, np.uint64)
+            if not len(rs):
+                return np.zeros(len(ids), np.uint64)
+            pos = np.searchsorted(rs, ids)
+            posc = np.minimum(pos, len(rs) - 1)
+            found = (pos < len(rs)) & (rs[posc] == ids)
+            return np.where(found, cs[posc], 0).astype(np.uint64)
 
     def row_counts_host(self, row_ids) -> np.ndarray:
         """Cardinalities of the listed rows as one uint64 vector under one

@@ -1990,15 +1990,21 @@ class Executor:
         if spec.ids:
             # pass 2 / explicit ids: no truncation -> the per-shard select
             # reduces to "sum counts >= threshold per shard" (exact).
+            # Cardinalities come from the rank cache when it is provably
+            # complete (vectorized lookup), else the authoritative
+            # row_counts_host walk.
             ids = [int(i) for i in spec.ids]
             if allowed is not None:
                 ids = [rid for rid in ids if allowed(rid)]
             if not ids:
                 return merged
+            ids_arr = np.asarray(ids, np.uint64)
             totals = np.zeros(len(ids), np.uint64)
             thr = np.uint64(spec.threshold)
             for _, frag in present:
-                c = frag.row_counts_host(ids)
+                c = frag.cache_counts_exact(ids_arr)
+                if c is None:
+                    c = frag.row_counts_host(ids)
                 c[c < thr] = 0
                 totals += c
             for rid, cnt in zip(ids, totals):

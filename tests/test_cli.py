@@ -177,3 +177,50 @@ def test_server_command_boots(tmp_path):
         assert status["state"] == "NORMAL"
     finally:
         srv.stop()
+
+
+def test_server_command_boots_tls(tmp_path):
+    """The CLI-level TLS wiring: flag parsing -> Config.tls -> cmd_server
+    -> an HTTPS-serving node whose advertised URI matches the scheme."""
+    import shutil
+    import ssl
+    import subprocess
+
+    import pytest
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl binary not available for cert generation")
+
+    from pilosa_tpu.cli.main import _build_parser, _load_config, cmd_server
+
+    cert, key = str(tmp_path / "c.crt"), str(tmp_path / "c.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", key, "-out", cert, "-days", "2", "-nodes",
+            "-subj", "/CN=localhost",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    args = _build_parser().parse_args(
+        [
+            "server", "--data-dir", str(tmp_path / "node"),
+            "--bind", "localhost:0",
+            "--tls-certificate", cert, "--tls-key", key,
+            "--tls-skip-verify",
+        ]
+    )
+    cfg = _load_config(args)
+    assert cfg.tls.certificate == cert and cfg.tls.skip_verify
+    srv = cmd_server(cfg, wait=False)
+    try:
+        assert srv.node.uri.startswith("https://")
+        ctx = ssl.create_default_context(cafile=cert)
+        with urllib.request.urlopen(
+            f"{srv.node.uri}/status", timeout=5, context=ctx
+        ) as r:
+            assert json.loads(r.read())["state"] == "NORMAL"
+    finally:
+        srv.stop()

@@ -21,6 +21,10 @@ from pilosa_tpu.testing import ClusterHarness
 
 @pytest.fixture(scope="module")
 def certs(tmp_path_factory):
+    import shutil
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl binary not available for cert generation")
     d = tmp_path_factory.mktemp("tls")
     cert, key = str(d / "node.crt"), str(d / "node.key")
     subprocess.run(

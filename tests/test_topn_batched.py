@@ -194,6 +194,81 @@ class TestDispatchCounts:
         # gather), NOT by the 40 shards, and issued once, not per pass
         assert exmod.TOPN_STATS["tally_evals"] <= 2
 
+    def test_cache_counts_exact(self):
+        """The pass-2 cardinality fast path: an unpruned rank cache is a
+        complete exact row->count map; once pruned it must return None
+        (callers fall back to row_counts_host)."""
+        bits = [(r, r * 5 + i) for r in range(6) for i in range(r + 1)]
+        h, ex = _mk(bits)
+        frag = (
+            h.index("i").field("f").view("standard").fragment_if_exists(0)
+        )
+        ids = np.array([0, 3, 5, 99], np.uint64)
+        got = frag.cache_counts_exact(ids)
+        assert got is not None
+        want = frag.row_counts_host([0, 3, 5, 99])
+        assert (got == want).all(), (got, want)
+        # pruned cache -> None
+        h2, ex2 = _mk(bits, cache_size=3)
+        frag2 = (
+            h2.index("i").field("f").view("standard").fragment_if_exists(0)
+        )
+        assert frag2.cache_counts_exact(ids) is None
+
+    def test_pruned_flag_survives_sidecar_reload(self, tmp_path):
+        """A pruned cache flushed to the .cache sidecar and reloaded must
+        NOT reload as 'provably complete' — cache_counts_exact would
+        return 0 for the pruned rows and TopN pass-2 would silently
+        undercount after a restart (code-review r5 finding)."""
+        from pilosa_tpu.core import cache as cachemod
+
+        cache = cachemod.RankCache(max_size=3)
+        for r in range(6):
+            cache.add(r, 10 + r)
+        cache.recalculate()
+        assert cache.pruned
+        path = str(tmp_path / "frag.cache")
+        cachemod.write_cache(path, cache)
+        fresh = cachemod.RankCache(max_size=3)
+        assert cachemod.read_cache(path, fresh)
+        assert fresh.pruned  # the flag rode the sidecar
+        # and an unpruned cache round-trips as unpruned
+        ok = cachemod.RankCache(max_size=50)
+        ok.add(1, 7)
+        path2 = str(tmp_path / "ok.cache")
+        cachemod.write_cache(path2, ok)
+        fresh2 = cachemod.RankCache(max_size=50)
+        assert cachemod.read_cache(path2, fresh2)
+        assert not fresh2.pruned
+
+    def test_cache_counts_exact_none_after_restart_when_pruned(self, tmp_path):
+        """End-to-end: fragment with more rows than cache_size, snapshot +
+        close + reopen — the fast path must refuse (None), not undercount."""
+        from pilosa_tpu.core.field import FieldOptions
+        from pilosa_tpu.core.holder import Holder
+
+        d = str(tmp_path / "h")
+        h = Holder(d).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f", FieldOptions(cache_size=4))
+        bits = [(r, r * 3 + i) for r in range(10) for i in range(r + 1)]
+        rows = np.array([r for r, _ in bits], np.uint64)
+        cols = np.array([c for _, c in bits], np.uint64)
+        f.import_bits(rows, cols)
+        frag = f.view("standard").fragment_if_exists(0)
+        frag.snapshot()  # WAL truncated: sidecar will be trusted on reopen
+        h.close()
+        h2 = Holder(d).open()
+        frag2 = (
+            h2.index("i").field("f").view("standard").fragment_if_exists(0)
+        )
+        ids = np.arange(10, dtype=np.uint64)
+        assert frag2.cache_counts_exact(ids) is None
+        # authoritative counts still exact
+        want = np.array([r + 1 for r in range(10)], np.uint64)
+        assert (frag2.row_counts_host(list(range(10))) == want).all()
+        h2.close()
+
     def test_row_count_is_o1(self):
         """RowBits cardinality must be maintained, not recomputed (plain
         TopN pass 2 does n_shards x n_candidates count() calls)."""
