@@ -483,9 +483,9 @@ class Fragment:
                 return None  # checked AFTER top(): recalculate may prune
             memo = self._cache_id_arrays
             if memo is None or memo[0] is not t:
-                n = len(t)
-                rids = np.fromiter((p[0] for p in t), np.uint64, n)
-                cnts = np.fromiter((p[1] for p in t), np.uint64, n)
+                # reuse the rank-order memo (pass 1 builds it) instead of
+                # re-iterating the tuple list
+                rids, cnts = self.cache_top_arrays()
                 o = np.argsort(rids)
                 memo = self._cache_id_arrays = (t, rids[o], cnts[o])
             _, rs, cs = memo
@@ -1049,9 +1049,12 @@ class Fragment:
                 # offsets moved with the rewrite: re-index unmaterialized
                 # rows against the new file (materialized rows unaffected)
                 self._rows.rebase(self.snap_path)
+            # flush the sidecar BEFORE truncating the WAL: open() trusts
+            # the sidecar only when the WAL replayed nothing, so a crash
+            # in between leaves a non-empty WAL -> replay -> recalculate,
+            # never a stale sidecar served as "provably complete" exact
+            # counts (code-review r5 crash-window finding)
+            self.flush_cache()
             if self._wal is not None:
                 self._wal.truncate()
             self._op_n = 0
-            # keep the sidecar in lockstep with the (now-empty) WAL: open()
-            # only trusts it when no WAL ops need replay
-            self.flush_cache()

@@ -1811,7 +1811,9 @@ class Executor:
         sorted by count. Counts are exact O(1) host metadata either way."""
         if spec.ids:
             ids = [int(i) for i in spec.ids]
-            counts = frag.row_counts_host(ids)
+            counts = frag.cache_counts_exact(np.asarray(ids, np.uint64))
+            if counts is None:
+                counts = frag.row_counts_host(ids)
             pairs = [(rid, int(cnt)) for rid, cnt in zip(ids, counts) if cnt > 0]
             pairs.sort(key=lambda p: (-p[1], p[0]))
             return 0, pairs

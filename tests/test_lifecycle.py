@@ -269,6 +269,13 @@ def test_sigkill_mid_import_wal_replay_and_ae():
             {"rows": [0] * half, "cols": all_cols[:half]},
             timeout=120,
         )
+        # attrs written pre-kill: the append-log (r5) must survive the
+        # SIGKILL (no clean close -> no compaction, torn tail possible)
+        http_json(
+            "POST", f"{uris[0]}/index/e2e/query",
+            {"query": 'SetRowAttrs(f, 0, label="alpha", rank=7)'},
+            timeout=120,
+        )
         # SIGKILL a replica mid-stream (no clean shutdown: open WALs)
         procs[2].send_signal(signal.SIGKILL)
         procs[2].wait(timeout=30)
@@ -300,6 +307,17 @@ def test_sigkill_mid_import_wal_replay_and_ae():
                 {"query": "Count(Row(f=0))"}, timeout=120,
             )
             assert r["results"][0] == len(all_cols), u
+        # attrs survived the SIGKILL + restart (append-log replay) and
+        # AE propagated them with the row data — assert on EVERY node,
+        # including the restarted one (its store was repaired by attr AE)
+        for u in uris:
+            r = http_json(
+                "POST", f"{u}/index/e2e/query",
+                {"query": "Row(f=0)"}, timeout=120,
+            )
+            assert r["results"][0].get("attrs") == {
+                "label": "alpha", "rank": 7,
+            }, u
     finally:
         for p in procs:
             if p.poll() is None:

@@ -269,6 +269,36 @@ class TestDispatchCounts:
         assert (frag2.row_counts_host(list(range(10))) == want).all()
         h2.close()
 
+    def test_snapshot_flushes_sidecar_before_wal_truncate(self, tmp_path, monkeypatch):
+        """Crash-window ordering: the cache sidecar must hit disk BEFORE
+        the WAL truncates — open() only trusts the sidecar when the WAL
+        replays nothing, so a crash in between must leave a non-empty WAL
+        (recalculate path), never a stale 'complete' sidecar serving
+        wrong exact counts (code-review r5 finding)."""
+        from pilosa_tpu.core import fragment as fragmod
+        from pilosa_tpu.core import wal as walmod
+        from pilosa_tpu.core.holder import Holder
+
+        h = Holder(str(tmp_path / "h")).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        f.import_bits(np.array([1, 2], np.uint64), np.array([5, 9], np.uint64))
+        frag = f.view("standard").fragment_if_exists(0)
+        order = []
+        orig_flush = fragmod.Fragment.flush_cache
+        orig_trunc = walmod.WalWriter.truncate
+        monkeypatch.setattr(
+            fragmod.Fragment, "flush_cache",
+            lambda self: (order.append("flush"), orig_flush(self))[1],
+        )
+        monkeypatch.setattr(
+            walmod.WalWriter, "truncate",
+            lambda self: (order.append("truncate"), orig_trunc(self))[1],
+        )
+        frag.snapshot()
+        assert order.index("flush") < order.index("truncate"), order
+        h.close()
+
     def test_row_count_is_o1(self):
         """RowBits cardinality must be maintained, not recomputed (plain
         TopN pass 2 does n_shards x n_candidates count() calls)."""
