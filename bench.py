@@ -277,6 +277,45 @@ def main():
         )
         mq_gbps_effective = bytes_per_q / (mq_ms / 1000) / 1e9
 
+        # ---- filtered-TopN device work, RTT-amortized ----
+        # The exact shapes the one-pass tally dispatches at bench scale:
+        # dense-candidate cross tally [1,S,W]x[2,S,W], sparse gather of
+        # ~200k live words + sorted-segment cumsum, fused [32,S] concat.
+        # Batched back-to-back with ONE final sync, same methodology as
+        # the count device number — this is the colocated-hardware cost
+        # of a filtered TopN query (the system number is RTT-bound).
+        from pilosa_tpu.exec import groupby as gbm
+        from pilosa_tpu.ops import bitmap as obm
+
+        planes2 = jax.device_put(np.stack([a_h, b_h]))  # dense candidates
+        k_ent = 1 << 18
+        g_idx = jax.device_put(
+            rng.integers(0, n_shards * WORDS_PER_ROW, k_ent).astype(np.int32)
+        )
+        g_mask = jax.device_put(rng.integers(0, 2**32, k_ent, np.uint32))
+        segs = np.sort(rng.integers(0, k_ent, 32 * n_shards)).astype(np.int32)
+        g_starts = jax.device_put(segs)
+        g_ends = jax.device_put(np.minimum(segs + 8, k_ent).astype(np.int32))
+
+        @jax.jit
+        def topn_tally_once(b, planes2, g_idx, g_mask, g_starts, g_ends, salt):
+            # operands as arguments, not closure: closed-over device
+            # arrays would embed as compile-time constants
+            src = jnp.bitwise_xor(b, salt)
+            dense_c = gbm._counts_cross(src[None], planes2)[0]
+            sparse_c = obm.gather_tally_sorted(
+                src, g_idx, g_mask, g_starts, g_ends
+            ).reshape(32, n_shards)
+            return jnp.concatenate([dense_c[:, :n_shards], sparse_c], axis=0)
+
+        args_t = (b, planes2, g_idx, g_mask, g_starts, g_ends)
+        _ = np.asarray(topn_tally_once(*args_t, np.uint32(0)))  # warm
+        TB = 32
+        t0 = time.perf_counter()
+        outs = [topn_tally_once(*args_t, np.uint32(i + 1)) for i in range(TB)]
+        _ = np.asarray(outs[-1])  # one sync for the whole batch
+        topn_filtered_device_ms = (time.perf_counter() - t0) * 1000 / TB
+
         # ---- tunnel RTT (dispatch + sync of a trivial op) ----
         tiny = jax.device_put(np.uint32(1))
         add1 = jax.jit(lambda x: x + 1)
@@ -427,6 +466,7 @@ def main():
                     "ingest_bits_mps": round(ingest_bits_mps, 2),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),
+                    "topn_filtered_device_ms": round(topn_filtered_device_ms, 3),
                     "bsi_sum_1b_cols_ms": round(sum_ms, 3),
                     "groupby_3f_64shards_ms": round(groupby_ms, 3),
                     "hbm_evict_count_ms": round(hbm_evict_count_ms, 3),

@@ -187,6 +187,8 @@ def gather_tally_sorted(src, idx, mask, starts, ends) -> jnp.ndarray:
     under 2^27 (each entry contributes <= 32); the caller enforces that
     bound when building entries."""
     vals = jax.lax.population_count(jnp.bitwise_and(src.reshape(-1)[idx], mask))
+    # (a two-level blocked scan was tried here and measured at parity:
+    # the scattered gather dominates and overlaps the scan)
     cum = jnp.concatenate(
         [jnp.zeros(1, jnp.uint32), jnp.cumsum(vals, dtype=jnp.uint32)]
     )
