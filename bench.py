@@ -306,7 +306,7 @@ def main():
             sparse_c = obm.gather_tally_sorted(
                 src, g_idx, g_mask, g_starts, g_ends
             ).reshape(32, n_shards)
-            return jnp.concatenate([dense_c[:, :n_shards], sparse_c], axis=0)
+            return jnp.concatenate([dense_c, sparse_c], axis=0)
 
         args_t = (b, planes2, g_idx, g_mask, g_starts, g_ends)
         _ = np.asarray(topn_tally_once(*args_t, np.uint32(0)))  # warm

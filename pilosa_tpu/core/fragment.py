@@ -584,13 +584,15 @@ class Fragment:
                 yield int(row_id), cols[sl]
 
         if len(to_set):
-            for row_id, row_cols in _by_row(to_set):
-                rb = self._rows.get(row_id)
-                if rb is None:
-                    rb = self._rows[row_id] = RowBits(SHARD_WIDTH)
-                n_set += rb.add(row_cols)
-                touched.add(row_id)
-                if self._mutex_map is not None:
+            if self._mutex_map is None:
+                n_set += self._bulk_set_sparse(to_set, touched)
+            else:
+                for row_id, row_cols in _by_row(to_set):
+                    rb = self._rows.get(row_id)
+                    if rb is None:
+                        rb = self._rows[row_id] = RowBits(SHARD_WIDTH)
+                    n_set += rb.add(row_cols)
+                    touched.add(row_id)
                     for c in row_cols:
                         self._mutex_map[int(c)] = row_id
         if len(to_clear):
@@ -616,6 +618,66 @@ class Fragment:
             if self.on_mutate is not None:
                 self.on_mutate()
         return n_set, n_clear
+
+    def _bulk_set_sparse(self, to_set: np.ndarray, touched: set) -> int:
+        """Set a batch of keyed positions (row*SHARD_WIDTH + col) with ONE
+        merge for all sparse-rep rows: their stored position arrays and
+        the incoming batch are re-keyed into the same row-major space, so
+        one np.unique over the concatenation replaces a union1d per
+        (row, shard) — the per-call numpy overhead used to dominate
+        scattered bulk imports ~3:1. Dense-rep rows keep the per-row word
+        path (their bits are cheap to OR in place)."""
+        rows_arr = to_set // SHARD_WIDTH
+        uniq_rows = np.unique(rows_arr).astype(np.uint64)
+        dense_rows = [
+            int(r)
+            for r in uniq_rows
+            if (rb := self._rows.get(int(r))) is not None and rb.dense is not None
+        ]
+        n = 0
+        if dense_rows:
+            m = np.isin(rows_arr, np.array(dense_rows, np.uint64))
+            cols = (to_set[m] % SHARD_WIDTH).astype(np.uint32)
+            for row_id, sl in group_slices(rows_arr[m].astype(np.int64)):
+                rb = self._rows[int(row_id)]
+                n += rb.add(cols[sl])
+                touched.add(int(row_id))
+            if len(dense_rows) == len(uniq_rows):
+                return n
+            incoming = to_set[~m]
+        else:
+            incoming = to_set
+        dense_set = set(dense_rows)
+        sparse_rows = [int(r) for r in uniq_rows if int(r) not in dense_set]
+        parts = [incoming.astype(np.uint64)]
+        before = 0
+        for rid in sparse_rows:
+            rb = self._rows.get(rid)
+            if rb is not None and len(rb.positions):
+                before += len(rb.positions)
+                parts.append(
+                    rb.positions.astype(np.uint64) + np.uint64(rid) * np.uint64(SHARD_WIDTH)
+                )
+        merged = np.unique(np.concatenate(parts))
+        # split the sorted row-major keyspace back into per-row arrays
+        edges = np.searchsorted(
+            merged,
+            np.array(
+                [r * SHARD_WIDTH for r in sparse_rows]
+                + [(sparse_rows[-1] + 1) * SHARD_WIDTH],
+                np.uint64,
+            ),
+        )
+        for i, rid in enumerate(sparse_rows):
+            seg = merged[edges[i] : edges[i + 1]]
+            rb = self._rows.get(rid)
+            if rb is None:
+                rb = self._rows[rid] = RowBits(SHARD_WIDTH)
+            rb.positions = (seg % np.uint64(SHARD_WIDTH)).astype(np.uint32)
+            rb._maybe_densify()
+            touched.add(rid)
+        n += len(merged) - before
+        return n
 
     def import_row_words(self, row_id: int, words: np.ndarray) -> int:
         """Word-level bulk union into one row — the device-native analog of
