@@ -659,7 +659,12 @@ class Fragment:
                     rb.positions.astype(np.uint64) + np.uint64(rid) * np.uint64(SHARD_WIDTH)
                 )
         merged = np.unique(np.concatenate(parts))
-        # split the sorted row-major keyspace back into per-row arrays
+        # split the sorted row-major keyspace back into per-row arrays;
+        # the %/cast runs ONCE for the whole fragment, then each row takes
+        # a COPY of its slice — a shared view would pin the entire merge
+        # buffer for as long as any one straggler row kept its slice
+        # (rows densify/rewrite independently)
+        all_pos = (merged % np.uint64(SHARD_WIDTH)).astype(np.uint32)
         edges = np.searchsorted(
             merged,
             np.array(
@@ -669,11 +674,10 @@ class Fragment:
             ),
         )
         for i, rid in enumerate(sparse_rows):
-            seg = merged[edges[i] : edges[i + 1]]
             rb = self._rows.get(rid)
             if rb is None:
                 rb = self._rows[rid] = RowBits(SHARD_WIDTH)
-            rb.positions = (seg % np.uint64(SHARD_WIDTH)).astype(np.uint32)
+            rb.positions = all_pos[edges[i] : edges[i + 1]].copy()
             rb._maybe_densify()
             touched.add(rid)
         n += len(merged) - before
