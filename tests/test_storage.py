@@ -64,6 +64,42 @@ class TestFragment:
         assert f.contains(2, 10)
         assert not f.set_bit(2, 10)
 
+    def test_bulk_set_sparse_differential(self, rng):
+        """Randomized differential of the r5 batched sparse-set path
+        (_bulk_set_sparse: one row-major merge per fragment) against a
+        Python set model: interleaved bulk imports, single-bit writes,
+        clears, duplicates, rows crossing the sparse->dense threshold,
+        and exact newly-set accounting."""
+        f = frag()
+        model: dict = {}
+        dense_row = 1  # driven across the densify threshold early
+        wide = np.unique(rng.integers(0, SHARD_WIDTH, SHARD_WIDTH // 16))
+        n = f.bulk_import(np.full(len(wide), dense_row, np.uint64), wide)
+        model[dense_row] = set(int(c) for c in wide)
+        assert n == len(model[dense_row])
+        for _ in range(12):
+            k = int(rng.integers(1, 3000))
+            rows = rng.integers(0, 9, k).astype(np.uint64)
+            cols = rng.integers(0, SHARD_WIDTH, k).astype(np.uint64)
+            before = sum(len(s) for s in model.values())
+            got = f.bulk_import(rows, cols)
+            for r, c in zip(rows, cols):
+                model.setdefault(int(r), set()).add(int(c))
+            want = sum(len(s) for s in model.values()) - before
+            assert got == want
+            # interleave point writes and clears
+            r = int(rng.integers(0, 9))
+            c = int(rng.integers(0, SHARD_WIDTH))
+            f.set_bit(r, c)
+            model.setdefault(r, set()).add(c)
+            if model.get(0):
+                victim = next(iter(model[0]))
+                f.clear_bit(0, victim)
+                model[0].discard(victim)
+        for r, bits in model.items():
+            assert f.row_count(r) == len(bits), r
+            assert set(f.row_positions(r).tolist()) == bits, r
+
     def test_mutex_bulk(self):
         f = frag(mutex=True)
         f.bulk_import(
