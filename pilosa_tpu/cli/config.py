@@ -27,6 +27,15 @@ class ClusterConfig:
     # coordinator liveness-probe ticker, seconds; 0 disables (the SWIM
     # role — reference gossip probes continuously, gossip/gossip.go:364)
     probe_interval: float = 2.0
+    # internode RPC fault tolerance (server/faults.py): attempts share
+    # one deadline budget per request; per-peer circuit breakers fast-
+    # fail requests to known-dead peers; query-deadline bounds a whole
+    # distributed fan-out including failover re-map rounds
+    retry_max_attempts: int = 3
+    retry_base_backoff: float = 0.05  # seconds before the first retry
+    breaker_threshold: int = 5  # consecutive failures before open
+    breaker_cooldown: float = 2.0  # seconds open before a half-open probe
+    query_deadline: float = 30.0  # seconds per distributed query
 
 
 @dataclass

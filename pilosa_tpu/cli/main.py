@@ -35,6 +35,26 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--replicas", type=int)
     sp.add_argument("--anti-entropy-interval", type=float)
     sp.add_argument(
+        "--retry-max-attempts", type=int,
+        help="internode RPC attempts within one deadline budget",
+    )
+    sp.add_argument(
+        "--retry-base-backoff", type=float,
+        help="seconds before the first internode retry (doubles per retry)",
+    )
+    sp.add_argument(
+        "--breaker-threshold", type=int,
+        help="consecutive failures before a peer's circuit opens",
+    )
+    sp.add_argument(
+        "--breaker-cooldown", type=float,
+        help="seconds a circuit stays open before a half-open probe",
+    )
+    sp.add_argument(
+        "--query-deadline", type=float,
+        help="wall-clock bound on one distributed query fan-out, seconds",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -100,6 +120,16 @@ def _load_config(args) -> Config:
         cluster["hosts"] = args.cluster_hosts
     if getattr(args, "replicas", None) is not None:
         cluster["replicas"] = args.replicas
+    for knob in (
+        "retry_max_attempts",
+        "retry_base_backoff",
+        "breaker_threshold",
+        "breaker_cooldown",
+        "query_deadline",
+    ):
+        v = getattr(args, knob, None)
+        if v is not None:
+            cluster[knob] = v
     if cluster:
         overrides["cluster"] = cluster
     if getattr(args, "anti_entropy_interval", None) is not None:
@@ -198,6 +228,11 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         replica_n=cfg.cluster.replicas,
         anti_entropy_interval=cfg.anti_entropy.interval,
         probe_interval=cfg.cluster.probe_interval,
+        retry_max_attempts=cfg.cluster.retry_max_attempts,
+        retry_base_backoff=cfg.cluster.retry_base_backoff,
+        breaker_threshold=cfg.cluster.breaker_threshold,
+        breaker_cooldown=cfg.cluster.breaker_cooldown,
+        query_deadline=cfg.cluster.query_deadline,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

@@ -19,6 +19,11 @@ class Holder:
         self.path = path  # data directory; None => in-memory
         self._mu = threading.RLock()
         self._indexes: Dict[str, Index] = {}
+        # (index, shard, node_id) writes that a replica missed (it was
+        # down / partitioned when the write fanned out): anti-entropy is
+        # what repairs them, and this set is what makes that debt VISIBLE
+        # (/status pendingRepairs) instead of silent drift
+        self._pending_repairs: set = set()
 
     def open(self) -> "Holder":
         if self.path is not None:
@@ -76,6 +81,49 @@ class Holder:
             idx.close()
             if idx.path is not None:
                 shutil.rmtree(idx.path, ignore_errors=True)
+            self.resolve_pending_repairs(index=name)
+
+    # -- pending replica repairs -------------------------------------------
+
+    def record_pending_repair(self, index: str, shard: int, node_id: str) -> None:
+        """A write to (index, shard) was dropped on its way to replica
+        `node_id`; anti-entropy owes it a repair."""
+        with self._mu:
+            self._pending_repairs.add((index, int(shard), node_id))
+
+    def pending_repairs(self) -> List[tuple]:
+        with self._mu:
+            return sorted(self._pending_repairs)
+
+    def pending_repair_count(self) -> int:
+        with self._mu:
+            return len(self._pending_repairs)
+
+    def discard_pending_repair(self, index: str, shard: int, node_id: str) -> bool:
+        """Drop ONE entry — used when anti-entropy confirms this specific
+        replica was reconciled (an unreachable replica's entry must stay)."""
+        with self._mu:
+            try:
+                self._pending_repairs.remove((index, int(shard), node_id))
+                return True
+            except KeyError:
+                return False
+
+    def resolve_pending_repairs(
+        self, index: Optional[str] = None, shard: Optional[int] = None
+    ) -> int:
+        """Discard entries matching (index, shard); None matches all.
+        Called when an anti-entropy pass reconciles a fragment (and when
+        an index is deleted). Returns how many entries were resolved."""
+        with self._mu:
+            before = len(self._pending_repairs)
+            self._pending_repairs = {
+                (i, s, n)
+                for (i, s, n) in self._pending_repairs
+                if (index is not None and i != index)
+                or (shard is not None and s != shard)
+            }
+            return before - len(self._pending_repairs)
 
     def fragments(self):
         """Every open fragment (indexes -> fields -> views -> fragments)."""

@@ -40,6 +40,18 @@ from pilosa_tpu.exec.executor import (
 )
 from pilosa_tpu.pql.ast import Call
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.stats import NopStatsClient
+
+
+def _faults():
+    # lazy: pilosa_tpu.server.__init__ imports node -> this module, so a
+    # top-level "from pilosa_tpu.server import faults" would be circular
+    # when exec.distributed is imported before the server package
+    from pilosa_tpu.server import faults
+
+    return faults
+
+DEFAULT_QUERY_DEADLINE = 30.0
 
 
 class RemoteError(ExecError):
@@ -53,11 +65,17 @@ class DistributedExecutor(Executor):
         cluster_fn: Callable[[], Cluster],
         client,
         local_id: str,
+        stats=None,
+        query_deadline: float = DEFAULT_QUERY_DEADLINE,
     ):
         super().__init__(holder)
         self.cluster_fn = cluster_fn
         self.client = client
         self.local_id = local_id
+        self.stats = stats if stats is not None else NopStatsClient()
+        # overall wall-clock bound on one distributed call's fan-out,
+        # covering every re-map round and backoff (config: query-deadline)
+        self.query_deadline = query_deadline
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_mu = threading.Lock()
 
@@ -89,19 +107,32 @@ class DistributedExecutor(Executor):
             raise RemoteError(f"unknown node {node_id}")
         return n.uri
 
+    def _breaker_open(self, uri: str) -> bool:
+        faults = _faults()
+        breakers = getattr(self.client, "breakers", None) or faults.global_breakers()
+        return breakers is not None and breakers.state(uri) == faults.OPEN
+
     def _fan_out(
         self, idx: Index, c: Call, shards: Optional[Sequence[int]], write: bool = False
     ) -> List[Any]:
         """Run call `c` over the cluster's shards; returns the list of
         partial results (local partial included). Reads go to the first
         live owner per shard with failover re-mapping (executor.go:2497);
-        writes go to EVERY live replica owner (executor.go:2142)."""
+        writes go to EVERY live replica owner (executor.go:2142).
+
+        The whole fan-out — every re-map round and backoff included — is
+        bounded by `query_deadline`; re-map rounds back off with the
+        client's retry policy, and owner selection prefers replicas whose
+        circuit breaker is not open (a known-dead peer only gets picked
+        when every replica looks dead)."""
         cluster = self._cluster()
         all_shards = self._shards_for(idx, shards, c)
         if write:
             remaining = dict(cluster.shards_by_all_owners(idx.name, all_shards))
         else:
             remaining = dict(cluster.shards_by_node(idx.name, all_shards))
+        policy = getattr(self.client, "retry_policy", None) or _faults().RetryPolicy()
+        deadline = policy.budget(self.query_deadline)
         partials: List[Any] = []
         failed: set = set()
         attempts = 0
@@ -109,6 +140,18 @@ class DistributedExecutor(Executor):
             attempts += 1
             if attempts > len(cluster.nodes) + 1:
                 raise RemoteError("shards could not be placed on any live node")
+            if deadline.expired():
+                raise RemoteError(
+                    f"query deadline ({self.query_deadline}s) exceeded with "
+                    f"shards unplaced on nodes {sorted(remaining)}"
+                )
+            if attempts > 1:
+                # breathe between re-map rounds: a replica refusing
+                # connections during a restart needs milliseconds, not an
+                # instant second hammering (bounded by the deadline)
+                delay = min(policy.backoff(attempts - 1), deadline.remaining())
+                if delay > 0:
+                    policy.sleep(delay)
             # one concurrent request per node (executor.go:2522 mapper
             # goroutines): a slow node no longer serializes the others.
             # RemoteErrors come back as values so failover re-mapping
@@ -118,7 +161,17 @@ class DistributedExecutor(Executor):
             def attempt(t):
                 node_id, node_shards = t
                 try:
-                    return self._node_partial(idx, c, node_id, node_shards)
+                    # each RPC is bounded by the query deadline's REMAINING
+                    # time, so a hung (connected-but-silent) peer cannot
+                    # stall the fan-out past the deadline
+                    return self._node_partial(
+                        idx,
+                        c,
+                        node_id,
+                        node_shards,
+                        write=write,
+                        timeout=max(0.05, deadline.remaining()),
+                    )
                 except RemoteError as e:
                     return e
 
@@ -134,12 +187,27 @@ class DistributedExecutor(Executor):
                 failed.add(node_id)
                 if write:
                     # replicas already targeted; drift repairs via
-                    # anti-entropy rather than re-mapping
+                    # anti-entropy — but the debt must be VISIBLE: record
+                    # each dropped (index, shard, replica) for /status and
+                    # bump the drop counter (ISSUE satellite #2). Ledger
+                    # entries only exist at replica_n>1: with no second
+                    # copy there is nothing for AE to repair FROM, so an
+                    # entry could never drain (the error surfaces through
+                    # the call's own result/logs instead).
+                    if cluster.replica_n > 1:
+                        for s in node_shards:
+                            self.holder.record_pending_repair(
+                                idx.name, s, node_id
+                            )
+                        self.stats.count(
+                            "write_replica_dropped", len(node_shards)
+                        )
                     continue
-                # re-map this node's shards to the next live replica
+                # re-map this node's shards to the next live replica,
+                # preferring replicas whose breaker is closed
                 for s in node_shards:
                     owners = [
-                        n.id
+                        n
                         for n in cluster.shard_nodes(idx.name, s)
                         if n.id not in failed and n.state != "DOWN"
                     ]
@@ -147,12 +215,22 @@ class DistributedExecutor(Executor):
                         raise RemoteError(
                             f"shard {s} unavailable: all replicas down"
                         )
-                    retry.setdefault(owners[0], []).append(s)
+                    owners.sort(
+                        key=lambda n: n.id != self.local_id
+                        and self._breaker_open(n.uri)
+                    )
+                    retry.setdefault(owners[0].id, []).append(s)
             remaining = retry
         return partials
 
     def _node_partial(
-        self, idx: Index, c: Call, node_id: str, node_shards: List[int]
+        self,
+        idx: Index,
+        c: Call,
+        node_id: str,
+        node_shards: List[int],
+        write: bool = False,
+        timeout: Optional[float] = None,
     ) -> Any:
         if node_id == self.local_id:
             opt = ExecOptions(remote=True)
@@ -164,9 +242,20 @@ class DistributedExecutor(Executor):
                 str(c),
                 shards=node_shards,
                 remote=True,
+                timeout=timeout,
             )
-        except Exception as e:  # transport/remote errors -> failover
-            raise RemoteError(f"node {node_id}: {e}") from e
+        except Exception as e:
+            # reads: node-down shaped failures fail over to a replica; a
+            # non-retryable ClientError (4xx / remote payload error) means
+            # the peer is alive and rejected the request — replaying the
+            # same bad request on a replica cannot succeed (satellite #1).
+            # writes: EVERY failure stays RemoteError-shaped so the write
+            # path records pending-repair debt for this replica and keeps
+            # going instead of aborting the fan-out mid-flight with other
+            # replicas already written.
+            if write or getattr(e, "retryable", True):
+                raise RemoteError(f"node {node_id}: {e}") from e
+            raise ExecError(f"node {node_id}: {e}") from e
         return results[0]
 
     # ------------------------------------------------------------------
@@ -304,6 +393,7 @@ class DistributedExecutor(Executor):
         owners = cluster.shard_nodes(idx.name, shard)
         changed = False
         errs = []
+        failed_nodes = []
         for n in owners:
             try:
                 if n.id == self.local_id:
@@ -317,8 +407,22 @@ class DistributedExecutor(Executor):
                 changed = changed or bool(r)
             except Exception as e:
                 errs.append(f"{n.id}: {e}")
+                failed_nodes.append(n)
         if errs and len(errs) == len(owners):
             raise RemoteError("; ".join(errs))
+        # partial application: some replica missed this write — visible
+        # pending-repair debt instead of silent drift (satellite #2).
+        # Only REMOTE replicas at replica_n>1 are recorded: a local-apply
+        # failure is not replica drift (the primary's normal AE pushes to
+        # us), a self-keyed entry could never be resolved by any sync
+        # path, and at replica_n<=1 there is no second copy to repair
+        # from so the entry could never drain.
+        dropped = [n for n in failed_nodes if n.id != self.local_id]
+        if cluster.replica_n > 1:
+            for n in dropped:
+                self.holder.record_pending_repair(idx.name, shard, n.id)
+            if dropped:
+                self.stats.count("write_replica_dropped", len(dropped))
         if c.name == "Set":
             self._announce_written_shard(idx, c, shard)
         return changed

@@ -23,6 +23,7 @@ sums them in exact Python ints — one device->host read per query.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -37,6 +38,16 @@ from pilosa_tpu.ops.bitmap import shift_bits
 # Dispatch accounting: evals counts jitted plan executions (the "one device
 # dispatch per query" contract is asserted against this in tests).
 STATS = {"evals": 0}
+
+# One in-flight compiled mesh dispatch at a time. Concurrent entry into a
+# multi-device program from several HTTP handler threads can DEADLOCK the
+# XLA CPU client when virtual devices outnumber physical cores (each
+# program parks in its collective rendezvous waiting for device threads
+# another program holds — observed as cluster tests hanging inside
+# pjit __call__ on 2-core CI hosts). A single program occupying the whole
+# mesh is the execution model anyway; the lock makes it explicit. It is
+# held through the device->host read so no async execution escapes it.
+_DISPATCH_MU = threading.Lock()
 
 
 def reset_stats() -> None:
@@ -289,25 +300,39 @@ class StackedPlan:
         """Total count: ONE jitted dispatch + one [S] host read, summed in
         exact Python ints (replaces the per-shard int() sync loop)."""
         STATS["evals"] += 1
-        counts = _eval_jit(self.root, "count", tuple(self.operands), self._scalar_args())
-        return int(np.asarray(counts[: self.n_shards], dtype=np.uint64).sum())
+        with _DISPATCH_MU:
+            counts = _eval_jit(
+                self.root, "count", tuple(self.operands), self._scalar_args()
+            )
+            host = np.asarray(counts[: self.n_shards], dtype=np.uint64)
+        return int(host.sum())
 
     def shard_counts(self) -> np.ndarray:
         STATS["evals"] += 1
-        counts = _eval_jit(self.root, "count", tuple(self.operands), self._scalar_args())
-        return np.asarray(counts)[: self.n_shards]
+        with _DISPATCH_MU:
+            counts = _eval_jit(
+                self.root, "count", tuple(self.operands), self._scalar_args()
+            )
+            return np.asarray(counts)[: self.n_shards]
 
     def rows(self) -> jax.Array:
         """Materialized [S, W] result stack (padded shards trimmed)."""
         STATS["evals"] += 1
-        out = _eval_jit(self.root, "row", tuple(self.operands), self._scalar_args())
-        return out[: self.n_shards]
+        with _DISPATCH_MU:
+            out = _eval_jit(
+                self.root, "row", tuple(self.operands), self._scalar_args()
+            )
+            return out[: self.n_shards].block_until_ready()
 
     def rows_full(self) -> jax.Array:
         """Materialized result stack INCLUDING mesh-padded shards (all-zero
         rows), for composing with other padded [S, W] stacks on device."""
         STATS["evals"] += 1
-        return _eval_jit(self.root, "row", tuple(self.operands), self._scalar_args())
+        with _DISPATCH_MU:
+            out = _eval_jit(
+                self.root, "row", tuple(self.operands), self._scalar_args()
+            )
+            return out.block_until_ready()
 
 
 class MultiCountPlan:
@@ -328,11 +353,12 @@ class MultiCountPlan:
 
     def counts(self) -> List[int]:
         STATS["evals"] += 1
-        out = _eval_multi_jit(
-            tuple(self.roots),
-            "count",
-            tuple(self.operands),
-            tuple(jnp.uint32(s) for s in self.scalars),
-        )
-        h = np.asarray(out, dtype=np.uint64)[:, : self.n_shards]
+        with _DISPATCH_MU:
+            out = _eval_multi_jit(
+                tuple(self.roots),
+                "count",
+                tuple(self.operands),
+                tuple(jnp.uint32(s) for s in self.scalars),
+            )
+            h = np.asarray(out, dtype=np.uint64)[:, : self.n_shards]
         return [int(x) for x in h.sum(axis=1)]

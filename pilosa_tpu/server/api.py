@@ -439,6 +439,14 @@ class API:
                     applied += 1
                 except ClientError as e:
                     errors.append(f"{n.id}: {e}")
+                    # ledger entries only at replica_n>1: with no second
+                    # copy AE has nothing to repair from, so an entry
+                    # could never drain (the summary carries the error)
+                    if self.cluster.replica_n > 1:
+                        self.holder.record_pending_repair(
+                            idx.name, shard, n.id
+                        )
+                        self.server.stats.count("write_replica_dropped", 1)
                     self.server.logger(
                         f"import shard {shard} to replica {n.id} failed "
                         f"(anti-entropy will repair): {e}"
@@ -644,11 +652,17 @@ class API:
     # -- cluster info ------------------------------------------------------
 
     def status(self) -> dict:
+        breakers = getattr(self.server.client, "breakers", None)
         return {
             "state": self.server.state,
             "localID": self.server.node.id,
             "clusterID": self.server.cluster_name,
             "nodes": [n.to_json() for n in self.cluster.nodes],
+            # replica writes dropped on this node's fan-outs, awaiting
+            # anti-entropy repair (visible drift, ISSUE satellite #2)
+            "pendingRepairs": self.holder.pending_repair_count(),
+            # peer URI -> circuit state, so operators see shunned peers
+            "breakers": breakers.snapshot() if breakers is not None else {},
         }
 
     def hosts(self) -> List[dict]:

@@ -6,7 +6,16 @@ block sync (:842-933), message send (:1017); interface in client.go:46.
 
 stdlib urllib only (no external deps); JSON bodies; every method raises
 ClientError on transport or remote failure so the executor's failover path
-can re-map shards."""
+can re-map shards.
+
+Every `_do` call rides the fault-tolerance plane (server/faults.py): the
+`timeout` is a TOTAL deadline budget shared by all retry attempts (not a
+flat per-attempt timeout), retryable failures (connection refused,
+timeouts, 5xx) back off and retry within that budget, and a per-peer
+circuit breaker fails requests to a known-dead node in microseconds
+instead of burning the budget. All internode verbs here are idempotent
+(set/clear bitmap semantics, checksum reads, status messages), so
+retrying a request whose response was lost is safe."""
 
 from __future__ import annotations
 
@@ -19,14 +28,48 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from pilosa_tpu.server import wire
+from pilosa_tpu.server import faults, wire
 from pilosa_tpu.utils import tracing
 
 DEFAULT_TIMEOUT = 30.0
 
+# a timeout observed under a smaller per-attempt allotment than this says
+# more about the CALLER's nearly-exhausted deadline budget than about peer
+# health — it must not open the peer's circuit breaker
+_TIMEOUT_PENALTY_FLOOR = 1.0
+
 
 class ClientError(Exception):
-    pass
+    """Transport or remote failure, carrying enough to route failover:
+    `status` (HTTP code or None for connection-level failures),
+    `retryable` (may a retry / another replica fix this?), and the peer
+    `uri` — so logs and the executor can tell "node down" from "bad
+    request" (ISSUE satellite #1)."""
+
+    def __init__(
+        self,
+        msg: str,
+        status: Optional[int] = None,
+        retryable: bool = False,
+        uri: str = "",
+    ):
+        super().__init__(msg)
+        self.status = status
+        self.retryable = retryable
+        self.uri = uri
+
+
+class BreakerOpenError(ClientError):
+    """Fast-fail: the peer's circuit breaker is open. Retryable so the
+    executor re-maps the shards to a replica, but no RPC was attempted."""
+
+    def __init__(self, method: str, uri: str, path: str):
+        super().__init__(
+            f"{method} {uri}{path}: circuit open (peer marked dead)",
+            status=None,
+            retryable=True,
+            uri=uri,
+        )
 
 
 class InternalClient:
@@ -35,12 +78,21 @@ class InternalClient:
         timeout: float = DEFAULT_TIMEOUT,
         tls_skip_verify: bool = False,
         tls_ca_cert: str = "",
+        retry_policy: Optional[faults.RetryPolicy] = None,
+        breakers: Optional[faults.BreakerRegistry] = None,
+        stats=None,
     ):
         """TLS options mirror the reference internode client
         (server/config.go:151-157 applied via http.GetHTTPClient): a
         pinned CA verifies self-hosted clusters; skip_verify turns off
         verification entirely for self-signed deployments."""
         self.timeout = timeout
+        self.retry_policy = retry_policy or faults.RetryPolicy()
+        self.breakers = breakers
+        self.stats = stats
+        # test-only: a FaultInjector consulted before every dial (a global
+        # one via faults.install_injector applies when this is None)
+        self.fault_injector: Optional[faults.FaultInjector] = None
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if tls_ca_cert:
             self._ssl_ctx = ssl.create_default_context(cafile=tls_ca_cert)
@@ -52,6 +104,41 @@ class InternalClient:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _breakers(self) -> Optional[faults.BreakerRegistry]:
+        return self.breakers or faults.global_breakers()
+
+    @staticmethod
+    def _is_timeout(e: Exception) -> bool:
+        if isinstance(e, TimeoutError):  # socket.timeout is an alias
+            return True
+        return isinstance(e, urllib.error.URLError) and isinstance(
+            e.reason, TimeoutError
+        )
+
+    def _classify(self, method: str, url: str, uri: str, e: Exception) -> ClientError:
+        """Map a raw attempt failure onto a classified ClientError."""
+        if isinstance(e, urllib.error.HTTPError):
+            detail = e.read().decode("utf-8", "replace")[:500]
+            err = ClientError(
+                f"{method} {url} -> {e.code}: {detail}",
+                status=e.code,
+                retryable=faults.retryable_status(e.code),
+                uri=uri,
+            )
+        elif isinstance(e, (ssl.SSLCertVerificationError, ssl.CertificateError)) or (
+            isinstance(e, urllib.error.URLError)
+            and isinstance(
+                e.reason, (ssl.SSLCertVerificationError, ssl.CertificateError)
+            )
+        ):
+            # a cert that fails verification will not heal on retry
+            err = ClientError(f"{method} {url}: {e}", retryable=False, uri=uri)
+        else:
+            # connection refused / reset / timeout / DNS: node-down shaped
+            err = ClientError(f"{method} {url}: {e}", retryable=True, uri=uri)
+        err.__cause__ = e
+        return err
+
     def _do(
         self,
         method: str,
@@ -62,32 +149,97 @@ class InternalClient:
         content_type: str = "application/json",
         timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
+        check_breaker: bool = True,
     ) -> bytes:
+        """One logical RPC: up to `retry_policy.max_attempts` attempts
+        within a `timeout` (default `self.timeout`) TOTAL budget, backoff
+        between attempts, per-peer breaker consulted before each dial
+        (`check_breaker=False` for liveness probes, which must reach even
+        a shunned peer so it can recover)."""
         url = uri.rstrip("/") + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
-        req = urllib.request.Request(url, data=body, method=method)
-        if body is not None:
-            req.add_header("Content-Type", content_type)
-        if headers:
-            for k, v in headers.items():
-                req.add_header(k, v)
         # propagate trace context to the peer (reference: http/client.go
         # wraps every request with tracing.InjectHTTPHeaders)
         span = tracing.current_span()
-        if span is not None and getattr(span, "trace_id", ""):
-            req.add_header(tracing.TRACE_HEADER, span.trace_id)
-            req.add_header(tracing.SPAN_HEADER, span.span_id)
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout, context=self._ssl_ctx
-            ) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode("utf-8", "replace")[:500]
-            raise ClientError(f"{method} {url} -> {e.code}: {detail}") from e
-        except Exception as e:
-            raise ClientError(f"{method} {url}: {e}") from e
+        policy = self.retry_policy
+        breakers = self._breakers()
+        injector = self.fault_injector or faults.global_injector()
+        budget = policy.budget(timeout if timeout is not None else self.timeout)
+        attempts = 0
+        while True:
+            attempts += 1
+            remaining = budget.remaining()
+            if check_breaker and breakers is not None and not breakers.allow(uri):
+                if self.stats is not None:
+                    self.stats.count("internode.breaker_fastfail", 1)
+                raise BreakerOpenError(method, uri, path)
+            req = urllib.request.Request(url, data=body, method=method)
+            if body is not None:
+                req.add_header("Content-Type", content_type)
+            if headers:
+                for k, v in headers.items():
+                    req.add_header(k, v)
+            if span is not None and getattr(span, "trace_id", ""):
+                req.add_header(tracing.TRACE_HEADER, span.trace_id)
+                req.add_header(tracing.SPAN_HEADER, span.span_id)
+            try:
+                if injector is not None:
+                    injector.before_request(method, uri, path, url)
+                with urllib.request.urlopen(
+                    req, timeout=max(remaining, 0.001), context=self._ssl_ctx
+                ) as resp:
+                    # chunked read with budget checks: the urlopen timeout
+                    # is per-socket-op, so a slow-DRIP peer (a byte every
+                    # few hundred ms) would otherwise stream a large body
+                    # arbitrarily past the total budget
+                    chunks = []
+                    while True:
+                        chunk = resp.read(1 << 16)
+                        if not chunk:
+                            break
+                        chunks.append(chunk)
+                        if budget.expired():
+                            raise TimeoutError(
+                                "deadline budget exhausted mid-response"
+                            )
+                    data = b"".join(chunks)
+                if breakers is not None:
+                    breakers.record(uri, True)
+                return data
+            except Exception as e:  # noqa: BLE001 - classified below
+                err = self._classify(method, url, uri, e)
+                timed_out = self._is_timeout(e)
+            # a 4xx proves the peer is alive and healthy; only node-down
+            # shaped failures count against its breaker — and a timeout
+            # under a starved allotment blames the caller's budget, not
+            # the peer (one deadline-pressed query must not shun healthy
+            # replicas for everyone else)
+            if breakers is not None:
+                if not err.retryable and err.status is not None:
+                    # an HTTP status (4xx) proves the peer alive+healthy;
+                    # other non-retryables (e.g. cert verification) prove
+                    # nothing about liveness and must not close a breaker
+                    breakers.record(uri, True)
+                elif err.retryable and not (
+                    timed_out and remaining < _TIMEOUT_PENALTY_FLOOR
+                ):
+                    breakers.record(uri, False)
+                else:
+                    # neutral: release a half-open probe slot this attempt
+                    # may hold, or the unrecorded probe pins allow() false
+                    breakers.record_neutral(uri)
+            if not err.retryable or attempts >= policy.max_attempts:
+                raise err
+            delay = policy.backoff(attempts)
+            if budget.remaining() <= delay:
+                raise err  # no budget left for another attempt
+            if self.stats is not None:
+                self.stats.count("internode.retry", 1)
+            if span is not None:
+                span.set_tag("rpc.retries", attempts)
+                span.set_tag("rpc.retry.peer", uri)
+            policy.sleep(delay)
 
     def _json(self, *args, **kw) -> Any:
         data = self._do(*args, **kw)
@@ -102,7 +254,10 @@ class InternalClient:
         query: str,
         shards: Optional[Sequence[int]] = None,
         remote: bool = False,
+        timeout: Optional[float] = None,
     ) -> List[Any]:
+        """`timeout` (total budget) lets the distributed executor bound
+        each fan-out RPC by the query deadline's remaining time."""
         body = {"query": query, "remote": remote}
         if shards is not None:
             body["shards"] = list(shards)
@@ -111,9 +266,12 @@ class InternalClient:
             uri,
             f"/internal/index/{index}/query",
             json.dumps(body).encode(),
+            timeout=timeout,
         )
         if resp.get("error"):
-            raise ClientError(resp["error"])
+            # remote payload error: the peer is alive and executed the
+            # request — failover to a replica cannot fix a bad query
+            raise ClientError(resp["error"], retryable=False, uri=uri)
         return [wire.decode_result(r) for r in resp["results"]]
 
     # -- schema ------------------------------------------------------------
@@ -126,8 +284,15 @@ class InternalClient:
         channel for DDL a node missed while DOWN)."""
         self._json("POST", uri, "/schema", json.dumps({"indexes": schema}).encode())
 
-    def status(self, uri: str, timeout: Optional[float] = None) -> dict:
-        return self._json("GET", uri, "/status", timeout=timeout)
+    def status(
+        self, uri: str, timeout: Optional[float] = None, probe: bool = False
+    ) -> dict:
+        """`probe=True` bypasses the peer's circuit breaker: liveness
+        probes are how an open breaker learns the node recovered (a
+        successful probe closes it via the success recording in _do)."""
+        return self._json(
+            "GET", uri, "/status", timeout=timeout, check_breaker=not probe
+        )
 
     # -- attr anti-entropy (holder.go:975-1019 syncIndex attr diffs) -------
 
@@ -144,6 +309,16 @@ class InternalClient:
         return self._json(
             "GET", uri, f"/internal/index/{index}/attrs/block/{block_id}{q}"
         )["attrs"]
+
+    def trigger_sync(self, uri: str, timeout: float = 300.0) -> dict:
+        """Ask a peer to run one anti-entropy pass now (POST
+        /internal/sync). Returns {"synced": n, "ran": bool, "reached":
+        [[index, shard, node_id], ...]} — `reached` lists the replica
+        reconciliations the pass actually confirmed, which is what the
+        debt-nudge path keys its ledger resolution on. Generous default
+        timeout: a full pass on a large holder is slow (the lifecycle
+        tests use 300s for this same endpoint)."""
+        return self._json("POST", uri, "/internal/sync", timeout=timeout) or {}
 
     # -- cluster messages (http/client.go:1017 SendMessage) ----------------
 
@@ -363,7 +538,7 @@ class InternalClient:
             "POST", uri, "/internal/translate/keys", json.dumps(body).encode()
         )
         if resp.get("error"):
-            raise ClientError(resp["error"])
+            raise ClientError(resp["error"], retryable=False, uri=uri)
         return [int(i) for i in resp["ids"]]
 
     def translate_entries(

@@ -416,8 +416,22 @@ class Handler(BaseHTTPRequestHandler):
     @route("POST", "/internal/sync")
     def post_internal_sync(self):
         """Trigger one anti-entropy pass now (operational hook; the loop
-        runs on anti-entropy.interval — server.go:514 monitorAntiEntropy)."""
-        self._reply({"synced": self.node.sync_holder()})
+        runs on anti-entropy.interval — server.go:514 monitorAntiEntropy).
+        `ran` is false when a pass was already in flight (single-flight);
+        `reached` lists the (index, shard, node) reconciliations the pass
+        confirmed — the debt-nudge caller resolves exactly those."""
+        res = self.node.try_sync_holder()
+        if res is None:
+            self._reply({"synced": 0, "ran": False})
+            return
+        synced, reached = res
+        self._reply(
+            {
+                "synced": synced,
+                "ran": True,
+                "reached": [[i, s, d] for i, s, d in sorted(reached)],
+            }
+        )
 
     @route("POST", "/internal/resize")
     def post_internal_resize(self):

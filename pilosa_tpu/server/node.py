@@ -29,6 +29,7 @@ from pilosa_tpu.cluster.topology import (
 from pilosa_tpu.cluster import antientropy
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.exec.distributed import DistributedExecutor
+from pilosa_tpu.server import faults
 from pilosa_tpu.server.client import ClientError, InternalClient
 
 
@@ -58,6 +59,11 @@ class NodeServer:
         tls_key: str = "",
         tls_skip_verify: bool = False,  # internode client: trust any cert
         tls_ca_cert: str = "",  # internode client: pin this CA instead
+        retry_max_attempts: int = 3,  # internode RPC attempts per budget
+        retry_base_backoff: float = 0.05,  # first-retry backoff, seconds
+        breaker_threshold: int = 5,  # consecutive failures before open
+        breaker_cooldown: float = 2.0,  # seconds open before half-open
+        query_deadline: float = 30.0,  # distributed fan-out wall bound
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -83,11 +89,37 @@ class NodeServer:
         self.tls_key = tls_key
         if bool(tls_cert) != bool(tls_key):
             raise ValueError("tls_cert and tls_key must be set together")
+        from pilosa_tpu.utils import stats as statsmod
+
+        self.stats = statsmod.new_stats_client(stats_service, host=stats_host)
+        self.logger = logger or (lambda msg: None)
+        # fault-tolerance plane (server/faults.py): one retry policy and
+        # one per-peer breaker registry shared by EVERY internode path —
+        # queries, probes, broadcasts, anti-entropy, and resize all ride
+        # the same policy instead of ad-hoc timeouts
+        self.retry_policy = faults.RetryPolicy(
+            max_attempts=retry_max_attempts, base_backoff=retry_base_backoff
+        )
+        self.breakers = faults.BreakerRegistry(
+            threshold=breaker_threshold,
+            cooldown=breaker_cooldown,
+            stats=self.stats,
+            logger=self.logger,
+        )
         self.client = InternalClient(
-            tls_skip_verify=tls_skip_verify, tls_ca_cert=tls_ca_cert
+            tls_skip_verify=tls_skip_verify,
+            tls_ca_cert=tls_ca_cert,
+            retry_policy=self.retry_policy,
+            breakers=self.breakers,
+            stats=self.stats,
         )
         self.executor = DistributedExecutor(
-            self.holder, lambda: self.cluster, self.client, node_id
+            self.holder,
+            lambda: self.cluster,
+            self.client,
+            node_id,
+            stats=self.stats,
+            query_deadline=query_deadline,
         )
         # cross-request group-commit Count batching (exec/batcher.py)
         from pilosa_tpu.exec.batcher import CountBatcher
@@ -103,12 +135,9 @@ class NodeServer:
         self.topology_restored = False
         self.long_query_time = long_query_time
         self.metric_poll_interval = metric_poll_interval
-        from pilosa_tpu.utils import stats as statsmod
         from pilosa_tpu.utils import tracing as tracingmod
 
-        self.stats = statsmod.new_stats_client(stats_service, host=stats_host)
         self.tracer = tracingmod.global_tracer()
-        self.logger = logger or (lambda msg: None)
         self._httpd = None
         self._http_thread = None
         self._ae_thread = None
@@ -124,6 +153,14 @@ class NodeServer:
         # since their last pass (fresh drift repairs first under load)
         self._ae_versions: Dict[tuple, int] = {}
         self._resize_mu = threading.Lock()
+        # single-flight anti-entropy: the AE ticker, the operator's POST
+        # /internal/sync, and a peer's debt nudge must not stack passes —
+        # and single-flight breaks the A-nudges-B-nudges-A recursion
+        self._sync_once = threading.Lock()
+        # single-flight for the nudge itself: it runs OUTSIDE _sync_once
+        # (a slow primary must not stall our own next pass), so it needs
+        # its own guard against mutual-debt nudge recursion
+        self._nudge_once = threading.Lock()
         # serializes cluster-status emission: the probe ticker's stale
         # NORMAL must never land after a resize's RESIZING freeze
         self._status_mu = threading.Lock()
@@ -399,6 +436,14 @@ class NodeServer:
             self.node = mine
         self.wire_translation()
         self._save_topology()
+        # a departed node's drift debt is moot (it owns nothing anymore);
+        # without this prune its ledger entries could never resolve —
+        # `reached` sets are built from CURRENT owners — and would pin
+        # /status pendingRepairs nonzero forever
+        member_ids = {n.id for n in self.cluster.nodes}
+        for iname, shard, debtor in self.holder.pending_repairs():
+            if debtor not in member_ids:
+                self.holder.discard_pending_repair(iname, shard, debtor)
 
     def wire_translation(self) -> None:
         """Install single-writer key translation: the coordinator's stores
@@ -482,7 +527,9 @@ class NodeServer:
             if n.id == self.node.id:
                 return True
             try:
-                self.client.status(n.uri, timeout=timeout)
+                # probe=True bypasses the breaker: probes are how an open
+                # breaker learns a peer recovered (success closes it)
+                self.client.status(n.uri, timeout=timeout, probe=True)
                 return True
             except ClientError:
                 return False
@@ -586,7 +633,9 @@ class NodeServer:
     def _anti_entropy_loop(self) -> None:
         while not self._closing.wait(self.anti_entropy_interval):
             try:
-                self.sync_holder()
+                # non-waiting variant: the tick must not stall behind
+                # remote passes triggered by the debt nudge
+                self.try_sync_holder()
             except Exception as e:
                 self.logger(f"anti-entropy: {e}")
 
@@ -594,15 +643,52 @@ class NodeServer:
         """One full anti-entropy pass: for every local fragment whose shard
         this node PRIMARY-owns, reconcile all replicas via block checksums
         + majority-vote merge (fragment.go:2861 syncFragment). Returns the
-        number of fragments that needed repair.
+        number of fragments that needed repair. Single-flight: a pass
+        requested while one runs returns 0 immediately.
 
         Fragment syncs run on a thread pool (one slow replica no longer
         serializes the whole walk — the reference runs one goroutine per
         mapper the same way, executor.go:2522)."""
+        res = self.try_sync_holder(wait_nudge=True)
+        return 0 if res is None else res[0]
+
+    def try_sync_holder(self, wait_nudge: bool = False):
+        """One pass, or None when another pass is already running —
+        callers like the debt nudge must be able to tell "a pass ran"
+        from "nothing happened". Returns (repaired_count, reached) where
+        `reached` is the set of confirmed (index, shard, node_id)
+        reconciliations — returned (not stored on the instance) so a
+        concurrently starting pass cannot clobber it before the
+        /internal/sync handler builds its reply. The debt nudge runs on a
+        background thread: the handler must reply as soon as the LOCAL
+        pass is done, or mutual-debt clusters would chain blocking passes
+        (A waits on B's pass which waits on C's…) with a 300s timeout per
+        hop. `wait_nudge` restores the blocking behavior for the
+        operator/test-facing sync_holder()."""
+        if not self._sync_once.acquire(blocking=False):
+            return None
+        try:
+            n = self._sync_holder_pass()
+        finally:
+            self._sync_once.release()
+        if self.holder.pending_repair_count() == 0:
+            return n  # nothing to nudge; skip the thread spawn
+        t = threading.Thread(
+            target=self._nudge_debt_primaries,
+            name=f"nudge-{self.node.id}",
+            daemon=True,
+        )
+        t.start()
+        if wait_nudge:
+            t.join()
+        return n
+
+    def _sync_holder_pass(self):
+        """Returns (repaired_count, confirmed_reached_triples)."""
         from concurrent.futures import ThreadPoolExecutor
 
         if len(self.cluster.nodes) <= 1:
-            return 0
+            return 0, set()
         # merge peers' availability first: a node restarted after missing
         # shard announcements must re-learn which shards exist cluster-wide
         # (the reference's gossip NodeStatus state merge, gossip.go:295-362).
@@ -634,26 +720,102 @@ class NodeServer:
         # even at replica_n=1 (reference: holder.go:975-1019 syncIndex)
         self._sync_attrs(peers)
         if self.cluster.replica_n <= 1:
-            return 0
+            return 0, set()
         sync_tasks = self._ae_tasks()
         if not sync_tasks:
-            return 0
+            return 0, set()
 
-        def run_sync(t) -> bool:
+        def run_sync(t):
             idx, f, vname, shard, replicas = t
+            attempted = [n.id for n in replicas]
             try:
-                repaired = self._sync_fragment(idx, f, vname, shard, replicas)
+                repaired, reached = self._sync_fragment(
+                    idx, f, vname, shard, replicas
+                )
             except Exception as e:  # noqa: BLE001 - one bad fragment must
                 # not abort the rest of the pass
                 self.logger(f"anti-entropy {idx.name}/{f.name}/{shard}: {e}")
-                return False
+                return False, (idx.name, shard, attempted, [])
             frag = f.views[vname].fragment_if_exists(shard)
             if frag is not None:
                 self._ae_versions[(idx.name, f.name, vname, shard)] = frag.version
-            return repaired
+            return repaired, (idx.name, shard, attempted, reached)
 
         with ThreadPoolExecutor(max_workers=min(8, len(sync_tasks))) as pool:
-            return sum(pool.map(run_sync, sync_tasks))
+            results = list(pool.map(run_sync, sync_tasks))
+        # a (index, shard, replica) reconciliation is confirmed only when
+        # EVERY fragment task of that shard (each field/view is its own
+        # sync) reached the replica — one failed fragment means the
+        # shard's debt is NOT repaid. Clearing on partial success would
+        # recreate the silent drift the ledger exists to prevent.
+        confirmed: Dict[tuple, bool] = {}
+        for _, (iname, shard, attempted, reached) in results:
+            for nid in attempted:
+                key = (iname, shard, nid)
+                confirmed[key] = confirmed.get(key, True) and nid in reached
+        reached_triples = {k for k, ok in confirmed.items() if ok}
+        # when EVERY fragment of a shard reached EVERY attempted replica,
+        # this node's own copy merged everything live — report the shard
+        # reconciled for THIS node too, so a peer whose debtor is the
+        # PRIMARY (we never appear in our own replica lists) can resolve
+        # its ledger entry instead of carrying it forever. (If the only
+        # holder of a dropped write is DOWN, its return triggers a later
+        # pass; the ledger tracks repair debt, not unreachable history.)
+        shard_all_ok: Dict[tuple, bool] = {}
+        for _, (iname, shard, attempted, reached) in results:
+            ok = all(nid in reached for nid in attempted)
+            shard_all_ok[(iname, shard)] = (
+                shard_all_ok.get((iname, shard), True) and ok
+            )
+        for (iname, shard), ok in shard_all_ok.items():
+            if ok:
+                reached_triples.add((iname, shard, self.node.id))
+        for iname, shard, nid in reached_triples:
+            self.holder.discard_pending_repair(iname, shard, nid)
+        # /internal/sync replies with this set, so a nudging peer resolves
+        # exactly these confirmed repairs
+        return sum(r for r, _ in results), reached_triples
+
+    def _nudge_debt_primaries(self) -> None:
+        """Pending-repair debt on shards this node does NOT own cannot be
+        repaired locally (we hold no copy): ask each such shard's primary
+        to run an anti-entropy pass now — the coordinator's drop ledger
+        must drain even when the repair work happens elsewhere. An entry
+        is resolved ONLY when the primary's reply lists that exact
+        (index, shard, debtor) reconciliation in `reached`; a pass that
+        ran but could not reach the debtor keeps the debt visible.
+        Single-flight (and skipped while another nudge runs) so
+        mutual-debt clusters cannot recurse A-nudges-B-nudges-A."""
+        if not self._nudge_once.acquire(blocking=False):
+            return
+        try:
+            foreign: Dict[str, set] = {}
+            for iname, shard, debtor in self.holder.pending_repairs():
+                owners = self.cluster.shard_nodes(iname, shard)
+                if not owners or any(n.id == self.node.id for n in owners):
+                    continue  # our own debt-driven sync task covers it
+                if owners[0].state != "DOWN":
+                    foreign.setdefault(owners[0].id, set()).add(
+                        (iname, shard, debtor)
+                    )
+            for nid, entries in foreign.items():
+                n = self.cluster.node_by_id(nid)
+                if n is None:
+                    continue
+                try:
+                    resp = self.client.trigger_sync(n.uri)
+                except ClientError as e:
+                    self.logger(f"debt sync nudge to {nid}: {e}")
+                    continue
+                if not resp.get("ran"):
+                    continue  # the primary was mid-pass; retry next AE tick
+                reached = {
+                    (i, int(s), d) for i, s, d in resp.get("reached", [])
+                }
+                for entry in entries & reached:
+                    self.holder.discard_pending_repair(*entry)
+        finally:
+            self._nudge_once.release()
 
     def _ae_tasks(self) -> list:
         """Fragment sync work list for one AE pass, locally-mutated-since-
@@ -674,6 +836,38 @@ class NodeServer:
                         if not owners or owners[0].id != self.node.id:
                             continue  # only the primary drives the sync
                         replicas = [n for n in owners[1:] if n.state != "DOWN"]
+                        if not replicas:
+                            continue
+                        sync_tasks.append((idx, f, vname, shard, replicas))
+
+        # debt-driven tasks: a shard with a pending-repair entry gets
+        # reconciled NOW even when this node is only a replica — the
+        # primary may be the very node that missed the write, and the
+        # coordinator that observed the drop is the one holding the debt
+        pending: Dict[str, set] = {}
+        for iname, shard, _nid in self.holder.pending_repairs():
+            pending.setdefault(iname, set()).add(shard)
+        seen = {
+            (idx.name, f.name, vname, shard)
+            for idx, f, vname, shard, _ in sync_tasks
+        }
+        for idx in self.holder.indexes():
+            debt_shards = pending.get(idx.name)
+            if not debt_shards:
+                continue
+            for f in idx.fields(include_hidden=True):
+                for vname, v in list(f.views.items()):
+                    for shard in sorted(set(v.fragments) & debt_shards):
+                        if (idx.name, f.name, vname, shard) in seen:
+                            continue
+                        owners = self.cluster.shard_nodes(idx.name, shard)
+                        if not any(n.id == self.node.id for n in owners):
+                            continue  # not our copy; the primary nudge covers it
+                        replicas = [
+                            n
+                            for n in owners
+                            if n.id != self.node.id and n.state != "DOWN"
+                        ]
                         if not replicas:
                             continue
                         sync_tasks.append((idx, f, vname, shard, replicas))
@@ -761,7 +955,10 @@ class NodeServer:
                         # refresh only the merged block's checksum
                         local[bid] = store.block_checksum(bid)
 
-    def _sync_fragment(self, idx, f, view: str, shard: int, replicas) -> bool:
+    def _sync_fragment(self, idx, f, view: str, shard: int, replicas):
+        """Returns (repaired, reached_node_ids): reached lists the
+        replicas that actually participated in the reconciliation, so the
+        pending-repair ledger only resolves confirmed repairs."""
         # materialize the local fragment if only replicas hold it
         frag = f.views[view].fragment(shard)
         local_sums = frag.block_checksums()
@@ -781,12 +978,13 @@ class NodeServer:
             except ClientError:
                 continue
         if not live:
-            return False
+            return False, []
+        reached = [n.id for n in live]
         diff: set = set()
         for ps in peer_sums:
             diff.update(antientropy.diff_blocks(local_sums, ps))
         if not diff:
-            return False
+            return False, reached
         for bid in sorted(diff):
             blocks = [frag.block_pairs(bid)]
             for n in live:
@@ -800,7 +998,7 @@ class NodeServer:
                     self.client.send_block_deltas(
                         n.uri, idx.name, f.name, view, shard, sets[i], clears[i]
                     )
-        return True
+        return True, reached
 
     # -- resize (checkpoint-based resharding; cluster.go:1447 analog) ------
 
@@ -1102,7 +1300,11 @@ class NodeServer:
                     )
                 except ClientError as e:
                     last = e
-                time.sleep(0.1 * (attempt + 1))
+                if attempt + 1 < max(retries, 1):
+                    # shared policy's jittered backoff instead of the old
+                    # ad-hoc 0.1*(attempt+1) ladder; no sleep after the
+                    # final attempt — _status_mu is held here
+                    time.sleep(self.retry_policy.backoff(attempt + 1))
             if not ok:
                 failed.append(n.id)
                 self.logger(

@@ -28,12 +28,17 @@ class ClusterHarness:
         in_memory: bool = False,
         probe_interval: float = 0.0,
         tls: Optional[Tuple[str, str]] = None,
+        **node_kwargs,
     ):
+        """Extra **node_kwargs pass through to every NodeServer — chaos
+        tests use this to tighten retry/breaker/deadline knobs
+        (retry_max_attempts, breaker_threshold, query_deadline, ...)."""
         self._own_dir = base_dir is None and not in_memory
         self.base_dir = (
             None if in_memory else (base_dir or tempfile.mkdtemp(prefix="ptc-"))
         )
         self.tls = tls
+        self.node_kwargs = node_kwargs
         self.nodes: List[NodeServer] = []
         for i in range(n):
             data_dir = None if in_memory else f"{self.base_dir}/node{i}"
@@ -44,6 +49,7 @@ class ClusterHarness:
                 hasher=hasher,
                 probe_interval=probe_interval,
                 **self._tls_kwargs(),
+                **node_kwargs,
             )
             srv.start()
             self.nodes.append(srv)
@@ -93,6 +99,7 @@ class ClusterHarness:
             hasher=old.cluster.hasher,
             probe_interval=old.probe_interval,
             **self._tls_kwargs(),
+            **self.node_kwargs,
         )
         srv.start()
         self.nodes[i] = srv

@@ -23,6 +23,29 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture(autouse=True)
+def _fault_plane_leak_guard():
+    """State-leak guard: a test that installs a process-global
+    FaultInjector or BreakerRegistry (faults.install_injector /
+    install_breakers) and forgets to uninstall it would silently poison
+    every later test's internode traffic — fail loudly instead."""
+    yield
+    from pilosa_tpu.server import faults
+
+    leaked = []
+    if faults.global_injector() is not None:
+        faults.uninstall_injector()
+        leaked.append("FaultInjector")
+    if faults.global_breakers() is not None:
+        faults.uninstall_breakers()
+        leaked.append("BreakerRegistry")
+    if leaked:
+        pytest.fail(
+            f"test left a global {' and '.join(leaked)} installed "
+            "(faults.uninstall_injector()/uninstall_breakers() missing)"
+        )
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process E2E tests (boot real server processes)"

@@ -1,0 +1,518 @@
+"""Fault-injection harness + retry/backoff/circuit-breaker tests.
+
+Unit layer: RetryPolicy backoff/deadline math and the CircuitBreaker
+state machine run against injected clocks — no real sleeps. Client
+layer: an InternalClient with a seeded FaultInjector against one real
+NodeServer. Chaos layer: a 3-node ClusterHarness where the injector
+partitions or degrades one peer and distributed results must still
+match a single-node run, within the configured query deadline
+(reference analog: the clustertests pumba pause scenarios, made
+deterministic)."""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.exec.executor import ExecError
+from pilosa_tpu.server import faults
+from pilosa_tpu.server.client import (
+    BreakerOpenError,
+    ClientError,
+    InternalClient,
+)
+from pilosa_tpu.server.node import NodeServer
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import ClusterHarness
+from pilosa_tpu.utils.stats import StatsClient
+
+
+class FakeClock:
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, d: float) -> None:
+        self.now += d
+
+
+def http_json(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else {}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+# fast-failover knobs for the chaos harnesses: tight backoff, breaker
+# opens after 2 consecutive failures, 5s overall query deadline
+FAST = dict(
+    retry_max_attempts=2,
+    retry_base_backoff=0.01,
+    breaker_threshold=2,
+    breaker_cooldown=60.0,
+    query_deadline=5.0,
+)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy (unit; no sleeps)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_backoff_growth_and_cap(self):
+        p = faults.RetryPolicy(
+            base_backoff=0.05, multiplier=2.0, max_backoff=0.3, jitter=0.0
+        )
+        assert [p.backoff(a) for a in (1, 2, 3, 4, 5)] == [
+            0.05, 0.1, 0.2, 0.3, 0.3,
+        ]
+
+    def test_jitter_is_seeded_and_bounded(self):
+        mk = lambda: faults.RetryPolicy(
+            base_backoff=0.1, multiplier=2.0, max_backoff=10.0,
+            jitter=0.5, seed=7,
+        )
+        a = [mk().backoff(i) for i in (1, 2, 3)]
+        b = [mk().backoff(i) for i in (1, 2, 3)]
+        assert a == b, "same seed must replay the same jitter"
+        for attempt, v in zip((1, 2, 3), a):
+            full = 0.1 * 2 ** (attempt - 1)
+            assert 0.5 * full <= v <= full
+
+    def test_deadline_budget_shrinks_and_expires(self):
+        clk = FakeClock()
+        p = faults.RetryPolicy(clock=clk)
+        budget = p.budget(1.0)
+        assert budget.remaining() == pytest.approx(1.0)
+        clk.advance(0.6)
+        assert budget.remaining() == pytest.approx(0.4)
+        assert not budget.expired()
+        clk.advance(0.5)
+        assert budget.expired()
+        assert budget.remaining() == 0.0
+
+    def test_error_classification(self):
+        assert faults.retryable_status(500)
+        assert faults.retryable_status(503)
+        assert faults.retryable_status(429)
+        assert not faults.retryable_status(400)
+        assert not faults.retryable_status(404)
+        assert not faults.retryable_status(409)
+
+    def test_max_attempts_validated(self):
+        with pytest.raises(ValueError):
+            faults.RetryPolicy(max_attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker (unit; injected clock)
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        clk = FakeClock()
+        br = faults.CircuitBreaker(threshold=3, cooldown=5.0, clock=clk)
+        assert br.state == faults.CLOSED
+        br.record_failure()
+        br.record_failure()
+        assert br.state == faults.CLOSED and br.allow()
+        br.record_failure()
+        assert br.state == faults.OPEN
+        assert not br.allow()
+
+    def test_success_resets_the_failure_streak(self):
+        br = faults.CircuitBreaker(threshold=2, clock=FakeClock())
+        br.record_failure()
+        br.record_success()  # streak broken: not consecutive
+        br.record_failure()
+        assert br.state == faults.CLOSED
+
+    def test_half_open_allows_exactly_one_probe(self):
+        clk = FakeClock()
+        br = faults.CircuitBreaker(threshold=1, cooldown=2.0, clock=clk)
+        br.record_failure()
+        assert not br.allow()
+        clk.advance(2.5)  # cooldown elapsed
+        assert br.state == faults.HALF_OPEN
+        assert br.allow(), "first caller gets the probe"
+        assert not br.allow(), "second caller must wait for the probe"
+        br.record_success()
+        assert br.state == faults.CLOSED
+        assert br.allow() and br.allow()
+
+    def test_failed_probe_reopens_and_restarts_cooldown(self):
+        clk = FakeClock()
+        br = faults.CircuitBreaker(threshold=1, cooldown=2.0, clock=clk)
+        br.record_failure()
+        clk.advance(2.5)
+        assert br.allow()
+        br.record_failure()  # probe failed
+        assert br.state == faults.OPEN
+        assert not br.allow()
+        clk.advance(1.0)  # cooldown restarted: 1.0 < 2.0
+        assert br.state == faults.OPEN and not br.allow()
+        clk.advance(1.5)
+        assert br.state == faults.HALF_OPEN and br.allow()
+
+    def test_neutral_outcome_releases_probe_slot_without_transition(self):
+        """A caller-starved timeout must not consume the half-open probe
+        forever: record_neutral frees the slot, state stays half-open."""
+        clk = FakeClock()
+        br = faults.CircuitBreaker(threshold=1, cooldown=2.0, clock=clk)
+        br.record_failure()
+        clk.advance(2.5)
+        assert br.allow()  # probe slot taken
+        br.record_neutral()  # ambiguous outcome: release, don't judge
+        assert br.state == faults.HALF_OPEN
+        assert br.allow(), "slot must be available again"
+        br.record_success()
+        assert br.state == faults.CLOSED
+
+    def test_registry_states_and_transition_stats(self):
+        clk = FakeClock()
+        stats = StatsClient()
+        reg = faults.BreakerRegistry(
+            threshold=1, cooldown=2.0, clock=clk, stats=stats
+        )
+        uri = "http://peer-a:1"
+        assert reg.state(uri) == faults.CLOSED
+        assert reg.snapshot() == {}
+        reg.record(uri, False)
+        assert reg.state(uri) == faults.OPEN
+        assert not reg.allow(uri)
+        clk.advance(2.5)
+        assert reg.allow(uri)
+        reg.record(uri, True)
+        assert reg.state(uri) == faults.CLOSED
+        snap = stats.registry.snapshot()
+        assert snap.get("breaker.open") == 1
+        assert snap.get("breaker.half_open") == 1
+        assert snap.get("breaker.closed") == 1
+        assert reg.snapshot() == {"http://peer-a:1": faults.CLOSED}
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector (unit)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_counted_rule_fires_exactly_n_times(self):
+        inj = faults.FaultInjector(seed=1)
+        inj.add_rule("http500", uri="http://p:1", times=2)
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError):
+                inj.before_request("GET", "http://p:1", "/status", "http://p:1/status")
+        # exhausted: no more injections, other peers never affected
+        inj.before_request("GET", "http://p:1", "/status", "http://p:1/status")
+        inj.before_request("GET", "http://q:2", "/status", "http://q:2/status")
+        assert inj.count("http500") == 2 and inj.count() == 2
+
+    def test_partition_and_heal(self):
+        inj = faults.FaultInjector()
+        inj.partition("http://p:1/")
+        with pytest.raises(urllib.error.URLError):
+            inj.before_request("POST", "http://p:1", "/x", "http://p:1/x")
+        inj.heal("http://p:1")
+        inj.before_request("POST", "http://p:1", "/x", "http://p:1/x")
+        assert inj.count("partition") == 1
+
+    def test_probabilistic_rule_replays_with_seed(self):
+        def run(seed):
+            inj = faults.FaultInjector(seed=seed)
+            inj.add_rule("timeout", prob=0.5)
+            fired = []
+            for i in range(20):
+                try:
+                    inj.before_request("GET", "http://p:1", "/s", "u")
+                    fired.append(False)
+                except faults.InjectedTimeout:
+                    fired.append(True)
+            return fired
+
+        assert run(11) == run(11)
+        assert run(11) != run(12)
+        assert any(run(11)) and not all(run(11))
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            faults.FaultInjector().add_rule("meteor")
+
+
+# ---------------------------------------------------------------------------
+# InternalClient integration (one real node)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def solo_node():
+    srv = NodeServer(None, "faults-solo")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class TestClientRetries:
+    def test_retries_through_injected_500s(self, solo_node):
+        stats = StatsClient()
+        client = InternalClient(
+            retry_policy=faults.RetryPolicy(
+                max_attempts=3, base_backoff=0.001, jitter=0.0
+            ),
+            stats=stats,
+        )
+        inj = faults.FaultInjector(seed=5)
+        inj.add_rule("http500", times=2)
+        client.fault_injector = inj
+        st = client.status(solo_node.node.uri)
+        assert st["state"] == "NORMAL"
+        assert inj.count("http500") == 2
+        assert stats.registry.snapshot().get("internode.retry") == 2
+
+    def test_4xx_is_not_retried_and_classified(self, solo_node):
+        stats = StatsClient()
+        client = InternalClient(stats=stats)
+        with pytest.raises(ClientError) as ei:
+            client._do("GET", solo_node.node.uri, "/no-such-endpoint")
+        assert ei.value.status == 404
+        assert ei.value.retryable is False
+        assert ei.value.uri == solo_node.node.uri
+        assert "internode.retry" not in stats.registry.snapshot()
+
+    def test_deadline_budget_bounds_total_time(self):
+        # every attempt times out instantly (injected), so only the
+        # backoff sleeps consume wall time — the budget cuts them short
+        client = InternalClient(
+            retry_policy=faults.RetryPolicy(
+                max_attempts=50, base_backoff=0.01, jitter=0.0
+            ),
+        )
+        inj = faults.FaultInjector()
+        inj.add_rule("timeout")
+        client.fault_injector = inj
+        t0 = time.monotonic()
+        with pytest.raises(ClientError) as ei:
+            client._do("GET", "http://localhost:9", "/status", timeout=0.2)
+        assert time.monotonic() - t0 < 1.0
+        assert ei.value.retryable is True
+
+    def test_breaker_open_fails_in_microseconds(self):
+        breakers = faults.BreakerRegistry(threshold=1, cooldown=60.0)
+        client = InternalClient(
+            retry_policy=faults.RetryPolicy(max_attempts=1),
+            breakers=breakers,
+        )
+        dead = f"http://localhost:{_free_port()}"
+        with pytest.raises(ClientError):
+            client.status(dead, timeout=2.0)
+        assert breakers.state(dead) == faults.OPEN
+        t0 = time.monotonic()
+        with pytest.raises(BreakerOpenError) as ei:
+            client.status(dead, timeout=2.0)
+        assert time.monotonic() - t0 < 0.05, "open breaker must fast-fail"
+        assert ei.value.retryable is True, "failover may re-map elsewhere"
+
+    def test_probe_bypasses_open_breaker_and_recovery_closes_it(self, solo_node):
+        breakers = faults.BreakerRegistry(threshold=1, cooldown=60.0)
+        client = InternalClient(
+            retry_policy=faults.RetryPolicy(max_attempts=1),
+            breakers=breakers,
+        )
+        uri = solo_node.node.uri
+        inj = faults.FaultInjector()
+        inj.partition(uri)
+        client.fault_injector = inj
+        with pytest.raises(ClientError):
+            client.status(uri)
+        assert breakers.state(uri) == faults.OPEN
+        inj.heal(uri)
+        # a normal request still fast-fails (cooldown far away) ...
+        with pytest.raises(BreakerOpenError):
+            client.status(uri)
+        # ... but a liveness probe gets through and closes the breaker
+        assert client.status(uri, probe=True)["state"] == "NORMAL"
+        assert breakers.state(uri) == faults.CLOSED
+
+    def test_global_injector_install(self, solo_node):
+        client = InternalClient(
+            retry_policy=faults.RetryPolicy(max_attempts=1)
+        )
+        inj = faults.FaultInjector()
+        inj.partition(solo_node.node.uri)
+        faults.install_injector(inj)
+        try:
+            with pytest.raises(ClientError):
+                client.status(solo_node.node.uri)
+        finally:
+            faults.uninstall_injector()
+        assert client.status(solo_node.node.uri)["state"] == "NORMAL"
+
+
+# ---------------------------------------------------------------------------
+# chaos: 3-node cluster with a seeded injector (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def _seed_data(api, index="ft", field="f", n_shards=12):
+    api.create_index(index)
+    api.create_field(index, field, {"type": "set"})
+    rows, cols = [], []
+    for s in range(n_shards):
+        for r in range(3):
+            rows.append(r)
+            cols.append(s * SHARD_WIDTH + 7 * r + s)
+    api.import_bits(index, field, rows, cols)
+    return rows, cols
+
+
+def test_partitioned_peer_query_completes_within_deadline():
+    """THE acceptance scenario: one of three nodes partitioned via
+    FaultInjector -> a distributed query completes within the configured
+    deadline (no 30s stall), returns correct results, and the dead
+    peer's breaker is open."""
+    with ClusterHarness(3, replica_n=2, in_memory=True, **FAST) as c:
+        api = c[0].api
+        _seed_data(api)
+        (expect,) = api.query("ft", "Count(Row(f=0))")
+        assert expect == 12
+        inj = faults.FaultInjector(seed=42)
+        inj.partition(c[2].node.uri)
+        c[0].client.fault_injector = inj
+        t0 = time.monotonic()
+        (got,) = api.query("ft", "Count(Row(f=0))")
+        dt = time.monotonic() - t0
+        assert got == expect, "failover re-map must preserve the result"
+        assert dt < FAST["query_deadline"], f"query took {dt:.2f}s"
+        assert c[0].breakers.state(c[2].node.uri) == faults.OPEN
+        assert inj.count("partition") >= 1
+
+
+def test_flaky_peer_count_and_topn_match_single_node():
+    """Seeded chaos: one peer throws 500s, another is slow; distributed
+    Count/TopN must equal a single-node run over the same data."""
+    solo = NodeServer(None, "faults-ref")
+    solo.start()
+    try:
+        with ClusterHarness(3, replica_n=2, in_memory=True, **FAST) as c:
+            _seed_data(solo.api)
+            _seed_data(c[0].api)
+            inj = faults.FaultInjector(seed=7)
+            inj.add_rule("http500", uri=c[1].node.uri, times=3)
+            inj.add_rule("slow", uri=c[2].node.uri, delay=0.02, times=2)
+            c[0].client.fault_injector = inj
+            for q in (
+                "Count(Row(f=0))",
+                "Count(Union(Row(f=1), Row(f=2)))",
+                "TopN(f, n=3)",
+            ):
+                assert c[0].api.query("ft", q) == solo.api.query("ft", q), q
+            # at least the breaker-threshold's worth of 500s was actually
+            # injected (the breaker may fast-fail before all 3 fire)
+            assert inj.count("http500") >= 2
+    finally:
+        solo.stop()
+
+
+def test_write_replica_drop_is_visible():
+    """Satellite #2: a write that misses a replica must surface as
+    pending-repair debt (/status pendingRepairs + write_replica_dropped
+    stat), not silent drift — and anti-entropy resolves it."""
+    with ClusterHarness(3, replica_n=2, in_memory=True, **FAST) as c:
+        api = c[0].api
+        _seed_data(api)
+        _seed_data(api, index="st")
+        assert c[0].holder.pending_repair_count() == 0
+        inj = faults.FaultInjector(seed=3)
+        inj.partition(c[2].node.uri)
+        c[0].client.fault_injector = inj
+        # import path: replica fan-out drops node2's copies
+        cols = [s * SHARD_WIDTH + 99 for s in range(12)]
+        summary = api.import_bits("ft", "f", [5] * len(cols), cols)
+        assert summary["errors"], "node2's replicas should have failed"
+        n_imports = c[0].holder.pending_repair_count()
+        assert n_imports > 0
+        assert all(n == "node2" for _, _, n in c[0].holder.pending_repairs())
+        # row-wide write path (_fan_out write=True) records drops too
+        api.query("st", "Store(Row(f=0), f=6)")
+        st_entries = [
+            e for e in c[0].holder.pending_repairs() if e[0] == "st"
+        ]
+        assert st_entries and all(n == "node2" for _, _, n in st_entries)
+        st = http_json("GET", f"{c[0].node.uri}/status")
+        assert st["pendingRepairs"] == c[0].holder.pending_repair_count()
+        assert st["breakers"].get(c[2].node.uri) == faults.OPEN
+        snap = c[0].stats.registry.snapshot()
+        assert snap.get("write_replica_dropped", 0) >= 1
+        # heal + anti-entropy: node0 re-syncs its primary-owned shards and
+        # resolves their entries (node2-primary shards stay pending until
+        # node2's own pass — the debt is per-holder)
+        inj.heal(c[2].node.uri)
+        c[0].probe_peers()
+        before = c[0].holder.pending_repair_count()
+        c[0].sync_holder()
+        assert c[0].holder.pending_repair_count() < before
+
+
+def test_query_deadline_bounds_fan_out():
+    with ClusterHarness(2, in_memory=True, **FAST) as c:
+        api = c[0].api
+        _seed_data(api, index="dl", n_shards=4)
+        c[0].executor.query_deadline = 0.0
+        with pytest.raises(ExecError, match="deadline"):
+            api.query("dl", "Count(Row(f=0))")
+
+
+def test_breaker_half_open_recovery_end_to_end():
+    """Partition -> breaker opens; heal -> after the cooldown the next
+    query's half-open probe closes it and traffic flows again."""
+    kw = dict(FAST, breaker_cooldown=0.15)
+    with ClusterHarness(3, replica_n=2, in_memory=True, **kw) as c:
+        api = c[0].api
+        _seed_data(api)
+        (expect,) = api.query("ft", "Count(Row(f=0))")
+        inj = faults.FaultInjector(seed=9)
+        inj.partition(c[2].node.uri)
+        c[0].client.fault_injector = inj
+        (got,) = api.query("ft", "Count(Row(f=0))")
+        assert got == expect
+        assert c[0].breakers.state(c[2].node.uri) == faults.OPEN
+        inj.heal(c[2].node.uri)
+        time.sleep(0.2)  # past the cooldown: half-open probe allowed
+        assert c[0].breakers.state(c[2].node.uri) == faults.HALF_OPEN
+        (got,) = api.query("ft", "Count(Row(f=0))")
+        assert got == expect
+        assert c[0].breakers.state(c[2].node.uri) == faults.CLOSED
+
+
+@pytest.mark.slow
+def test_chaos_soak_seeded_flakiness_stays_correct():
+    """Long probabilistic soak (tier-2): 30 queries under sustained
+    seeded flakiness on one peer must all be exact."""
+    with ClusterHarness(3, replica_n=2, in_memory=True, **FAST) as c:
+        api = c[0].api
+        _seed_data(api)
+        (expect,) = api.query("ft", "Count(Row(f=0))")
+        inj = faults.FaultInjector(seed=1234)
+        inj.add_rule("http500", uri=c[1].node.uri, prob=0.3)
+        inj.add_rule("slow", uri=c[2].node.uri, prob=0.2, delay=0.01)
+        c[0].client.fault_injector = inj
+        for i in range(30):
+            (got,) = api.query("ft", "Count(Row(f=0))")
+            assert got == expect, f"iteration {i} diverged"
+        assert inj.count() > 0
