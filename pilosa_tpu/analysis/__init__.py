@@ -1,0 +1,78 @@
+"""Static analysis for pilosa_tpu: concurrency & JAX-purity gate.
+
+Usage (programmatic — tools/check.py is the CLI):
+
+    from pilosa_tpu import analysis
+    result = analysis.check(repo_root, baseline_path)
+    if not result.ok:
+        print(result.render())
+
+`default_passes()` is the registry: add a new pass by implementing
+`framework.Pass` and appending it there (docs/development.md walks
+through it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from pilosa_tpu.analysis.api_invariants import ApiInvariantsPass
+from pilosa_tpu.analysis.framework import (
+    Baseline,
+    BaselineEntry,
+    Finding,
+    GateResult,
+    Module,
+    Pass,
+    load_modules,
+    load_source_module,
+    run_gate,
+    run_passes,
+)
+from pilosa_tpu.analysis.jax_purity import JaxPurityPass
+from pilosa_tpu.analysis.lock_hygiene import LockHygienePass
+
+__all__ = [
+    "ApiInvariantsPass",
+    "Baseline",
+    "BaselineEntry",
+    "Finding",
+    "GateResult",
+    "JaxPurityPass",
+    "LockHygienePass",
+    "Module",
+    "Pass",
+    "check",
+    "default_passes",
+    "load_modules",
+    "load_source_module",
+    "run_gate",
+    "run_passes",
+]
+
+
+def default_passes() -> List[Pass]:
+    """The gate's pass registry, in execution order."""
+    return [LockHygienePass(), JaxPurityPass(), ApiInvariantsPass()]
+
+
+def check(
+    root: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+) -> GateResult:
+    """Run the full gate over the package at `root` (default: the repo
+    containing this installation) against the committed baseline."""
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    modules = load_modules(root)
+    baseline = None
+    if baseline_path is None:
+        candidate = os.path.join(root, "tools", "analysis_baseline.toml")
+        if os.path.exists(candidate):
+            baseline_path = candidate
+    if baseline_path is not None:
+        baseline = Baseline.load(baseline_path)
+    return run_gate(default_passes(), modules, baseline)

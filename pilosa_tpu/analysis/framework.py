@@ -1,0 +1,261 @@
+"""Static-analysis pass framework: modules, findings, baseline, runner.
+
+The analysis subsystem is a small, dependency-free AST lint engine that
+encodes this repo's concurrency and JAX-purity invariants (see the pass
+modules: lock_hygiene, jax_purity, api_invariants). It is wired into
+tier-1 via tests/test_static_analysis.py and into CI/dev loops via
+tools/check.py.
+
+Design points:
+
+* A `Pass` runs over the whole module set at once (cross-module passes
+  like the stats-registry check need the global view).
+* Findings are suppressed only through the committed baseline file
+  (tools/analysis_baseline.toml), where every entry carries a mandatory
+  human-written `reason`. A baseline entry that matches nothing is itself
+  an error — the baseline can only shrink or be re-justified, never rot.
+* Baseline entries match on (code, path, message-substring), NOT line
+  numbers, so unrelated edits don't invalidate them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+try:  # tomllib is stdlib only from 3.11; 3.10 environments carry tomli
+    import tomllib
+except ImportError:  # pragma: no cover - depends on interpreter version
+    import tomli as tomllib  # type: ignore[no-redef]
+
+__all__ = [
+    "Module",
+    "Finding",
+    "Pass",
+    "Baseline",
+    "BaselineEntry",
+    "GateResult",
+    "load_modules",
+    "load_source_module",
+    "run_passes",
+    "run_gate",
+]
+
+
+@dataclass(frozen=True)
+class Module:
+    """One parsed source file."""
+
+    path: str  # absolute
+    rel: str  # repo-root-relative, posix separators
+    source: str
+    tree: ast.Module
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a specific site."""
+
+    code: str  # e.g. "LOCK002"
+    path: str  # repo-root-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class Pass:
+    """Base class for analysis passes. Subclasses set `name` and
+    implement run() over the full module set."""
+
+    name = "unnamed"
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    code: str
+    path: str
+    match: str  # substring of the finding message; "" matches any
+    reason: str
+
+    def covers(self, f: Finding) -> bool:
+        return (
+            f.code == self.code
+            and f.path == self.path
+            and (not self.match or self.match in f.message)
+        )
+
+
+@dataclass
+class Baseline:
+    entries: List[BaselineEntry] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "rb") as fh:
+            data = tomllib.load(fh)
+        entries: List[BaselineEntry] = []
+        for i, raw in enumerate(data.get("allow", [])):
+            for req in ("code", "path", "reason"):
+                if not raw.get(req):
+                    raise ValueError(
+                        f"{path}: allow[{i}] is missing required key "
+                        f"{req!r} — every baseline entry must be justified"
+                    )
+            entries.append(
+                BaselineEntry(
+                    code=str(raw["code"]),
+                    path=str(raw["path"]),
+                    match=str(raw.get("match", "")),
+                    reason=str(raw["reason"]),
+                )
+            )
+        return cls(entries)
+
+
+@dataclass
+class GateResult:
+    """Outcome of one gate run: what fired, what the baseline ate, and
+    which baseline entries matched nothing (stale)."""
+
+    findings: List[Finding] = field(default_factory=list)  # unsuppressed
+    suppressed: List[Finding] = field(default_factory=list)
+    stale_entries: List[BaselineEntry] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_entries
+
+    def render(self) -> str:
+        out: List[str] = []
+        for f in sorted(
+            self.findings, key=lambda f: (f.path, f.line, f.code)
+        ):
+            out.append(f.render())
+        for e in self.stale_entries:
+            out.append(
+                f"{e.path}: STALE baseline entry {e.code} "
+                f"(match={e.match!r}) no longer matches any finding — "
+                "delete it"
+            )
+        if not out:
+            out.append("analysis: clean")
+        return "\n".join(out)
+
+
+def load_source_module(path: str, rel: Optional[str] = None) -> Module:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    return Module(
+        path=os.path.abspath(path),
+        rel=(rel if rel is not None else os.path.basename(path)),
+        source=source,
+        tree=ast.parse(source, filename=path),
+    )
+
+
+def load_modules(root: str, package_dir: str = "pilosa_tpu") -> List[Module]:
+    """Parse every .py under root/package_dir (repo tree order)."""
+    modules: List[Module] = []
+    base = os.path.join(root, package_dir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames.sort()
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            modules.append(load_source_module(full, rel))
+    return modules
+
+
+def run_passes(
+    passes: Sequence[Pass], modules: Sequence[Module]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in passes:
+        findings.extend(p.run(modules))
+    return findings
+
+
+def run_gate(
+    passes: Sequence[Pass],
+    modules: Sequence[Module],
+    baseline: Optional[Baseline] = None,
+) -> GateResult:
+    """Run passes, partition findings against the baseline, and report
+    stale baseline entries."""
+    all_findings = run_passes(passes, modules)
+    if baseline is None:
+        return GateResult(findings=all_findings)
+    used: Dict[int, bool] = {i: False for i in range(len(baseline.entries))}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in all_findings:
+        hit = False
+        for i, e in enumerate(baseline.entries):
+            if e.covers(f):
+                used[i] = True
+                hit = True
+        (suppressed if hit else kept).append(f)
+    stale = [e for i, e in enumerate(baseline.entries) if not used[i]]
+    return GateResult(findings=kept, suppressed=suppressed, stale_entries=stale)
+
+
+# -- shared AST helpers used by the concrete passes -------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map local alias -> imported dotted origin for a module.
+
+    `import numpy as np` -> {"np": "numpy"};
+    `from jax import jit` -> {"jit": "jax.jit"};
+    `import jax.numpy as jnp` -> {"jnp": "jax.numpy"}.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def resolve_call(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """Fully-qualified dotted origin of a call target, alias-resolved.
+
+    With {"np": "numpy"}, `np.asarray(x)` -> "numpy.asarray";
+    with {"urlopen": "urllib.request.urlopen"}, `urlopen(u)` ->
+    "urllib.request.urlopen". Returns None for non-name targets
+    (method calls on expressions, lambdas, subscripts, ...).
+    """
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    origin = aliases.get(head, head)
+    return f"{origin}.{rest}" if rest else origin

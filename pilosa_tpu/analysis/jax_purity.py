@@ -1,0 +1,360 @@
+"""JAX-purity pass: traced code must be pure and statically shaped.
+
+Functions compiled by `jax.jit` (and kernels handed to `pl.pallas_call`)
+are traced once and replayed: Python side effects inside them run at
+trace time only (silently wrong), host-numpy calls force device->host
+transfers or break tracing, and scalar coercions (`.item()`, `int(x)` on
+a traced value) force a blocking device read per call.
+
+Rules (codes):
+
+* JAX001 — Python side effect in a traced body: `print(...)` or a
+  `global` statement.
+* JAX002 — host numpy call (`np.*` / `numpy.*`) in a traced body.
+* JAX003 — traced->host coercion in a traced body: `.item()`, or
+  `int()/float()/bool()` applied to a non-static parameter.
+* JAX004 — mutation of module-level state (subscript/attribute store on
+  a module global) in a traced body; trace-time mutation runs once, not
+  per call.
+* JAX005 — `static_argnums` index out of range or `static_argnames`
+  naming a parameter the function does not have (the jit would raise at
+  call time — or worse, silently mark nothing static).
+* JAX006 — wall-clock / RNG host calls (`time.*`, `random.*`) in a
+  traced body.
+
+Traced bodies are discovered from: `@jax.jit`, `@jit`,
+`@partial(jax.jit, ...)` / `@functools.partial(jax.jit, ...)`,
+`jax.jit(fn, ...)` call expressions over local function names, and
+function names (possibly wrapped in `functools.partial`) passed as the
+first argument to `pl.pallas_call`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pilosa_tpu.analysis.framework import (
+    Finding,
+    Module,
+    Pass,
+    dotted_name,
+    import_aliases,
+    resolve_call,
+)
+
+__all__ = ["JaxPurityPass"]
+
+_JIT_ORIGINS = {"jax.jit"}
+_PARTIAL_ORIGINS = {"functools.partial", "partial"}
+_PALLAS_CALL_ORIGINS = {"jax.experimental.pallas.pallas_call"}
+
+
+def _is_jit_target(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    origin = aliases.get(head, head)
+    full = f"{origin}.{rest}" if rest else origin
+    return full in _JIT_ORIGINS
+
+
+def _static_spec(
+    call: ast.Call,
+) -> Tuple[Optional[List[int]], Optional[List[str]]]:
+    """Extract literal static_argnums / static_argnames from a jit-ish
+    call's keywords (None when absent or non-literal)."""
+    nums: Optional[List[int]] = None
+    names: Optional[List[str]] = None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _int_literals(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_literals(kw.value)
+    return nums, names
+
+
+def _int_literals(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _str_literals(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class _TracedBody:
+    def __init__(
+        self,
+        fn: ast.FunctionDef,
+        static_names: Set[str],
+        kind: str,  # "jit" | "pallas-kernel"
+    ):
+        self.fn = fn
+        self.static_names = static_names
+        self.kind = kind
+
+
+class JaxPurityPass(Pass):
+    name = "jax-purity"
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        findings: List[Finding] = []
+        for m in modules:
+            aliases = import_aliases(m.tree)
+            defs = {
+                n.name: n
+                for n in ast.walk(m.tree)
+                if isinstance(n, ast.FunctionDef)
+            }
+            traced = self._discover(m, aliases, defs, findings)
+            globals_ = self._module_globals(m.tree)
+            for body in traced:
+                self._check_body(m, aliases, body, globals_, findings)
+        return findings
+
+    # -- discovery ---------------------------------------------------------
+
+    def _discover(
+        self,
+        m: Module,
+        aliases: Dict[str, str],
+        defs: Dict[str, ast.FunctionDef],
+        findings: List[Finding],
+    ) -> List[_TracedBody]:
+        traced: List[_TracedBody] = []
+        seen: Set[str] = set()
+
+        def add(fn: ast.FunctionDef, static: Set[str], kind: str) -> None:
+            if fn.name not in seen:
+                seen.add(fn.name)
+                traced.append(_TracedBody(fn, static, kind))
+
+        for fn in defs.values():
+            for dec in fn.decorator_list:
+                if _is_jit_target(dec, aliases):
+                    add(fn, set(), "jit")
+                elif isinstance(dec, ast.Call):
+                    static = self._jit_call_statics(
+                        m, dec, aliases, fn, findings
+                    )
+                    if static is not None:
+                        add(fn, static, "jit")
+        # jax.jit(fn, ...) expressions and pallas_call(kernel, ...) args
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            origin = resolve_call(node, aliases)
+            if origin in _JIT_ORIGINS and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name) and target.id in defs:
+                    static = self._statics_for(
+                        m, node, defs[target.id], findings
+                    )
+                    add(defs[target.id], static, "jit")
+            elif (
+                origin in _PALLAS_CALL_ORIGINS
+                or (origin or "").endswith(".pallas_call")
+            ) and node.args:
+                kernel = node.args[0]
+                if (
+                    isinstance(kernel, ast.Call)
+                    and resolve_call(kernel, aliases) in _PARTIAL_ORIGINS
+                    and kernel.args
+                ):
+                    kernel = kernel.args[0]
+                if isinstance(kernel, ast.Name) and kernel.id in defs:
+                    add(defs[kernel.id], set(), "pallas-kernel")
+        return traced
+
+    def _jit_call_statics(
+        self,
+        m: Module,
+        dec: ast.Call,
+        aliases: Dict[str, str],
+        fn: ast.FunctionDef,
+        findings: List[Finding],
+    ) -> Optional[Set[str]]:
+        """Static-arg names when `dec` is a jit-wrapping decorator call
+        (`@partial(jax.jit, ...)` or `@jax.jit(...)`), else None."""
+        origin = resolve_call(dec, aliases)
+        if origin in _PARTIAL_ORIGINS:
+            if not (dec.args and _is_jit_target(dec.args[0], aliases)):
+                return None
+        elif not _is_jit_target(dec.func, aliases):
+            return None
+        return self._statics_for(m, dec, fn, findings)
+
+    def _statics_for(
+        self,
+        m: Module,
+        call: ast.Call,
+        fn: ast.FunctionDef,
+        findings: List[Finding],
+    ) -> Set[str]:
+        """Resolve a jit call's static spec against fn's signature,
+        emitting JAX005 for mismatches."""
+        params = [a.arg for a in fn.args.args]
+        nums, names = _static_spec(call)
+        static: Set[str] = set()
+        if nums is not None:
+            for i in nums:
+                if 0 <= i < len(params):
+                    static.add(params[i])
+                else:
+                    findings.append(
+                        Finding(
+                            code="JAX005",
+                            path=m.rel,
+                            line=call.lineno,
+                            message=(
+                                f"static_argnums index {i} out of range "
+                                f"for {fn.name}() with {len(params)} "
+                                "positional parameters"
+                            ),
+                        )
+                    )
+        if names is not None:
+            for nm in names:
+                if nm in params:
+                    static.add(nm)
+                else:
+                    findings.append(
+                        Finding(
+                            code="JAX005",
+                            path=m.rel,
+                            line=call.lineno,
+                            message=(
+                                f"static_argnames {nm!r} is not a "
+                                f"parameter of {fn.name}() "
+                                f"(params: {', '.join(params) or 'none'})"
+                            ),
+                        )
+                    )
+        return static
+
+    # -- body checks -------------------------------------------------------
+
+    @staticmethod
+    def _module_globals(tree: ast.Module) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                if isinstance(stmt.target, ast.Name):
+                    out.add(stmt.target.id)
+        return out
+
+    def _check_body(
+        self,
+        m: Module,
+        aliases: Dict[str, str],
+        body: _TracedBody,
+        module_globals: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        fn = body.fn
+        traced_params = {
+            a.arg for a in fn.args.args
+        } - body.static_names - {"self"}
+        local_names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                local_names.add(node.id)
+
+        def emit(code: str, node: ast.AST, msg: str) -> None:
+            findings.append(
+                Finding(
+                    code=code,
+                    path=m.rel,
+                    line=getattr(node, "lineno", fn.lineno),
+                    message=f"{msg} in traced body of {fn.name}()",
+                )
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                emit("JAX001", node, "`global` statement")
+            elif isinstance(node, ast.Call):
+                origin = resolve_call(node, aliases)
+                if origin == "print":
+                    emit("JAX001", node, "print() side effect")
+                elif origin is not None and origin.split(".")[0] == "numpy":
+                    emit(
+                        "JAX002",
+                        node,
+                        f"host numpy call {origin}()",
+                    )
+                elif origin is not None and (
+                    origin.startswith("time.")
+                    or origin.startswith("random.")
+                ):
+                    emit(
+                        "JAX006",
+                        node,
+                        f"host wall-clock/RNG call {origin}()",
+                    )
+                elif origin in ("int", "float", "bool"):
+                    if (
+                        node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in traced_params
+                    ):
+                        emit(
+                            "JAX003",
+                            node,
+                            f"{origin}() coercion of traced parameter "
+                            f"{node.args[0].id!r}",
+                        )
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    emit("JAX003", node, ".item() device read")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    if (
+                        isinstance(base, ast.Name)
+                        and base is not t  # only subscript/attr stores
+                        and base.id in module_globals
+                        and base.id not in local_names
+                    ):
+                        emit(
+                            "JAX004",
+                            node,
+                            f"mutation of module global {base.id!r}",
+                        )

@@ -1,0 +1,226 @@
+"""Lock-hygiene pass: what may be constructed, and what may run under a lock.
+
+Rules (codes):
+
+* LOCK001 — raw `threading.Lock()` / `RLock()` / `Condition()` /
+  `Semaphore()` constructed anywhere except `pilosa_tpu/utils/locks.py`.
+  All locks go through the tracked factories so the runtime deadlock
+  checker sees them.
+* LOCK002 — blocking host work inside a `with <lock>:` body: `time.sleep`,
+  `subprocess.*`, socket connect/IO, `urllib`/`http.client`/`requests`
+  network calls. A lock held across a sleep or the network turns every
+  peer timeout into whole-process convoying (and starved the XLA
+  dispatch path once already — see PR 1's deadlock note).
+* LOCK003 — device synchronization inside a `with <lock>:` body:
+  `.block_until_ready()`, `jax.device_get`, `jax.device_put`. Holding a
+  lock through a device round-trip serializes all query threads behind
+  HBM latency; where that is *intentional* (exec/plan.py serializes the
+  whole mesh dispatch by design) the site is baselined with a reason,
+  not rewritten.
+
+Scope notes: bodies of functions *defined* under a `with` are skipped
+(closures run later, lock not necessarily held); lock detection is
+name-based (`*_mu`, `*_lock`, `*_once`, `_MU`/`_LOCK` globals — the
+repo-wide naming convention the tracked factories enforce by usage).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence
+
+from pilosa_tpu.analysis.framework import (
+    Finding,
+    Module,
+    Pass,
+    dotted_name,
+    import_aliases,
+    resolve_call,
+)
+
+__all__ = ["LockHygienePass", "LOCKISH_RE"]
+
+# terminal identifier of a with-context expression that names a mutex
+LOCKISH_RE = re.compile(r"(?:^|_)(?:mu|mutex|lock|lk|once)\d*$", re.IGNORECASE)
+
+_RAW_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+
+# dotted-origin prefixes that mean "blocking host work" under a lock
+_BLOCKING_ORIGINS = (
+    "time.sleep",
+    "subprocess.",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "urllib.request.",
+    "http.client.",
+    "requests.",
+)
+
+_DEVICE_SYNC_ORIGINS = (
+    "jax.device_get",
+    "jax.device_put",
+    "jax.block_until_ready",
+)
+
+_ALLOWED_RAW_IN = "pilosa_tpu/utils/locks.py"
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    """Name of the lock when `expr` looks like one, else None."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    terminal = name.rsplit(".", 1)[-1]
+    return name if LOCKISH_RE.search(terminal) else None
+
+
+class _UnderLockScanner(ast.NodeVisitor):
+    """Scan a with-body for forbidden calls, skipping deferred bodies."""
+
+    def __init__(
+        self,
+        pass_: "LockHygienePass",
+        module: Module,
+        aliases: Dict[str, str],
+        lock_name: str,
+        findings: List[Finding],
+    ):
+        self.pass_ = pass_
+        self.module = module
+        self.aliases = aliases
+        self.lock_name = lock_name
+        self.findings = findings
+
+    # closures / nested defs run after the lock is released
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        return
+
+    def visit_Call(self, node: ast.Call) -> None:
+        origin = resolve_call(node, self.aliases)
+        device_sync_hit = False
+        if origin is not None:
+            for bad in _BLOCKING_ORIGINS:
+                if origin == bad or (bad.endswith(".") and origin.startswith(bad)):
+                    self.findings.append(
+                        Finding(
+                            code="LOCK002",
+                            path=self.module.rel,
+                            line=node.lineno,
+                            message=(
+                                f"blocking call {origin}() inside "
+                                f"`with {self.lock_name}:` body"
+                            ),
+                        )
+                    )
+                    break
+            for bad in _DEVICE_SYNC_ORIGINS:
+                if origin == bad:
+                    device_sync_hit = True
+                    self.findings.append(
+                        Finding(
+                            code="LOCK003",
+                            path=self.module.rel,
+                            line=node.lineno,
+                            message=(
+                                f"device sync {origin}() inside "
+                                f"`with {self.lock_name}:` body"
+                            ),
+                        )
+                    )
+        # method-style device sync: <expr>.block_until_ready()
+        # (skipped when the origin match above already reported this call
+        # as function-style jax.block_until_ready)
+        if (
+            not device_sync_hit
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            self.findings.append(
+                Finding(
+                    code="LOCK003",
+                    path=self.module.rel,
+                    line=node.lineno,
+                    message=(
+                        "device sync .block_until_ready() inside "
+                        f"`with {self.lock_name}:` body"
+                    ),
+                )
+            )
+        self.generic_visit(node)
+
+
+class LockHygienePass(Pass):
+    name = "lock-hygiene"
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        findings: List[Finding] = []
+        for m in modules:
+            aliases = import_aliases(m.tree)
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Call):
+                    self._check_raw_ctor(m, node, aliases, findings)
+                elif isinstance(node, ast.With):
+                    self._check_with(m, node, aliases, findings)
+        return findings
+
+    def _check_raw_ctor(
+        self,
+        m: Module,
+        node: ast.Call,
+        aliases: Dict[str, str],
+        findings: List[Finding],
+    ) -> None:
+        if m.rel.endswith(_ALLOWED_RAW_IN):
+            return
+        origin = resolve_call(node, aliases)
+        if origin in _RAW_LOCK_CTORS:
+            short = origin.rsplit(".", 1)[-1]
+            findings.append(
+                Finding(
+                    code="LOCK001",
+                    path=m.rel,
+                    line=node.lineno,
+                    message=(
+                        f"raw threading.{short}() constructed outside "
+                        "utils/locks.py — use locks.TrackedLock/"
+                        "TrackedRLock/TrackedCondition so the deadlock "
+                        "checker sees it"
+                    ),
+                )
+            )
+
+    def _check_with(
+        self,
+        m: Module,
+        node: ast.With,
+        aliases: Dict[str, str],
+        findings: List[Finding],
+    ) -> None:
+        lock_names = [
+            n
+            for n in (_lockish(item.context_expr) for item in node.items)
+            if n is not None
+        ]
+        if not lock_names:
+            return
+        scanner = _UnderLockScanner(
+            self, m, aliases, lock_names[0], findings
+        )
+        for stmt in node.body:
+            scanner.visit(stmt)

@@ -10,7 +10,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:  # tomllib is stdlib only from 3.11; 3.10 environments carry tomli
+    import tomllib
+except ImportError:  # pragma: no cover - depends on interpreter version
+    import tomli as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass, field
 from typing import List, Optional
 

@@ -31,9 +31,43 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--data-dir", "-d")
     sp.add_argument("--bind", "-b")
     sp.add_argument("--node-id")
+    sp.add_argument("--log-path", help="append server log here (default stderr)")
+    sp.add_argument(
+        "--long-query-time", type=float,
+        help="log queries slower than this many seconds (0 disables)",
+    )
+    sp.add_argument(
+        "--max-writes-per-request", type=int,
+        help="reject write batches larger than this",
+    )
     sp.add_argument("--cluster-hosts", help="comma-separated id@uri entries")
     sp.add_argument("--replicas", type=int)
+    sp.add_argument(
+        "--coordinator", action="store_true", default=None,
+        help="force this node to act as cluster coordinator",
+    )
+    sp.add_argument(
+        "--probe-interval", type=float,
+        help="coordinator liveness-probe ticker seconds (0 disables)",
+    )
     sp.add_argument("--anti-entropy-interval", type=float)
+    sp.add_argument(
+        "--metric-service",
+        help="metrics backend: none | expvar | prometheus | statsd",
+    )
+    sp.add_argument("--metric-host", help="statsd daemon host:port")
+    sp.add_argument(
+        "--metric-poll-interval", type=float,
+        help="runtime-gauge sampling ticker seconds (0 disables)",
+    )
+    sp.add_argument(
+        "--tracing-enabled", action="store_true", default=None,
+        help="record spans for incoming queries",
+    )
+    sp.add_argument(
+        "--tracing-sample-rate", type=float,
+        help="fraction of queries traced when tracing is enabled",
+    )
     sp.add_argument(
         "--retry-max-attempts", type=int,
         help="internode RPC attempts within one deadline budget",
@@ -104,48 +138,49 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# argparse dest -> (section, knob) for every server flag that overrides a
+# Config field; None section means a flat Config field. The api-invariants
+# pass checks this stays in sync with cli/config.py's dataclasses.
+_FLAG_KNOBS = {
+    "data_dir": (None, "data_dir"),
+    "bind": (None, "bind"),
+    "node_id": (None, "node_id"),
+    "log_path": (None, "log_path"),
+    "verbose": (None, "verbose"),
+    "long_query_time": (None, "long_query_time"),
+    "max_writes_per_request": (None, "max_writes_per_request"),
+    "cluster_hosts": ("cluster", "hosts"),
+    "replicas": ("cluster", "replicas"),
+    "coordinator": ("cluster", "coordinator"),
+    "probe_interval": ("cluster", "probe_interval"),
+    "retry_max_attempts": ("cluster", "retry_max_attempts"),
+    "retry_base_backoff": ("cluster", "retry_base_backoff"),
+    "breaker_threshold": ("cluster", "breaker_threshold"),
+    "breaker_cooldown": ("cluster", "breaker_cooldown"),
+    "query_deadline": ("cluster", "query_deadline"),
+    "anti_entropy_interval": ("anti_entropy", "interval"),
+    "metric_service": ("metric", "service"),
+    "metric_host": ("metric", "host"),
+    "metric_poll_interval": ("metric", "poll_interval"),
+    "tracing_enabled": ("tracing", "enabled"),
+    "tracing_sample_rate": ("tracing", "sample_rate"),
+    "tls_certificate": ("tls", "certificate"),
+    "tls_key": ("tls", "key"),
+    "tls_skip_verify": ("tls", "skip_verify"),
+    "tls_ca_certificate": ("tls", "ca_certificate"),
+}
+
+
 def _load_config(args) -> Config:
-    overrides = {}
-    for attr, key in (
-        ("data_dir", "data_dir"),
-        ("bind", "bind"),
-        ("node_id", "node_id"),
-        ("verbose", "verbose"),
-    ):
-        v = getattr(args, attr, None)
-        if v is not None:
-            overrides[key] = v
-    cluster = {}
-    if getattr(args, "cluster_hosts", None):
-        cluster["hosts"] = args.cluster_hosts
-    if getattr(args, "replicas", None) is not None:
-        cluster["replicas"] = args.replicas
-    for knob in (
-        "retry_max_attempts",
-        "retry_base_backoff",
-        "breaker_threshold",
-        "breaker_cooldown",
-        "query_deadline",
-    ):
-        v = getattr(args, knob, None)
-        if v is not None:
-            cluster[knob] = v
-    if cluster:
-        overrides["cluster"] = cluster
-    if getattr(args, "anti_entropy_interval", None) is not None:
-        overrides["anti_entropy"] = {"interval": args.anti_entropy_interval}
-    tls = {}
-    for attr, key in (
-        ("tls_certificate", "certificate"),
-        ("tls_key", "key"),
-        ("tls_skip_verify", "skip_verify"),
-        ("tls_ca_certificate", "ca_certificate"),
-    ):
-        v = getattr(args, attr, None)
-        if v is not None:
-            tls[key] = v
-    if tls:
-        overrides["tls"] = tls
+    overrides: dict = {}
+    for dest, (section, knob) in _FLAG_KNOBS.items():
+        v = getattr(args, dest, None)
+        if v is None:
+            continue
+        if section is None:
+            overrides[knob] = v
+        else:
+            overrides.setdefault(section, {})[knob] = v
     return Config.load(path=args.config, overrides=overrides)
 
 
@@ -160,31 +195,47 @@ def _scheme(cfg: Config) -> str:
     return "https" if cfg.tls.certificate else "http"
 
 
-def _join_on_boot(srv, coordinator_uri: str, timeout: float = 180.0) -> None:
+def _join_on_boot(
+    srv,
+    coordinator_uri: str,
+    timeout: float = 180.0,
+    clock=None,
+    wake=None,
+) -> None:
     """Self-register with the coordinator and wait until this node is an
     active member (reference: gossip join -> listenForJoins -> resize job,
     cluster.go:1141,1796). Retries while the coordinator is busy with
     another resize — concurrent joins serialize on the coordinator's
-    one-job-at-a-time rule."""
+    one-job-at-a-time rule.
+
+    `clock` (monotonic-seconds callable) and `wake` (Event-like; `.wait(t)`
+    bounds each poll step and an external `.set()` wakes the loop
+    immediately) are injectable so tests drive the loop on a virtual clock
+    instead of racing wall-time sleeps."""
+    import threading
     import time
 
     from pilosa_tpu.server.client import ClientError
 
+    if clock is None:
+        clock = time.monotonic
+    if wake is None:
+        wake = threading.Event()
     payload = {"id": srv.node.id, "uri": srv.node.uri}
-    deadline = time.time() + timeout
+    deadline = clock() + timeout
     registered_at: Optional[float] = None
-    while time.time() < deadline:
+    while clock() < deadline:
         if registered_at is None:
             try:
                 srv.client.join_cluster(coordinator_uri, payload)
-                registered_at = time.time()
+                registered_at = clock()
             except ClientError as e:
                 # coordinator busy (a resize job is already running) or not
                 # up yet: back off and retry
                 print(f"join: waiting for coordinator: {e}", file=sys.stderr)
-                time.sleep(1.0)
+                wake.wait(1.0)
                 continue
-        elif len(srv.cluster.nodes) <= 1 and time.time() - registered_at > 10.0:
+        elif len(srv.cluster.nodes) <= 1 and clock() - registered_at > 10.0:
             # the join resize aborted and rolled us back to a solo
             # cluster: re-register rather than idling out the deadline
             print("join: resize rolled back; re-registering", file=sys.stderr)
@@ -201,7 +252,7 @@ def _join_on_boot(srv, coordinator_uri: str, timeout: float = 180.0) -> None:
                 file=sys.stderr,
             )
             return
-        time.sleep(0.2)
+        wake.wait(0.2)
     raise SystemExit(f"join via {coordinator_uri} did not complete in {timeout}s")
 
 

@@ -14,9 +14,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import zlib
 from typing import Dict, List, Optional
+
+from pilosa_tpu.utils.locks import TrackedRLock
 
 ATTR_BLOCK_SIZE = 100  # reference: attrBlockSize, attr.go
 
@@ -29,7 +30,7 @@ COMPACT_THRESHOLD = 4096
 class AttrStore:
     def __init__(self, path: Optional[str] = None):
         self.path = path
-        self._mu = threading.RLock()
+        self._mu = TrackedRLock("attrs.mu")
         self._attrs: Dict[int, dict] = {}
         self._log_f = None
         self._log_n = 0

@@ -21,9 +21,10 @@ Keys are (owner, *rest) tuples where `owner` is a per-object token from
 from __future__ import annotations
 
 import os
-import threading
 from collections import OrderedDict
 from typing import Callable, Dict, Hashable, Set, Tuple
+
+from pilosa_tpu.utils.locks import TrackedLock
 
 _DEFAULT_BUDGET_MB = 4096
 
@@ -37,7 +38,7 @@ def _env_budget_bytes() -> int:
     return mb * 1024 * 1024
 
 
-_token_lock = threading.Lock()
+_token_lock = TrackedLock("devcache.token_lock")
 _token_next = 0
 
 
@@ -67,7 +68,7 @@ class DeviceCache:
     """
 
     def __init__(self, budget_bytes: int | None = None):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("devcache.mu")
         self._entries: "OrderedDict[Tuple, object]" = OrderedDict()
         self._sizes: Dict[Tuple, int] = {}
         self._by_owner: Dict[Hashable, Set[Tuple]] = {}

@@ -10,13 +10,13 @@ from __future__ import annotations
 import json
 import os
 import re
-import threading
 from dataclasses import asdict, dataclass, field as dc_field
 from datetime import datetime
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core import timeq
 from pilosa_tpu.core.cache import (  # single source of truth: core/cache.py
     CACHE_TYPE_LRU,
@@ -94,7 +94,7 @@ class Field:
         self.index = index
         self.name = name
         self.options = options
-        self._mu = threading.RLock()
+        self._mu = TrackedRLock("field.mu")
         self.views: Dict[str, View] = {}
         # shards this node knows exist cluster-wide (field.go:88
         # remoteAvailableShards); local shards are derived from fragments.

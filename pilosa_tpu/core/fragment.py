@@ -22,12 +22,12 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core import cache as cachemod
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
@@ -224,7 +224,7 @@ class Fragment:
         self._cache_top_arrays = None  # memoized (top, rids, cnts)
         self._cache_id_arrays = None  # memoized id-sorted (top, rids, cnts)
 
-        self._mu = threading.RLock()
+        self._mu = TrackedRLock("fragment.mu")
         self._rows: Dict[int, RowBits] = {}
         # Device residency goes through the process-global budgeted LRU
         # (core/devcache.py): per-row arrays under _token, multi-row stacks

@@ -8,16 +8,16 @@ from __future__ import annotations
 
 import os
 import shutil
-import threading
 from typing import Dict, List, Optional
 
+from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core.index import Index
 
 
 class Holder:
     def __init__(self, path: Optional[str] = None):
         self.path = path  # data directory; None => in-memory
-        self._mu = threading.RLock()
+        self._mu = TrackedRLock("holder.mu")
         self._indexes: Dict[str, Index] = {}
         # (index, shard, node_id) writes that a replica missed (it was
         # down / partitioned when the write fanned out): anti-entropy is

@@ -8,11 +8,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core.field import (
     FIELD_TYPE_SET,
     Field,
@@ -37,7 +37,7 @@ class Index:
         self.name = name
         self.keys = keys
         self.track_existence = track_existence
-        self._mu = threading.RLock()
+        self._mu = TrackedRLock("index.mu")
         self._fields: Dict[str, Field] = {}
         # per-column attributes (reference: index.go columnAttrStore)
         from pilosa_tpu.core.attrs import AttrStore

@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from pilosa_tpu.utils.locks import TrackedRLock
 
 _REC = struct.Struct("<QI")  # id, key-length ; followed by key bytes
 
@@ -51,7 +52,7 @@ class TranslateStore:
         # catchup_fn() -> None: pull + apply the primary's new entries.
         self.forward_fn = None
         self.catchup_fn = None
-        self._lock = threading.RLock()
+        self._lock = TrackedRLock("translate.lock")
         self._by_key: Dict[str, int] = {}
         self._by_id: Dict[int, str] = {}
         self._next_id = 1

@@ -7,11 +7,11 @@ groups (view.go:37-41)."""
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
@@ -41,7 +41,7 @@ class View:
         self.max_op_n = max_op_n
         self.cache_type = cache_type
         self.cache_size = cache_size
-        self._mu = threading.RLock()
+        self._mu = TrackedRLock("view.mu")
         self.fragments: Dict[int, Fragment] = {}
         # owner token for cross-shard row stacks in the global device cache
         self._stack_token = new_owner_token()

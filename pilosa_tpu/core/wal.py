@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 import zlib
 from collections import OrderedDict
 from contextlib import contextmanager, nullcontext
@@ -33,6 +32,7 @@ from typing import Dict, Iterator, Tuple
 
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.core.rowstore import RowBits
 
 SNAP_MAGIC = b"PTSNAP01"
@@ -145,7 +145,7 @@ class WalWriter:
     _MAX_OPEN_WALS."""
 
     _lru: "OrderedDict[int, WalWriter]" = OrderedDict()
-    _lru_mu = threading.Lock()
+    _lru_mu = TrackedLock("wal.lru_mu")
     _next_tok = 0
 
     def __init__(self, path: str):

@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List
 
+from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.pql import Query
 
 # Bound on calls merged into one execution: keeps lowered plan shapes in a
@@ -32,7 +33,7 @@ from pilosa_tpu.pql import Query
 MAX_BATCH_CALLS = 64
 
 STATS = {"leader": 0, "batched": 0, "merged_execs": 0, "fallback_splits": 0}
-_STATS_MU = threading.Lock()
+_STATS_MU = TrackedLock("batcher.stats_mu")
 
 
 def _bump(key: str) -> None:
@@ -73,7 +74,7 @@ class CountBatcher:
     everyone else's queries forever."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("batcher.mu")
         self._busy: Dict[str, bool] = {}
         self._queue: Dict[str, List[_Waiter]] = {}
 

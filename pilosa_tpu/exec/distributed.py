@@ -22,10 +22,10 @@ attr writes replicate to all nodes."""
 
 from __future__ import annotations
 
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.cluster.topology import Cluster
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
@@ -77,7 +77,7 @@ class DistributedExecutor(Executor):
         # covering every re-map round and backoff (config: query-deadline)
         self.query_deadline = query_deadline
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._pool_mu = threading.Lock()
+        self._pool_mu = TrackedLock("distributed.pool_mu")
 
     def _fanout_pool(self) -> ThreadPoolExecutor:
         """Lazy shared pool for concurrent per-node requests (the role of

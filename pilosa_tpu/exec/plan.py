@@ -23,7 +23,6 @@ sums them in exact Python ints — one device->host read per query.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -32,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.ops import bsi as obsi
 from pilosa_tpu.ops.bitmap import shift_bits
 
@@ -47,7 +47,7 @@ STATS = {"evals": 0}
 # pjit __call__ on 2-core CI hosts). A single program occupying the whole
 # mesh is the execution model anyway; the lock makes it explicit. It is
 # held through the device->host read so no async execution escapes it.
-_DISPATCH_MU = threading.Lock()
+_DISPATCH_MU = TrackedLock("plan.dispatch_mu")
 
 
 def reset_stats() -> None:

@@ -15,13 +15,14 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
 
+from pilosa_tpu.utils.locks import TrackedLock
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LOCK = threading.Lock()
+_LOCK = TrackedLock("native.build_lock")
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 

@@ -36,6 +36,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.ops import bitmap as ob
 
+# jax.shard_map graduated from jax.experimental in newer releases; support
+# both so the mesh step runs on the 0.4.x line this image ships.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax version
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 _pc = jax.lax.population_count
 
 
@@ -195,7 +202,7 @@ def make_query_step(mesh: Mesh, row_a: int = 0, row_b: int = 1):
         rows = jax.lax.psum(rows, ("shards", "cols"))
         return data, inter, uni, rows
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(DATA_SPEC, DATA_SPEC),

@@ -28,10 +28,11 @@ from __future__ import annotations
 
 import io
 import random
-import threading
 import time
 import urllib.error
 from typing import Callable, Dict, List, Optional, Tuple
+
+from pilosa_tpu.utils.locks import TrackedLock
 
 # breaker states (reference naming: closed = healthy, open = fast-fail,
 # half-open = single probe allowed after the cooldown)
@@ -101,7 +102,7 @@ class RetryPolicy:
         self.jitter = jitter
         self.clock = clock
         self.sleep = sleep
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("faults.retry_mu")
         self._rng = random.Random(seed)
 
     def backoff(self, attempt: int) -> float:
@@ -141,7 +142,7 @@ class CircuitBreaker:
         self.cooldown = cooldown
         self._clock = clock
         self._on_transition = on_transition
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("faults.breaker_mu")
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -235,7 +236,7 @@ class BreakerRegistry:
         self._clock = clock
         self.stats = stats
         self.logger = logger
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("faults.breaker_registry_mu")
         self._breakers: Dict[str, CircuitBreaker] = {}
 
     @staticmethod
@@ -339,7 +340,7 @@ class FaultInjector:
     fails any test that leaks the global)."""
 
     def __init__(self, seed: int = 0, sleep: Callable[[float], None] = time.sleep):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("faults.injector_mu")
         self._rng = random.Random(seed)
         self._sleep = sleep
         self._rules: List[_Rule] = []
@@ -438,7 +439,7 @@ class FaultInjector:
 # process-wide installs (tests); the conftest leak-guard checks these
 # ---------------------------------------------------------------------------
 
-_global_mu = threading.Lock()
+_global_mu = TrackedLock("faults.global_mu")
 _global_injector: Optional[FaultInjector] = None
 _global_breakers: Optional[BreakerRegistry] = None
 

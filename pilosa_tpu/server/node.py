@@ -17,7 +17,10 @@ from __future__ import annotations
 import os
 import threading
 import time
+import traceback
 from typing import Dict, List, Optional
+
+from pilosa_tpu.utils.locks import TrackedLock
 
 from pilosa_tpu.cluster.topology import (
     STATE_NORMAL,
@@ -152,18 +155,18 @@ class NodeServer:
         # last-synced fragment versions: AE prioritizes fragments mutated
         # since their last pass (fresh drift repairs first under load)
         self._ae_versions: Dict[tuple, int] = {}
-        self._resize_mu = threading.Lock()
+        self._resize_mu = TrackedLock("node.resize_mu")
         # single-flight anti-entropy: the AE ticker, the operator's POST
         # /internal/sync, and a peer's debt nudge must not stack passes —
         # and single-flight breaks the A-nudges-B-nudges-A recursion
-        self._sync_once = threading.Lock()
+        self._sync_once = TrackedLock("node.sync_once")
         # single-flight for the nudge itself: it runs OUTSIDE _sync_once
         # (a slow primary must not stall our own next pass), so it needs
         # its own guard against mutual-debt nudge recursion
-        self._nudge_once = threading.Lock()
+        self._nudge_once = TrackedLock("node.nudge_once")
         # serializes cluster-status emission: the probe ticker's stale
         # NORMAL must never land after a resize's RESIZING freeze
-        self._status_mu = threading.Lock()
+        self._status_mu = TrackedLock("node.status_mu")
         self._resize_abort = threading.Event()
         self._resize_thread: Optional[threading.Thread] = None
 
@@ -363,6 +366,16 @@ class NodeServer:
             self._runtime_thread.start()
         return self
 
+    def _ticker_error(self, ticker: str, exc: BaseException) -> None:
+        """Background tickers must survive any failure, but never silently:
+        the full traceback goes to the log and `ticker.error` counts it so
+        a quietly-failing loop shows up on dashboards instead of being
+        discovered as stale caches / undetected dead peers much later."""
+        self.stats.count("ticker.error")
+        self.logger(
+            f"{ticker} ticker error: {exc!r}\n{traceback.format_exc()}"
+        )
+
     def _runtime_poll_loop(self) -> None:
         """Sample process runtime gauges (reference: server.go:813
         monitorRuntime — goroutines/heap/GC/open-files)."""
@@ -381,7 +394,7 @@ class NodeServer:
                 except OSError:
                     pass
             except Exception as e:  # noqa: BLE001 - keep the ticker alive
-                self.logger(f"runtime poll: {e}")
+                self._ticker_error("runtime-poll", e)
 
     def _cache_flush_loop(self) -> None:
         """Persist rank caches periodically (reference: holder.go:506
@@ -390,7 +403,7 @@ class NodeServer:
             try:
                 self.holder.flush_caches()
             except Exception as e:  # noqa: BLE001 - keep the ticker alive
-                self.logger(f"cache flush: {e}")
+                self._ticker_error("cache-flush", e)
 
     def stop(self) -> None:
         self._closing.set()
@@ -559,7 +572,7 @@ class NodeServer:
             try:
                 self.run_probe_pass()
             except Exception as e:  # noqa: BLE001 - keep the ticker alive
-                self.logger(f"liveness probe: {e}")
+                self._ticker_error("liveness-probe", e)
 
     def run_probe_pass(self, timeout: float = 2.0) -> bool:
         """One coordinator liveness tick. Returns True when a state change
@@ -636,8 +649,8 @@ class NodeServer:
                 # non-waiting variant: the tick must not stall behind
                 # remote passes triggered by the debt nudge
                 self.try_sync_holder()
-            except Exception as e:
-                self.logger(f"anti-entropy: {e}")
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self._ticker_error("anti-entropy", e)
 
     def sync_holder(self) -> int:
         """One full anti-entropy pass: for every local fragment whose shard

@@ -1,0 +1,445 @@
+"""Tracked locks: runtime lock-order / deadlock discipline for the package.
+
+Every lock in pilosa_tpu is created through the factories here instead of
+`threading.Lock()` directly (the lock-hygiene AST pass in
+pilosa_tpu/analysis/ rejects raw constructions outside this module). In
+normal operation the factories are ZERO-overhead passthroughs — they
+return the raw `threading` primitive, so production pays nothing.
+
+When `PILOSA_TPU_LOCK_CHECK=1` (tests/conftest.py sets it for the whole
+tier-1 suite) the factories return checking wrappers that maintain a
+process-global lock-acquisition-order graph keyed by lock *class* (the
+`name` passed at construction — all Fragment._mu instances share one
+node, like kernel lockdep). The checker records, at acquire time:
+
+  * **ordering edges** held-class -> acquiring-class, and flags any edge
+    that closes a cycle (an AB/BA ordering between two threads is a
+    potential deadlock even if this particular run never parked);
+  * **self-deadlock**: the same thread re-acquiring a non-reentrant
+    TrackedLock it already holds (guaranteed deadlock);
+  * optionally, **long holds**: with `PILOSA_TPU_LOCK_HOLD_MS=<n>`,
+    releases after holding longer than n ms are recorded as warnings.
+
+Violations are recorded (with the acquisition stacks of BOTH sites of a
+cycle) rather than raised: raising inside arbitrary lock acquisitions
+would be masked by keep-alive handlers. tests/conftest.py fails any test
+that recorded a violation, printing `format_report()`.
+
+Cost model under checking: stacks are captured only when a *new* edge is
+inserted into the order graph (bounded by the number of distinct lock-
+class pairs), so steady-state acquires cost a thread-local list append
+plus a set lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+__all__ = [
+    "TrackedLock",
+    "TrackedRLock",
+    "TrackedCondition",
+    "checking_enabled",
+    "enable_checking",
+    "disable_checking",
+    "violations",
+    "warnings",
+    "reset",
+    "format_report",
+    "Violation",
+]
+
+_STACK_LIMIT = 16  # frames kept per recorded acquisition site
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PILOSA_TPU_LOCK_CHECK", "") == "1"
+
+
+def _env_hold_ms() -> Optional[float]:
+    raw = os.environ.get("PILOSA_TPU_LOCK_HOLD_MS", "")
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One detected discipline breach.
+
+    kind: "cycle" | "self-deadlock" | "long-hold"
+    For cycles, `stack_a` is the site that recorded the pre-existing
+    reverse edge and `stack_b` the site that closed the cycle.
+    """
+
+    kind: str
+    message: str
+    stack_a: str = ""
+    stack_b: str = ""
+
+    def render(self) -> str:
+        out = [f"[{self.kind}] {self.message}"]
+        if self.stack_a:
+            out.append("--- first site ---")
+            out.append(self.stack_a.rstrip())
+        if self.stack_b:
+            out.append("--- second site ---")
+            out.append(self.stack_b.rstrip())
+        return "\n".join(out)
+
+
+@dataclass
+class _HeldEntry:
+    lock: object
+    name: str
+    t_acquired: float
+    depth: int = 1
+
+
+@dataclass
+class _Edge:
+    """First-seen metadata for an order-graph edge held -> acquired."""
+
+    thread: str
+    stack: str
+
+
+class _CheckerState:
+    """Process-global order graph + violation log (one per process)."""
+
+    def __init__(self) -> None:
+        self.mu = threading.Lock()  # the one permitted raw lock
+        self.edges: Dict[Tuple[str, str], _Edge] = {}
+        self.adj: Dict[str, Set[str]] = {}
+        self.violations: List[Violation] = []
+        self.warnings: List[Violation] = []
+        self.tls = threading.local()
+
+    def held(self) -> List[_HeldEntry]:
+        lst = getattr(self.tls, "held", None)
+        if lst is None:
+            lst = []
+            self.tls.held = lst
+        return lst
+
+    # -- graph -------------------------------------------------------------
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        """DFS reachability src -> dst over the current adjacency."""
+        stack, seen = [src], {src}
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            for nxt in self.adj.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    def _cycle_path(self, src: str, dst: str) -> List[str]:
+        """One src -> dst path (for the report); graph is tiny."""
+        stack: List[Tuple[str, List[str]]] = [(src, [src])]
+        seen = {src}
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            for nxt in self.adj.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return [src, dst]
+
+    def record_acquire(self, lock: object, name: str, reentrant: bool) -> None:
+        held = self.held()
+        for h in held:
+            if h.lock is lock:
+                if reentrant:
+                    h.depth += 1
+                    return
+                stack = _current_stack()
+                with self.mu:
+                    self.violations.append(
+                        Violation(
+                            kind="self-deadlock",
+                            message=(
+                                f"thread {threading.current_thread().name!r} "
+                                f"re-acquired non-reentrant lock {name!r} it "
+                                "already holds"
+                            ),
+                            stack_b=stack,
+                        )
+                    )
+                # fall through: still track the attempt so release balances
+        if held:
+            holder_names = [h.name for h in held if h.lock is not lock]
+            # steady-state fast path: dict membership is GIL-atomic, so
+            # already-recorded edges never touch the global checker mutex
+            # (taking it on every nested acquire would convoy the very
+            # thread interleavings the checked suite exercises)
+            missing = [
+                hn for hn in holder_names if (hn, name) not in self.edges
+            ]
+            if missing:
+                self._record_edges(name, missing)
+        held.append(
+            _HeldEntry(lock=lock, name=name, t_acquired=time.monotonic())
+        )
+
+    def _record_edges(self, name: str, holder_names: List[str]) -> None:
+        """Slow path: first sighting of held -> name orderings."""
+        with self.mu:
+            for held_name in holder_names:
+                key = (held_name, name)
+                if key in self.edges:  # re-check under the mutex
+                    continue
+                stack = _current_stack()
+                # does name already reach held_name? then adding
+                # held_name -> name closes a cycle
+                if held_name == name:
+                    # two INSTANCES of one lock class nested with no
+                    # defined order: the classic transfer() deadlock
+                    self.violations.append(
+                        Violation(
+                            kind="cycle",
+                            message=(
+                                f"same-class nested acquisition: a "
+                                f"second {name!r} instance acquired "
+                                f"while one is already held — "
+                                "unordered same-class nesting "
+                                "deadlocks under AB/BA interleaving"
+                            ),
+                            stack_b=stack,
+                        )
+                    )
+                elif self._reaches(name, held_name):
+                    path = self._cycle_path(name, held_name)
+                    first = self.edges.get((path[0], path[1]))
+                    self.violations.append(
+                        Violation(
+                            kind="cycle",
+                            message=(
+                                "lock-order cycle: acquiring "
+                                f"{name!r} while holding {held_name!r}, "
+                                "but the reverse ordering "
+                                f"{' -> '.join([held_name] + path)} was "
+                                "already recorded"
+                                + (
+                                    f" (by thread {first.thread!r})"
+                                    if first
+                                    else ""
+                                )
+                            ),
+                            stack_a=first.stack if first else "",
+                            stack_b=stack,
+                        )
+                    )
+                self.edges[key] = _Edge(
+                    thread=threading.current_thread().name, stack=stack
+                )
+                self.adj.setdefault(held_name, set()).add(name)
+
+    def record_release(self, lock: object, name: str) -> None:
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            h = held[i]
+            if h.lock is lock:
+                if h.depth > 1:
+                    h.depth -= 1
+                    return
+                del held[i]
+                hold_ms = _env_hold_ms()
+                if hold_ms is not None:
+                    elapsed = (time.monotonic() - h.t_acquired) * 1000.0
+                    if elapsed > hold_ms:
+                        with self.mu:
+                            self.warnings.append(
+                                Violation(
+                                    kind="long-hold",
+                                    message=(
+                                        f"lock {name!r} held for "
+                                        f"{elapsed:.1f}ms "
+                                        f"(threshold {hold_ms}ms)"
+                                    ),
+                                    stack_b=_current_stack(),
+                                )
+                            )
+                return
+        # release of a lock this thread never recorded (e.g. handed across
+        # threads); nothing to balance
+
+    def reset(self) -> None:
+        with self.mu:
+            self.edges.clear()
+            self.adj.clear()
+            self.violations.clear()
+            self.warnings.clear()
+
+
+_state = _CheckerState()
+_enabled = _env_enabled()
+
+
+def _current_stack() -> str:
+    frames = traceback.extract_stack(limit=_STACK_LIMIT + 4)
+    # drop locks.py's own frames from the tail
+    while frames and frames[-1].filename == __file__:
+        frames.pop()
+    return "".join(traceback.format_list(frames[-_STACK_LIMIT:]))
+
+
+def checking_enabled() -> bool:
+    return _enabled
+
+
+def enable_checking() -> None:
+    """Make FUTURE TrackedLock()/TrackedRLock() calls return checking
+    wrappers (already-created passthrough locks stay raw)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_checking() -> None:
+    global _enabled
+    _enabled = False
+
+
+def violations() -> List[Violation]:
+    with _state.mu:
+        return list(_state.violations)
+
+
+def warnings() -> List[Violation]:
+    with _state.mu:
+        return list(_state.warnings)
+
+
+def reset() -> None:
+    """Clear the order graph and all recorded violations/warnings."""
+    _state.reset()
+
+
+def format_report() -> str:
+    vs = violations()
+    ws = warnings()
+    if not vs and not ws:
+        return "lock check: clean"
+    parts = []
+    for v in vs:
+        parts.append(v.render())
+    for w in ws:
+        parts.append(w.render())
+    return "\n\n".join(parts)
+
+
+class _TrackedLockBase:
+    """Shared wrapper machinery; `_reentrant` set by subclasses."""
+
+    _reentrant = False
+
+    def __init__(self, inner: object, name: str):
+        self._inner = inner
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _state.record_acquire(self, self.name, self._reentrant)
+        got = self._inner.acquire(blocking, timeout)  # type: ignore[attr-defined]
+        if not got:
+            _state.record_release(self, self.name)
+        return bool(got)
+
+    def release(self) -> None:
+        self._inner.release()  # type: ignore[attr-defined]
+        _state.record_release(self, self.name)
+
+    def __enter__(self) -> "_TrackedLockBase":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r} inner={self._inner!r}>"
+
+
+class _TrackedLock(_TrackedLockBase):
+    _reentrant = False
+
+    def __init__(self, name: str):
+        super().__init__(threading.Lock(), name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()  # type: ignore[attr-defined]
+
+    # threading.Condition support: full release/restore around wait()
+    def _release_save(self) -> None:
+        self.release()
+
+    def _acquire_restore(self, _saved: object) -> None:
+        self.acquire()
+
+    def _is_owned(self) -> bool:
+        # best effort (matches Condition's fallback for plain Locks)
+        if self._inner.acquire(False):  # type: ignore[attr-defined]
+            self._inner.release()  # type: ignore[attr-defined]
+            return False
+        return True
+
+
+class _TrackedRLock(_TrackedLockBase):
+    _reentrant = True
+
+    def __init__(self, name: str):
+        super().__init__(threading.RLock(), name)
+
+    def _release_save(self) -> object:
+        # fully unwind recursive ownership (Condition.wait contract)
+        saved = self._inner._release_save()  # type: ignore[attr-defined]
+        _state.record_release(self, self.name)
+        return saved
+
+    def _acquire_restore(self, saved: object) -> None:
+        _state.record_acquire(self, self.name, self._reentrant)
+        self._inner._acquire_restore(saved)  # type: ignore[attr-defined]
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()  # type: ignore[attr-defined]
+
+
+LockLike = Union[threading.Lock, threading.RLock, _TrackedLock, _TrackedRLock]
+
+
+def TrackedLock(name: str) -> "LockLike":
+    """Non-reentrant mutex. `name` is the lock CLASS for order tracking —
+    every instance guarding the same kind of state should share it
+    (e.g. "fragment.mu"). Returns a raw threading.Lock unless checking
+    is enabled."""
+    if not _enabled:
+        return threading.Lock()
+    return _TrackedLock(name)
+
+
+def TrackedRLock(name: str) -> "LockLike":
+    """Reentrant mutex; same-thread re-acquisition is legal and recorded
+    once per outermost hold."""
+    if not _enabled:
+        return threading.RLock()
+    return _TrackedRLock(name)
+
+
+def TrackedCondition(
+    lock: Optional[object] = None, name: str = "condition"
+) -> threading.Condition:
+    """Condition over a tracked lock (wait() releases/re-acquires through
+    the wrapper, keeping the held-set accurate)."""
+    if lock is None:
+        lock = TrackedRLock(name)
+    return threading.Condition(lock)  # type: ignore[arg-type]

@@ -8,16 +8,17 @@ unless verbose. Instances are callable (printf-style) so existing
 from __future__ import annotations
 
 import sys
-import threading
 import time
 from typing import Optional, TextIO
+
+from pilosa_tpu.utils.locks import TrackedLock
 
 
 class Logger:
     def __init__(self, stream: Optional[TextIO] = None, verbose: bool = False):
         self.stream = stream if stream is not None else sys.stderr
         self.verbose = verbose
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("logger.mu")
 
     def _emit(self, msg: str, *args) -> None:
         if args:

@@ -19,12 +19,56 @@ while still feeding the registry so the scrape endpoints keep working.
 from __future__ import annotations
 
 import socket
-import threading
 import time
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from pilosa_tpu.utils.locks import TrackedLock
+
 _HIST_KEEP = 512  # ring buffer per histogram/timing series
+
+# ---------------------------------------------------------------------------
+# Metric-name registry. Every stat name the package emits MUST be declared
+# here (the api-invariants AST pass in pilosa_tpu/analysis/ rejects
+# emissions of undeclared literals, and flags declared-but-never-emitted
+# names as stale). This is the single place to look up what the server can
+# report, and it keeps dashboards/alerts from silently referencing metrics
+# that a refactor renamed away.
+# ---------------------------------------------------------------------------
+
+STAT_NAMES = frozenset(
+    {
+        # query path (server/api.py)
+        "query_n",
+        "query_ms",
+        # distributed writes (exec/distributed.py, server/api.py)
+        "write_replica_dropped",
+        # internode fault tolerance (server/client.py)
+        "internode.retry",
+        "internode.breaker_fastfail",
+        # background tickers (server/node.py)
+        "ticker.error",
+        # runtime gauges (server/node.py monitorRuntime analog)
+        "runtime.max_rss_kb",
+        "runtime.threads",
+        "runtime.gc_objects",
+        "runtime.open_files",
+    }
+)
+
+# Prefixes for families whose full names are built dynamically (e.g.
+# breaker state-transition counters "breaker.open"/"breaker.closed"/
+# "breaker.half_open" in server/faults.py). Dynamic emissions must start
+# with a declared prefix.
+STAT_PREFIXES = frozenset({"breaker."})
+
+
+def is_declared_stat(name: str) -> bool:
+    """True when `name` is a declared metric or under a declared dynamic
+    prefix (used by the static gate; cheap enough for runtime asserts)."""
+    return name in STAT_NAMES or any(
+        name.startswith(p) for p in STAT_PREFIXES
+    )
 
 
 def _key(name: str, tags: Tuple[str, ...]) -> Tuple[str, Tuple[str, ...]]:
@@ -35,7 +79,7 @@ class Registry:
     """Tagged counters / gauges / histograms / sets, shared by all views."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("stats.registry_mu")
         self._counters: Dict[Tuple[str, Tuple[str, ...]], float] = defaultdict(float)
         self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
         self._hists: Dict[Tuple[str, Tuple[str, ...]], List[float]] = defaultdict(list)

@@ -15,10 +15,11 @@ headers.
 from __future__ import annotations
 
 import contextvars
-import threading
 import time
 import uuid
 from typing import Dict, List, Optional
+
+from pilosa_tpu.utils.locks import TrackedLock
 
 # current span for the executing task/thread; entered spans install
 # themselves so nested spans and the internode client pick up the context
@@ -85,7 +86,7 @@ class Tracer:
 
     def __init__(self, keep: int = _RING):
         self.keep = keep
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("tracing.mu")
         self._spans: List[Span] = []
 
     def start_span(self, name: str, parent: Optional[Span] = None) -> Span:
@@ -156,7 +157,7 @@ def inject_http_headers(span, headers: dict) -> dict:
 
 
 _global = Tracer()
-_global_lock = threading.Lock()
+_global_lock = TrackedLock("tracing.global_lock")
 
 
 def global_tracer():

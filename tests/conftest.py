@@ -10,6 +10,16 @@ environment's sitecustomize pre-registers a tunneled TPU backend that would
 otherwise be dialed (and can hang) even for CPU-only tests.
 """
 
+import os
+
+# Lock-discipline checking must be on BEFORE any pilosa_tpu module is
+# imported: module-level locks (plan._DISPATCH_MU, faults._global_mu, ...)
+# are created at import time and only locks created while checking is
+# enabled are tracked. Under this flag every lock in the package records
+# acquisition ordering; any AB/BA cycle or self-deadlock fails the test
+# that produced it (see _lock_discipline_guard below) with both stacks.
+os.environ.setdefault("PILOSA_TPU_LOCK_CHECK", "1")
+
 from pilosa_tpu.utils.cpuonly import force_cpu
 
 force_cpu(8)
@@ -17,10 +27,31 @@ force_cpu(8)
 import numpy as np
 import pytest
 
+from pilosa_tpu.utils import locks
+
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _lock_discipline_guard():
+    """Fail any test whose execution recorded a lock-order cycle or a
+    same-thread re-acquisition of a non-reentrant lock. The order graph
+    accumulates across tests on purpose (an AB edge from one test plus a
+    BA edge from another is still a real ordering conflict in the same
+    process), but violations are attributed to the test that completed
+    the bad pattern."""
+    before = len(locks.violations())
+    yield
+    vs = locks.violations()[before:]
+    if vs:
+        report = "\n\n".join(v.render() for v in vs)
+        pytest.fail(
+            f"lock discipline violated ({len(vs)} finding(s)):\n{report}",
+            pytrace=False,
+        )
 
 
 @pytest.fixture(autouse=True)

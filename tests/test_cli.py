@@ -53,7 +53,10 @@ def test_config_toml_roundtrip():
     cfg = Config()
     cfg.cluster.hosts = ["n0@http://a:1"]
     dumped = cfg.to_toml()
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:
+        import tomli as tomllib
 
     parsed = tomllib.loads(dumped)
     assert parsed["bind"] == cfg.bind

@@ -1,0 +1,252 @@
+"""Tests for the tracked-lock runtime deadlock detector (utils/locks.py).
+
+The detector itself must be trustworthy before the whole suite leans on
+it (conftest fails any test that records a violation): these tests
+construct real AB/BA orderings on two threads and assert the cycle
+report names both acquisition sites, that reentrancy/ordered nesting
+stay clean, and that disabled-mode factories are passthrough-cheap.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.utils import locks
+
+
+@pytest.fixture(autouse=True)
+def _isolated_graph():
+    """Each test here runs on a fresh order graph (these tests seed
+    deliberate violations), but the suite-wide graph accumulated by the
+    other test modules is snapshotted and restored — wiping it would
+    blind conftest's cross-test AB/BA detection for everything collected
+    after this file."""
+    state = locks._state
+    with state.mu:
+        saved = (
+            dict(state.edges),
+            {k: set(v) for k, v in state.adj.items()},
+            list(state.violations),
+            list(state.warnings),
+        )
+    locks.reset()
+    yield
+    with state.mu:
+        state.edges, state.adj = dict(saved[0]), {
+            k: set(v) for k, v in saved[1].items()
+        }
+        state.violations[:] = saved[2]
+        state.warnings[:] = saved[3]
+
+
+def _run_threads(*fns):
+    threads = [threading.Thread(target=fn) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class TestCycleDetection:
+    def test_ab_ba_cycle_on_two_threads_reports_both_sites(self):
+        a = locks.TrackedLock("test.A")
+        b = locks.TrackedLock("test.B")
+        barrier = threading.Event()
+
+        def t1():
+            with a:
+                with b:  # A -> B
+                    pass
+            barrier.set()
+
+        def t2():
+            barrier.wait(5)
+            with b:
+                with a:  # B -> A: closes the cycle
+                    pass
+
+        _run_threads(t1, t2)
+        vs = locks.violations()
+        assert len(vs) == 1
+        v = vs[0]
+        assert v.kind == "cycle"
+        assert "test.A" in v.message and "test.B" in v.message
+        # both acquisition stacks captured, each naming this file
+        assert "test_locks.py" in v.stack_a
+        assert "test_locks.py" in v.stack_b
+        assert "in t1" in v.stack_a
+        assert "in t2" in v.stack_b
+
+    def test_consistent_ordering_is_clean(self):
+        a = locks.TrackedLock("test.A")
+        b = locks.TrackedLock("test.B")
+
+        def worker():
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+
+        _run_threads(worker, worker)
+        assert locks.violations() == []
+
+    def test_three_lock_transitive_cycle(self):
+        a = locks.TrackedLock("test.A")
+        b = locks.TrackedLock("test.B")
+        c = locks.TrackedLock("test.C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:  # A -> B -> C -> A
+                pass
+        vs = locks.violations()
+        assert len(vs) == 1
+        assert vs[0].kind == "cycle"
+        assert "test.A" in vs[0].message and "test.C" in vs[0].message
+
+    def test_same_class_nesting_across_instances_flagged(self):
+        # two *instances* of the same lock class nested: unordered
+        # same-class nesting is the classic transfer() deadlock
+        a1 = locks.TrackedLock("test.same")
+        a2 = locks.TrackedLock("test.same")
+        with a1:
+            with a2:
+                pass
+        vs = locks.violations()
+        assert len(vs) == 1
+        assert vs[0].kind == "cycle"
+
+
+class TestSelfDeadlock:
+    def test_nonreentrant_reacquire_flagged(self):
+        a = locks.TrackedLock("test.self")
+        a.acquire()
+        try:
+            got = a.acquire(blocking=False)
+            assert got is False
+        finally:
+            a.release()
+        vs = locks.violations()
+        assert len(vs) == 1
+        assert vs[0].kind == "self-deadlock"
+        assert "test.self" in vs[0].message
+
+    def test_rlock_reentrancy_clean(self):
+        r = locks.TrackedRLock("test.rlock")
+        with r:
+            with r:
+                with r:
+                    pass
+        assert locks.violations() == []
+
+
+class TestCondition:
+    def test_wait_notify_roundtrip(self):
+        cond = locks.TrackedCondition(name="test.cv")
+        state = {"go": False}
+        hits = []
+
+        def waiter():
+            with cond:
+                ok = cond.wait_for(lambda: state["go"], timeout=5)
+                hits.append(ok)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:
+            state["go"] = True
+            cond.notify_all()
+        t.join(5)
+        assert hits == [True]
+        assert locks.violations() == []
+
+
+class TestPassthrough:
+    def test_disabled_factories_return_raw_primitives(self):
+        assert locks.checking_enabled()  # conftest turned it on
+        locks.disable_checking()
+        try:
+            raw = locks.TrackedLock("p")
+            rawr = locks.TrackedRLock("p")
+            assert isinstance(raw, type(threading.Lock()))
+            assert isinstance(rawr, type(threading.RLock()))
+        finally:
+            locks.enable_checking()
+
+    def test_disabled_factories_add_no_measurable_overhead(self):
+        """Passthrough-cheap: the disabled factory hands back the raw
+        primitive, so acquire/release cost is identical by construction;
+        assert the uncontended loop stays within a loose factor of raw
+        (same object type, so this is really a guard against the factory
+        accidentally returning a wrapper)."""
+        locks.disable_checking()
+        try:
+            tracked = locks.TrackedLock("perf")
+        finally:
+            locks.enable_checking()
+        raw = threading.Lock()
+        n = 20_000
+
+        def loop(lk):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with lk:
+                    pass
+            return time.perf_counter() - t0
+
+        loop(raw), loop(tracked)  # warm
+        t_raw, t_tracked = loop(raw), loop(tracked)
+        assert type(tracked) is type(raw)
+        assert t_tracked < t_raw * 3 + 0.05
+
+    def test_enabled_wrapper_supports_lock_api(self):
+        lk = locks.TrackedLock("test.api")
+        assert lk.acquire(timeout=1)
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+
+
+class TestHoldThreshold:
+    def test_long_hold_recorded_as_warning(self, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_LOCK_HOLD_MS", "1")
+        lk = locks.TrackedLock("test.hold")
+        with lk:
+            time.sleep(0.01)
+        ws = locks.warnings()
+        assert len(ws) == 1
+        assert ws[0].kind == "long-hold"
+        assert "test.hold" in ws[0].message
+        # warnings never fail the suite: violations stay empty
+        assert locks.violations() == []
+
+    def test_fast_hold_not_flagged(self, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_LOCK_HOLD_MS", "5000")
+        lk = locks.TrackedLock("test.hold2")
+        with lk:
+            pass
+        assert locks.warnings() == []
+
+
+class TestReportFormat:
+    def test_format_report_clean(self):
+        assert locks.format_report() == "lock check: clean"
+
+    def test_format_report_renders_violations(self):
+        a = locks.TrackedLock("test.RA")
+        b = locks.TrackedLock("test.RB")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        rep = locks.format_report()
+        assert "[cycle]" in rep
+        assert "test.RA" in rep and "test.RB" in rep
+        assert "first site" in rep and "second site" in rep

@@ -1,0 +1,464 @@
+"""Tier-1 wiring for the static-analysis gate (pilosa_tpu/analysis/).
+
+Two halves:
+
+1. The REAL gate over the repo: every pass, against the committed
+   baseline — the same check `python tools/check.py` runs. A new raw
+   lock, a sleep under a mutex, an impure jit body, an undeclared stat
+   name, or an undocumented config knob fails tier-1 right here with
+   file:line evidence.
+2. The gate's own behavior on seeded violations: each pass family must
+   fire (with correct location) on a synthetic bad module, stale
+   baseline entries must fail, and unjustified baseline entries must be
+   rejected at load time.
+"""
+
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pilosa_tpu import analysis
+from pilosa_tpu.analysis.framework import (
+    Baseline,
+    BaselineEntry,
+    Module,
+    run_gate,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "analysis_baseline.toml")
+
+
+def seeded_module(rel: str, src: str) -> Module:
+    src = textwrap.dedent(src)
+    return Module(
+        path=os.path.join("/tmp", rel),
+        rel=rel,
+        source=src,
+        tree=ast.parse(src),
+    )
+
+
+def findings_for(src: str, rel: str = "pilosa_tpu/_seeded.py"):
+    return analysis.run_passes(
+        analysis.default_passes(), [seeded_module(rel, src)]
+    )
+
+
+# ---------------------------------------------------------------------------
+# the real gate
+# ---------------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_repo_is_clean_under_committed_baseline(self):
+        result = analysis.check(REPO, baseline_path=BASELINE)
+        assert result.ok, "\n" + result.render()
+
+    def test_baseline_is_small_and_fully_justified(self):
+        b = Baseline.load(BASELINE)
+        assert b.entries, "baseline exists but is empty?"
+        for e in b.entries:
+            assert len(e.reason.strip()) > 40, (
+                f"baseline entry {e.code}/{e.path} has a perfunctory "
+                "reason — document WHY the violation is intentional"
+            )
+
+    def test_check_script_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check.py")],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "check: OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# lock hygiene on seeded violations
+# ---------------------------------------------------------------------------
+
+
+class TestLockHygieneSeeded:
+    def test_raw_lock_outside_locks_py(self):
+        fs = findings_for(
+            """
+            import threading
+            _MU = threading.Lock()
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK001"]
+        assert f.line == 3
+        assert "TrackedLock" in f.message
+
+    def test_raw_lock_inside_locks_py_allowed(self):
+        fs = analysis.run_passes(
+            analysis.default_passes(),
+            [
+                seeded_module(
+                    "pilosa_tpu/utils/locks.py",
+                    "import threading\n_MU = threading.Lock()\n",
+                )
+            ],
+        )
+        assert not [f for f in fs if f.code == "LOCK001"]
+
+    def test_sleep_under_lock(self):
+        fs = findings_for(
+            """
+            import time
+            from pilosa_tpu.utils.locks import TrackedLock
+            _MU = TrackedLock("x")
+
+            def f():
+                with _MU:
+                    time.sleep(1.0)
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK002"]
+        assert f.line == 8
+        assert "time.sleep" in f.message and "_MU" in f.message
+
+    def test_network_io_under_self_lock(self):
+        fs = findings_for(
+            """
+            import urllib.request
+
+            class C:
+                def f(self):
+                    with self._mu:
+                        urllib.request.urlopen("http://x")
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK002"]
+        assert "urlopen" in f.message
+
+    def test_device_sync_under_lock(self):
+        fs = findings_for(
+            """
+            class C:
+                def f(self):
+                    with self._lock:
+                        return self.arr.block_until_ready()
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK003"]
+        assert "block_until_ready" in f.message
+
+    def test_closure_under_lock_not_flagged(self):
+        # a function DEFINED under the lock runs later: not a hold
+        fs = findings_for(
+            """
+            import time
+
+            class C:
+                def f(self):
+                    with self._mu:
+                        def later():
+                            time.sleep(1.0)
+                        self.cb = later
+            """
+        )
+        assert not [f for f in fs if f.code == "LOCK002"]
+
+
+# ---------------------------------------------------------------------------
+# jax purity on seeded violations
+# ---------------------------------------------------------------------------
+
+
+class TestJaxPuritySeeded:
+    def test_impure_jit_body_all_rules(self):
+        fs = findings_for(
+            """
+            import functools
+            import jax
+            import numpy as np
+
+            STATS = {"n": 0}
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def g(x, k):
+                print("traced")
+                STATS["n"] += 1
+                v = np.sum(x)
+                return float(x) + x.item() + v
+            """
+        )
+        codes = {f.code for f in fs}
+        assert {"JAX001", "JAX002", "JAX003", "JAX004"} <= codes
+        np_finding = [f for f in fs if f.code == "JAX002"][0]
+        assert "numpy.sum" in np_finding.message
+        assert np_finding.line == 12
+
+    def test_static_argnames_mismatch(self):
+        fs = findings_for(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("missing",))
+            def g(x):
+                return x
+            """
+        )
+        (f,) = [f for f in fs if f.code == "JAX005"]
+        assert "'missing'" in f.message and "g()" in f.message
+
+    def test_static_argnums_out_of_range(self):
+        fs = findings_for(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(5,))
+            def g(x):
+                return x
+            """
+        )
+        (f,) = [f for f in fs if f.code == "JAX005"]
+        assert "out of range" in f.message
+
+    def test_static_coercion_allowed(self):
+        # int() of a STATIC argument is legal (it is a Python value)
+        fs = findings_for(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def g(x, k):
+                return x * int(k)
+            """
+        )
+        assert not [f for f in fs if f.code == "JAX003"]
+
+    def test_pallas_kernel_body_checked(self):
+        fs = findings_for(
+            """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                print("impure")
+                o_ref[...] = x_ref[...]
+
+            def call(x):
+                return pl.pallas_call(kernel, out_shape=None)(x)
+            """
+        )
+        (f,) = [f for f in fs if f.code == "JAX001"]
+        assert "kernel()" in f.message
+
+    def test_pure_jit_clean(self):
+        fs = findings_for(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def g(x):
+                return jnp.sum(x)
+            """
+        )
+        assert not [f for f in fs if f.code.startswith("JAX")]
+
+
+# ---------------------------------------------------------------------------
+# api invariants on seeded violations
+# ---------------------------------------------------------------------------
+
+
+class TestApiInvariantsSeeded:
+    def _with_repo_registry(self, src: str):
+        """Seeded module + the real stats.py (for its STAT_NAMES)."""
+        stats_path = os.path.join(REPO, "pilosa_tpu", "utils", "stats.py")
+        stats_mod = analysis.load_source_module(
+            stats_path, rel="pilosa_tpu/utils/stats.py"
+        )
+        return analysis.run_passes(
+            [analysis.ApiInvariantsPass()],
+            [stats_mod, seeded_module("pilosa_tpu/_seeded.py", src)],
+        )
+
+    def test_undeclared_stat_emission(self):
+        fs = self._with_repo_registry(
+            """
+            class C:
+                def f(self):
+                    self.stats.count("definitely_not_declared")
+            """
+        )
+        assert any(
+            f.code == "API001" and "definitely_not_declared" in f.message
+            for f in fs
+        )
+
+    def test_dynamic_stat_outside_declared_prefix(self):
+        fs = self._with_repo_registry(
+            """
+            class C:
+                def f(self, x):
+                    self.stats.count(f"mystery.{x}")
+            """
+        )
+        assert any(
+            f.code == "API001" and "mystery." in f.message for f in fs
+        )
+
+    def test_declared_prefix_dynamic_ok(self):
+        fs = self._with_repo_registry(
+            """
+            class C:
+                def f(self, state):
+                    self.stats.count(f"breaker.{state}")
+            """
+        )
+        assert not [
+            f
+            for f in fs
+            if f.code == "API001" and "breaker." in f.message
+        ]
+
+    def test_config_flag_doc_invariants(self, tmp_path):
+        config_src = textwrap.dedent(
+            """
+            from dataclasses import dataclass
+
+            @dataclass
+            class ClusterConfig:
+                replicas: int = 1
+                secret_knob: float = 0.0
+
+            @dataclass
+            class Config:
+                bind: str = "localhost:1"
+                cluster: ClusterConfig = None
+            """
+        )
+        main_src = textwrap.dedent(
+            """
+            import argparse
+
+            def build():
+                p = argparse.ArgumentParser()
+                sub = p.add_subparsers()
+                sp = sub.add_parser("server")
+                sp.add_argument("--bind")
+                sp.add_argument("--replicas")
+                sp.add_argument("--orphan-flag")
+                return p
+            """
+        )
+        docs = tmp_path / "configuration.md"
+        docs.write_text("bind = ...\nreplicas = ...\n")  # secret-knob absent
+        config_mod = Module(
+            path=str(tmp_path / "config.py"),
+            rel="pilosa_tpu/cli/config.py",
+            source=config_src,
+            tree=ast.parse(config_src),
+        )
+        main_mod = Module(
+            path=str(tmp_path / "main.py"),
+            rel="pilosa_tpu/cli/main.py",
+            source=main_src,
+            tree=ast.parse(main_src),
+        )
+        fs = analysis.run_passes(
+            [analysis.ApiInvariantsPass(docs_path=str(docs))],
+            [config_mod, main_mod],
+        )
+        codes = {(f.code, f.message) for f in fs}
+        assert any(
+            c == "API003" and "secret_knob" in m for c, m in codes
+        ), fs  # undocumented knob
+        assert any(
+            c == "API004" and "orphan-flag" in m for c, m in codes
+        ), fs  # flag with no knob
+        assert any(
+            c == "API005" and "secret_knob" in m for c, m in codes
+        ), fs  # knob with no flag
+
+    def test_non_stats_receivers_ignored(self):
+        fs = self._with_repo_registry(
+            """
+            class C:
+                def f(self, rb):
+                    return rb.count() + self.plan.count()
+            """
+        )
+        assert not [f for f in fs if f.code == "API001"]
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def test_stale_entry_fails_gate(self):
+        baseline = Baseline(
+            entries=[
+                BaselineEntry(
+                    code="LOCK002",
+                    path="pilosa_tpu/nowhere.py",
+                    match="",
+                    reason="entry that matches nothing",
+                )
+            ]
+        )
+        result = run_gate(analysis.default_passes(), [], baseline)
+        assert not result.ok
+        assert result.stale_entries and "STALE" in result.render()
+
+    def test_baseline_suppresses_matching_finding(self):
+        m = seeded_module(
+            "pilosa_tpu/_seeded.py",
+            """
+            import time
+            from pilosa_tpu.utils.locks import TrackedLock
+            _MU = TrackedLock("x")
+
+            def f():
+                with _MU:
+                    time.sleep(1.0)
+            """,
+        )
+        baseline = Baseline(
+            entries=[
+                BaselineEntry(
+                    code="LOCK002",
+                    path="pilosa_tpu/_seeded.py",
+                    match="time.sleep",
+                    reason="seeded on purpose for this test",
+                )
+            ]
+        )
+        result = run_gate([analysis.LockHygienePass()], [m], baseline)
+        assert result.ok, result.render()
+        assert len(result.suppressed) == 1
+
+    def test_unjustified_entry_rejected_at_load(self, tmp_path):
+        p = tmp_path / "baseline.toml"
+        p.write_text(
+            '[[allow]]\ncode = "LOCK002"\npath = "x.py"\nmatch = ""\n'
+        )
+        with pytest.raises(ValueError, match="reason"):
+            Baseline.load(str(p))
+
+    def test_gate_failure_carries_file_line_evidence(self):
+        m = seeded_module(
+            "pilosa_tpu/_seeded.py",
+            """
+            import threading
+            _MU = threading.Lock()
+            """,
+        )
+        result = run_gate(analysis.default_passes(), [m], Baseline())
+        assert not result.ok
+        assert "pilosa_tpu/_seeded.py:3" in result.render()

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Repository analysis gate: AST passes, and optionally ruff + mypy.
+
+Usage:
+    python tools/check.py              # AST passes against the baseline
+    python tools/check.py --all       # + ruff + mypy (skipped if absent)
+    python tools/check.py --list      # show registered passes
+    python tools/check.py --no-baseline   # raw findings, nothing allowed
+
+Exit code 0 means every enabled checker is clean; any finding not
+covered by tools/analysis_baseline.toml — or any stale baseline entry —
+is a failure. tests/test_static_analysis.py runs the AST half of this
+gate inside tier-1, so CI fails with the same file:line evidence this
+prints.
+
+ruff/mypy are optional: environments without them (the hermetic test
+container) skip those steps with a notice rather than failing, so the
+gate degrades to the AST passes instead of blocking. Their
+configuration lives in pyproject.toml.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from pilosa_tpu import analysis  # noqa: E402
+
+
+def run_ast_passes(baseline: bool) -> int:
+    if baseline:
+        baseline_path = os.path.join(
+            REPO_ROOT, "tools", "analysis_baseline.toml"
+        )
+        result = analysis.check(REPO_ROOT, baseline_path=baseline_path)
+    else:
+        # raw mode: bypass analysis.check()'s baseline auto-discovery
+        modules = analysis.load_modules(REPO_ROOT)
+        result = analysis.run_gate(
+            analysis.default_passes(), modules, baseline=None
+        )
+    if baseline and result.suppressed:
+        print(
+            f"analysis: {len(result.suppressed)} finding(s) covered by "
+            "the committed baseline (tools/analysis_baseline.toml)"
+        )
+    print(result.render())
+    return 0 if result.ok else 1
+
+
+def _tool_available(module: str) -> bool:
+    try:
+        __import__(module)
+        return True
+    except ImportError:
+        return False
+
+
+def run_tool(name: str, args: List[str]) -> int:
+    """Run an optional external checker; missing tools skip, not fail."""
+    if not _tool_available(name):
+        print(f"{name}: not installed here — skipped (config in pyproject.toml)")
+        return 0
+    proc = subprocess.run(
+        [sys.executable, "-m", name, *args], cwd=REPO_ROOT
+    )
+    return proc.returncode
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--all",
+        action="store_true",
+        help="also run ruff and mypy (when installed)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report raw findings, ignoring tools/analysis_baseline.toml",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list registered AST passes"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in analysis.default_passes():
+            print(p.name)
+        return 0
+
+    rc = 0
+    if args.all:
+        rc |= run_tool("ruff", ["check", "pilosa_tpu", "tools", "tests"])
+        rc |= run_tool("mypy", ["pilosa_tpu/analysis", "pilosa_tpu/utils/locks.py"])
+    rc |= run_ast_passes(baseline=not args.no_baseline)
+    if rc == 0:
+        print("check: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
