@@ -51,6 +51,7 @@ _NON_KNOB_FLAGS = {
 _SECTION_CLASSES = {
     "Config": "",
     "ClusterConfig": "cluster",
+    "SchedConfig": "sched",
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",
     "TracingConfig": "tracing",

@@ -43,6 +43,18 @@ class ClusterConfig:
 
 
 @dataclass
+class SchedConfig:
+    # query admission control & QoS (pilosa_tpu/sched/): every query is
+    # admitted before it may dispatch — bounded concurrency, a bounded
+    # deadline/priority-aware queue, 429 load shedding
+    max_concurrent_queries: int = 16  # executing at once; 0 disables sched
+    admission_queue_depth: int = 128  # waiting queries before shedding
+    admission_byte_budget: int = 0  # in-flight device bytes; 0 = HBM budget
+    admission_default_class: str = "interactive"  # headerless queries
+    shed_retry_after: float = 1.0  # Retry-After seconds on 429
+
+
+@dataclass
 class AntiEntropyConfig:
     interval: float = 0.0  # seconds; 0 disables the loop
 
@@ -85,6 +97,7 @@ class Config:
     long_query_time: float = 0.0  # seconds; 0 disables slow-query logging
     max_writes_per_request: int = 5000
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    sched: SchedConfig = field(default_factory=SchedConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -156,6 +169,7 @@ class Config:
             out.append(f"{k} = {_toml_value(v)}")
         for sect_name, sect in (
             ("cluster", self.cluster),
+            ("sched", self.sched),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
             ("tracing", self.tracing),

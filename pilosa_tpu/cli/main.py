@@ -89,6 +89,30 @@ def _build_parser() -> argparse.ArgumentParser:
         help="wall-clock bound on one distributed query fan-out, seconds",
     )
     sp.add_argument(
+        "--max-concurrent-queries", type=int,
+        help="queries executing at once; extra queries queue (0 disables "
+        "admission control)",
+    )
+    sp.add_argument(
+        "--admission-queue-depth", type=int,
+        help="waiting queries before load shedding replies 429",
+    )
+    sp.add_argument(
+        "--admission-byte-budget", type=int,
+        help="in-flight estimated device bytes before queries queue "
+        "(0 = follow the HBM devcache budget)",
+    )
+    sp.add_argument(
+        "--admission-default-class",
+        choices=["interactive", "batch", "internal"],
+        help="priority class for queries without an X-Pilosa-Priority "
+        "header",
+    )
+    sp.add_argument(
+        "--shed-retry-after", type=float,
+        help="Retry-After seconds sent with 429 load-shed responses",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -158,6 +182,11 @@ _FLAG_KNOBS = {
     "breaker_threshold": ("cluster", "breaker_threshold"),
     "breaker_cooldown": ("cluster", "breaker_cooldown"),
     "query_deadline": ("cluster", "query_deadline"),
+    "max_concurrent_queries": ("sched", "max_concurrent_queries"),
+    "admission_queue_depth": ("sched", "admission_queue_depth"),
+    "admission_byte_budget": ("sched", "admission_byte_budget"),
+    "admission_default_class": ("sched", "admission_default_class"),
+    "shed_retry_after": ("sched", "shed_retry_after"),
     "anti_entropy_interval": ("anti_entropy", "interval"),
     "metric_service": ("metric", "service"),
     "metric_host": ("metric", "host"),
@@ -284,6 +313,11 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         breaker_threshold=cfg.cluster.breaker_threshold,
         breaker_cooldown=cfg.cluster.breaker_cooldown,
         query_deadline=cfg.cluster.query_deadline,
+        max_concurrent_queries=cfg.sched.max_concurrent_queries,
+        admission_queue_depth=cfg.sched.admission_queue_depth,
+        admission_byte_budget=cfg.sched.admission_byte_budget,
+        admission_default_class=cfg.sched.admission_default_class,
+        shed_retry_after=cfg.sched.shed_retry_after,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

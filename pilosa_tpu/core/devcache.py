@@ -160,6 +160,19 @@ class DeviceCache:
     def __len__(self) -> int:
         return len(self._entries)
 
+    def stats_snapshot(self) -> Dict[str, int]:
+        """One consistent view of the residency counters (exported as
+        gauges on /metrics and /debug/vars by NodeServer)."""
+        with self._mu:
+            return {
+                "resident_bytes": self._bytes,
+                "entries": len(self._entries),
+                "evictions": self.evictions,
+                "hits": self.hits,
+                "misses": self.misses,
+                "budget_bytes": self.budget_bytes,
+            }
+
 
 # Process-global instance shared by fragments and views. Tests may swap the
 # budget (set_budget) or replace the instance outright.

@@ -22,9 +22,11 @@ gives concurrent requests no cross-request amortization at all.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
 
-from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 from pilosa_tpu.pql import Query
 
 # Bound on calls merged into one execution: keeps lowered plan shapes in a
@@ -47,6 +49,22 @@ def batchable(query: Query) -> bool:
     """Only plain read Counts merge: every call `Count(<one child>)`."""
     return bool(query.calls) and all(
         c.name == "Count" and len(c.children) == 1 for c in query.calls
+    )
+
+
+def batch_eligible(query, shards, opt) -> bool:
+    """Will this request be ROUTED through the batcher? The single
+    source of truth shared by api._query_batched (routing) and
+    api._admit (the adaptive-batching load hint) — two copies of this
+    condition would silently diverge and mis-size the hint."""
+    return (
+        shards is None
+        and not opt.remote
+        and not opt.column_attrs
+        and not opt.exclude_row_attrs
+        and not opt.exclude_columns
+        and isinstance(query, Query)
+        and batchable(query)
     )
 
 
@@ -75,14 +93,31 @@ class CountBatcher:
 
     def __init__(self):
         self._mu = TrackedLock("batcher.mu")
+        # signalled whenever a waiter enqueues; the adaptive leader hold
+        # (see run()) sleeps on it instead of polling
+        self._arrived = TrackedCondition(self._mu, name="batcher.arrived")
         self._busy: Dict[str, bool] = {}
-        self._queue: Dict[str, List[_Waiter]] = {}
+        self._queue: Dict[str, Deque[_Waiter]] = {}
+        # -- adaptive batching (sched/ admission feeds this) --------------
+        # load_hint(index) returns the number of BATCHABLE queries for
+        # `index` currently admitted or queued by the admission
+        # controller — i.e. actual potential batch mates. When it
+        # reports load, a fresh leader HOLDS its dispatch briefly
+        # (hold_timeout) until that many calls have accumulated, so batch
+        # size tracks queue depth (the >=4-queries/sweep plateau from
+        # BENCH_NOTES r3) instead of relying on dispatch-overlap luck.
+        self.load_hint: Optional[Callable[[str], int]] = None
+        self.hold_timeout: float = 0.005  # seconds; bounds added latency
+        # stats client (NodeServer wires its own); emits one
+        # `batcher.batch_size` observation per executed round
+        self.stats = None
 
     def run(self, index: str, query: Query, execute: Callable[[Query], list]):
         with self._mu:
             if self._busy.get(index):
                 w = _Waiter(query)
-                self._queue.setdefault(index, []).append(w)
+                self._queue.setdefault(index, deque()).append(w)
+                self._arrived.notify_all()
             else:
                 self._busy[index] = True
                 w = None
@@ -100,12 +135,43 @@ class CountBatcher:
             if w.error is not None:
                 raise w.error
             return w.results
+        # leadership taken: only NOW consult the scheduler's load hint —
+        # followers and promoted leaders never read it, so the hot path
+        # pays the (locked) hint lookup once per round, not per call
+        target = 0
+        if self.load_hint is not None:
+            try:
+                target = min(int(self.load_hint(index)), MAX_BATCH_CALLS)
+            except Exception:  # noqa: BLE001 - a hint must never fail a query
+                target = 0
+        if target >= 2:
+            # adaptive hold: the admission controller reports `target`
+            # queries in flight/queued — wait (bounded) for them to line
+            # up behind us, then run the whole set as ONE merged dispatch
+            lead = _Waiter(query)
+            deadline = time.monotonic() + self.hold_timeout
+            with self._mu:
+                # target counts QUERIES (the admission hint's unit), so
+                # the lined-up side counts queries too — comparing calls
+                # against a query target would end the hold early for
+                # any multi-call leader
+                while 1 + len(self._queue.get(index, ())) < target:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._arrived.wait(remaining)
+            _bump("leader")
+            self._serve_round(index, execute, first=lead)
+            if lead.error is not None:
+                raise lead.error
+            return lead.results
         return self._lead(index, query, execute)
 
     # -- internals ---------------------------------------------------------
 
     def _lead(self, index: str, query: Query, execute):
         _bump("leader")
+        self._record_round(len(query.calls))
         try:
             return execute(query)
         finally:
@@ -117,34 +183,40 @@ class CountBatcher:
         query), then hand leadership to the first later arrival — or
         release the slot when the queue is empty."""
         with self._mu:
-            round_ = self._queue.get(index, [])
-            self._queue[index] = []
+            round_ = self._queue.get(index) or deque()
+            self._queue[index] = deque()
         if first is not None:
-            round_.insert(0, first)
+            round_.appendleft(first)
         while round_:
             batch: List[_Waiter] = []
             n = 0
             while round_ and n + len(round_[0].query.calls) <= MAX_BATCH_CALLS:
-                wtr = round_.pop(0)
+                wtr = round_.popleft()
                 batch.append(wtr)
                 n += len(wtr.query.calls)
             if not batch:  # single oversized query: run it alone
-                batch = [round_.pop(0)]
+                batch = [round_.popleft()]
             self._run_batch(batch, execute)
         with self._mu:
             queued = self._queue.get(index)
             if queued:
-                nxt = queued.pop(0)
+                nxt = queued.popleft()
                 nxt.promoted = True
                 nxt.event.set()  # takes over; _busy stays held
             else:
                 self._queue.pop(index, None)
                 self._busy.pop(index, None)
 
-    @staticmethod
-    def _run_batch(batch: List[_Waiter], execute) -> None:
+    def _record_round(self, n_calls: int) -> None:
+        """One executed round's size — the observable the scheduler's
+        adaptive hook is judged by (>=4 under load, BENCH_NOTES r3)."""
+        if self.stats is not None:
+            self.stats.histogram("batcher.batch_size", float(n_calls))
+
+    def _run_batch(self, batch: List[_Waiter], execute) -> None:
         if len(batch) == 1:
             w = batch[0]
+            self._record_round(len(w.query.calls))
             try:
                 w.results = execute(w.query)
             except Exception as e:  # noqa: BLE001 - delivered to the waiter
@@ -152,6 +224,7 @@ class CountBatcher:
             w.event.set()
             return
         calls = [c for w in batch for c in w.query.calls]
+        self._record_round(len(calls))
         # pad to a pow2 call count (repeat the last call; extras dropped):
         # the multi-root plan compiles once per size family instead of once
         # per distinct batch size

@@ -171,6 +171,10 @@ class DistributedExecutor(Executor):
                         node_shards,
                         write=write,
                         timeout=max(0.05, deadline.remaining()),
+                        # the peer's admission controller sheds this leg
+                        # (429, retryable) when OUR remaining budget can
+                        # no longer be met in its queue
+                        deadline=max(0.05, deadline.remaining()),
                     )
                 except RemoteError as e:
                     return e
@@ -231,6 +235,7 @@ class DistributedExecutor(Executor):
         node_shards: List[int],
         write: bool = False,
         timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
     ) -> Any:
         if node_id == self.local_id:
             opt = ExecOptions(remote=True)
@@ -243,6 +248,7 @@ class DistributedExecutor(Executor):
                 shards=node_shards,
                 remote=True,
                 timeout=timeout,
+                deadline=deadline,
             )
         except Exception as e:
             # reads: node-down shaped failures fail over to a replica; a
@@ -402,7 +408,13 @@ class DistributedExecutor(Executor):
                     )
                 else:
                     r = self.client.query_node(
-                        n.uri, idx.name, str(c), shards=[shard], remote=True
+                        n.uri, idx.name, str(c), shards=[shard], remote=True,
+                        # bound the peer-side admission wait: without a
+                        # deadline a saturated peer parks this leg's
+                        # handler thread indefinitely — long after we
+                        # timed out and recorded pending-repair debt
+                        timeout=self.query_deadline,
+                        deadline=self.query_deadline,
                     )[0]
                 changed = changed or bool(r)
             except Exception as e:
@@ -462,7 +474,12 @@ class DistributedExecutor(Executor):
         def send(n):
             try:
                 self.client.query_node(
-                    n.uri, idx.name, pql, shards=None, remote=True
+                    n.uri, idx.name, pql, shards=None, remote=True,
+                    # deadline-bounded so a saturated peer sheds the
+                    # broadcast early instead of parking it forever
+                    # (drift repairs via anti-entropy either way)
+                    timeout=self.query_deadline,
+                    deadline=self.query_deadline,
                 )
             except Exception:
                 pass  # attr drift repairs via anti-entropy

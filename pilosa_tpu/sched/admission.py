@@ -1,0 +1,719 @@
+"""Admission controller: bounded, deadline- and priority-aware queueing.
+
+The shape is the admission-control/continuous-batching front end of a
+production inference stack, applied to bitmap queries:
+
+- at most `max_concurrent` queries execute at once (the dispatch mutex
+  in exec/plan.py serializes device programs anyway — everything past
+  the cap would only pile onto that lock and blow out tail latency);
+- while the in-flight device-byte account (estimated per-query by
+  sched/cost.py, budget shared with core/devcache.py's HBM residency
+  budget) is full, further queries WAIT in per-class FIFO queues;
+- the queues are drained weighted-fair (classic WFQ virtual finish
+  times): `interactive` dequeues ahead of `batch` whenever both wait,
+  without ever starving `batch`; `internal` (internode fan-out legs)
+  sits between them;
+- the queue is BOUNDED and deadline-aware: when it is full, or an
+  entry's deadline can no longer be met, the query is shed with
+  `ShedError` -> HTTP 429 + Retry-After (retryable per server/faults.py,
+  so remote nodes' retries/failover absorb the shed).
+
+Clock is injectable; the unit tests drive expiry with a fake clock and
+never sleep. Controllers register in a weak set so the test suite's
+leak guard can assert no shed/finished query leaves a queue entry or a
+held slot behind.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from collections import deque
+from typing import Callable, Deque, Dict, Optional
+
+from pilosa_tpu.sched.cost import QueryCost, ZERO_COST
+from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+
+# Request headers understood by the query routes. Priority selects the
+# class; deadline carries the REMAINING seconds of the sender's budget
+# (the distributed executor stamps its fan-out legs with
+# `deadline.remaining()` so a remote node sheds early instead of timing
+# out late).
+PRIORITY_HEADER = "X-Pilosa-Priority"
+DEADLINE_HEADER = "X-Pilosa-Deadline"
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_BATCH = "batch"
+CLASS_INTERNAL = "internal"
+
+# WFQ weights: higher weight -> earlier virtual finish -> dequeues first.
+CLASS_WEIGHTS: Dict[str, float] = {
+    CLASS_INTERACTIVE: 8.0,
+    CLASS_INTERNAL: 4.0,
+    CLASS_BATCH: 1.0,
+}
+
+# test-suite leak guard (tests/conftest.py): every live controller must
+# be idle (no queued entries, no held slots) between tests
+_live_controllers: "weakref.WeakSet[AdmissionController]" = weakref.WeakSet()
+
+
+def leaked_state() -> list:
+    """(controller-id, queued, inflight) for every non-idle controller."""
+    out = []
+    for ctl in list(_live_controllers):
+        queued, inflight = ctl.pending()
+        if queued or inflight:
+            out.append((id(ctl), queued, inflight))
+    return out
+
+
+class ShedError(Exception):
+    """Load shed: the caller should reply 429 with Retry-After.
+
+    Deliberately NOT an ApiError/ExecError subclass — those map to
+    4xx/200-with-error payloads on various routes; shedding must surface
+    as a real 429 so server/faults.py classifies it retryable."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.status = 429
+
+
+class Ticket:
+    """A granted admission: holds one concurrency slot and the query's
+    device-byte weight until release(). Context-manager friendly."""
+
+    __slots__ = (
+        "cls", "cost", "waited", "batchable", "index", "granted_at",
+        "leg", "_controller", "_released", "_batch_done",
+    )
+
+    def __init__(self, controller: "AdmissionController", cls: str,
+                 cost: QueryCost, waited: float, batchable: bool = False,
+                 index: Optional[str] = None, granted_at: float = 0.0,
+                 leg: bool = False):
+        self._controller = controller
+        self._released = False
+        self._batch_done = False
+        self.cls = cls
+        self.cost = cost
+        self.batchable = batchable
+        self.index = index
+        self.granted_at = granted_at  # controller-clock time of the grant
+        self.leg = leg  # internal fan-out leg (separate admission lane)
+        self.waited = waited  # seconds spent queued before the grant
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._controller._release(self)
+
+    def done_batching(self) -> None:
+        """Drop this query from the adaptive-batching load hint NOW —
+        its batcher round is over, only result slicing/serialization
+        remains, so it can no longer be anyone's batch mate. Leaving it
+        counted until release() would make fresh Count leaders hold a
+        window for mates that cannot arrive."""
+        if self._released or self._batch_done or not self.batchable:
+            return
+        self._batch_done = True
+        self._controller._release_batchable(self)
+
+    def __enter__(self) -> "Ticket":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+class _Entry:
+    __slots__ = (
+        "cls", "cost", "deadline_at", "enq_at", "batchable", "index",
+        "granted", "shed",
+    )
+
+    def __init__(self, cls: str, cost: QueryCost, deadline_at: Optional[float],
+                 enq_at: float, batchable: bool = False,
+                 index: Optional[str] = None):
+        self.cls = cls
+        self.cost = cost
+        self.deadline_at = deadline_at
+        self.enq_at = enq_at
+        self.batchable = batchable
+        self.index = index
+        self.granted = False
+        self.shed = False
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_concurrent: int = 16,
+        queue_depth: int = 128,
+        byte_budget: int = 0,  # 0 = follow devcache's HBM budget
+        default_class: str = CLASS_INTERACTIVE,
+        retry_after: float = 1.0,
+        stats=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        if default_class not in CLASS_WEIGHTS:
+            # operator config (vs. request headers, which normalize):
+            # silently promoting a typo like "bach" to interactive would
+            # invert the intended deprioritization with no signal
+            raise ValueError(
+                f"unknown admission default class {default_class!r}; "
+                f"expected one of {sorted(CLASS_WEIGHTS)}"
+            )
+        self.max_concurrent = max_concurrent
+        self.max_queue_depth = max(0, queue_depth)
+        self._byte_budget = byte_budget
+        self.default_class = default_class
+        self.retry_after = retry_after
+        self.stats = stats
+        self._clock = clock
+        self._cv = TrackedCondition(TrackedLock("sched.mu"))
+        self._queues: Dict[str, Deque[_Entry]] = {}
+        self._vtime: Dict[str, float] = {c: 0.0 for c in CLASS_WEIGHTS}
+        # global virtual clock: the start tag of the entry most recently
+        # granted from the queue (SFQ). A class re-activating after idling
+        # jumps UP to it (no banked advantage) and a class that banked
+        # debt during a solo-saturation epoch is measured against it, so
+        # its residual handicap is bounded by ~one service quantum instead
+        # of growing without bound (no 429-starvation on re-entry).
+        self._vglobal = 0.0
+        self._inflight = 0
+        self._inflight_bytes = 0
+        # EWMA of per-query service seconds (grant -> release), feeding
+        # the early-shed deadline feasibility estimate (per lane: legs
+        # run shard subsets, so their service time differs from whole
+        # coordinator queries)
+        self._svc_ewma = 0.0
+        self._leg_svc_ewma = 0.0
+        # SEPARATE lane for internal fan-out legs (remote=True): a
+        # coordinator holds its own node's slot while it blocks on its
+        # legs, and each leg must be admitted on the peer — if legs
+        # competed for the peers' coordinator slots, two nodes could
+        # hold-and-wait on each other until every deadline expired
+        # (distributed deadlock). Legs never fan out further (they run
+        # local shards only), so a leg-only lane has no wait cycle; it
+        # is bounded by the same cap/queue-depth and deadline-sheds the
+        # same way. Waiters are a real FIFO: freed slots hand off to the
+        # OLDEST waiter, so a steady arrival stream cannot starve a
+        # parked leg past its deadline.
+        self._inflight_leg = 0
+        self._leg_waiters: Deque[_Entry] = deque()
+        # batchable (pure-Count, batcher-eligible) queries in flight,
+        # PER INDEX: the count batcher's adaptive-hold hint counts ONLY
+        # these — Row/TopN/remote traffic can never join a count batch,
+        # the batcher queues per index so other-index Counts are not
+        # batch mates either, and an inflated hint would tax every solo
+        # Count with a full hold window under mixed load
+        self._inflight_batchable: Dict[Optional[str], int] = {}
+        # queued counterpart kept as an O(1) counter — the hint is read
+        # on the query hot path, and scanning whole queues under
+        # sched.mu there would serialize admission behind it
+        self._queued_batchable: Dict[Optional[str], int] = {}
+        _live_controllers.add(self)
+
+    # -- public surface ----------------------------------------------------
+
+    def normalize_class(self, raw: Optional[str]) -> str:
+        raw = (raw or "").strip().lower()
+        return raw if raw in CLASS_WEIGHTS else self.default_class
+
+    def admit(
+        self,
+        cls: Optional[str] = None,
+        cost: Optional[QueryCost] = None,
+        deadline: Optional[float] = None,
+        batchable: bool = False,
+        index: Optional[str] = None,
+        leg: bool = False,
+    ) -> Ticket:
+        """Block until the query may execute; returns the Ticket to
+        release when it finishes. Raises ShedError (-> 429) when the
+        queue is full or `deadline` (remaining seconds) cannot be met.
+        `batchable` marks pure-Count queries eligible for the count
+        batcher — only those feed the per-`index` adaptive-batching
+        load hint. `leg` routes internal fan-out legs through their own
+        lane (see __init__: sharing the coordinator slots would allow a
+        distributed hold-and-wait deadlock)."""
+        cost = cost or ZERO_COST
+        cls = self.normalize_class(cls)
+        t0 = self._clock()
+        deadline_at = t0 + deadline if deadline is not None else None
+        if leg:
+            return self._admit_leg(cls, cost, deadline, deadline_at, t0)
+        shed_why: Optional[str] = None
+        waited = 0.0
+        with self._cv:
+            if deadline is not None and deadline <= 0:
+                shed_why = "deadline already exhausted on arrival"
+            elif (
+                not self._queued_total_locked()
+                and self._inflight < self.max_concurrent
+                and self._bytes_ok_locked(cost)
+            ):
+                self._account_grant_locked(
+                    cls, cost, queued=False, batchable=batchable, index=index
+                )
+            elif self._queued_total_locked() >= self.max_queue_depth:
+                shed_why = "admission queue full"
+            elif deadline_at is not None and not self._deadline_feasible_locked(
+                deadline_at
+            ):
+                # EARLY shed: the learned service rate says this deadline
+                # cannot be met from the back of the queue — reject NOW,
+                # while the sender still has budget to re-map the leg to
+                # a replica, instead of discovering the miss only when
+                # the deadline expires
+                shed_why = "deadline cannot be met from the back of the queue"
+            else:
+                entry = _Entry(
+                    cls, cost, deadline_at, t0, batchable=batchable,
+                    index=index,
+                )
+                q = self._queues.get(cls)
+                if q is None:
+                    q = self._queues[cls] = deque()
+                if not q:
+                    # a (re-)activating class competes from NOW: lift its
+                    # virtual time to the global clock / live floor so an
+                    # idle class banks no credit — and any debt banked
+                    # during a solo-saturation epoch shrinks to ~1 quantum
+                    self._vtime[cls] = max(
+                        self._vtime[cls],
+                        self._vglobal,
+                        self._vtime_floor_locked(),
+                    )
+                q.append(entry)
+                if entry.batchable:
+                    self._queued_batchable[index] = (
+                        self._queued_batchable.get(index, 0) + 1
+                    )
+                # work-conserving on ARRIVAL too: the fast path is
+                # skipped whenever anything is queued, but this entry
+                # (or another class's head) may fit right now — e.g. a
+                # cheap query arriving behind a byte-gated fat head
+                # with slots free must not wait for a release
+                self._pump_locked()
+                while not entry.granted and not entry.shed:
+                    timeout = None
+                    if entry.deadline_at is not None:
+                        timeout = entry.deadline_at - self._clock()
+                        if timeout <= 0:
+                            break
+                    self._cv.wait(timeout)
+                if not entry.granted:
+                    # deadline ran out in the queue (or a pump pass
+                    # already purged us): drop the entry — a shed query
+                    # must never leave a queue residue — and pump: our
+                    # departure may unblock entries behind us (e.g. a
+                    # byte-gated fat head expiring with cheap queries
+                    # queued after it)
+                    try:
+                        self._queues[cls].remove(entry)
+                        self._dequeued_batchable_locked(entry)
+                    except (KeyError, ValueError):
+                        pass
+                    self._pump_locked()
+                    shed_why = "deadline cannot be met in queue"
+                else:
+                    waited = self._clock() - t0
+            gauges = self._gauge_values_locked()
+        return self._finish_admit(
+            cls, cost, shed_why, waited, batchable, index, t0, gauges
+        )
+
+    def _admit_leg(
+        self,
+        cls: str,
+        cost: QueryCost,
+        deadline: Optional[float],
+        deadline_at: Optional[float],
+        t0: float,
+    ) -> Ticket:
+        """Internal fan-out legs: own concurrency lane (same cap and
+        waiting bound, FIFO, deadline-aware) so legs never compete with
+        coordinator slots — legs run local shards only, so this lane has
+        no wait cycle and always drains."""
+        shed_why: Optional[str] = None
+        waited = 0.0
+        with self._cv:
+            if deadline is not None and deadline <= 0:
+                shed_why = "deadline already exhausted on arrival"
+            elif (
+                self._inflight_leg < self.max_concurrent
+                and not self._leg_waiters
+            ):
+                self._inflight_leg += 1
+                # legs ACCOUNT bytes (so public admission sees the real
+                # HBM pressure where shard work actually lands) but are
+                # never byte-GATED: a leg waiting on bytes held by a
+                # coordinator that is itself waiting on remote legs
+                # would recreate the cross-node hold-and-wait cycle
+                self._inflight_bytes += cost.device_bytes
+            elif len(self._leg_waiters) >= self.max_queue_depth:
+                shed_why = "internal-leg queue full"
+            elif deadline_at is not None and not self._leg_feasible_locked(
+                deadline_at
+            ):
+                # EARLY shed — this is the lane X-Pilosa-Deadline
+                # actually arrives on: reject while the SENDER still has
+                # budget to re-map the leg to a replica, instead of
+                # burning its whole budget to learn the miss at expiry
+                shed_why = "deadline cannot be met from the back of the queue"
+            else:
+                # strict FIFO handoff: grants come only from
+                # _pump_legs_locked popping the HEAD, so a new arrival
+                # can never beat an earlier parked waiter to a freed
+                # slot — a steady stream would otherwise win every
+                # post-release race and starve waiters past deadline
+                entry = _Entry(cls, cost, deadline_at, t0)
+                self._leg_waiters.append(entry)
+                while not entry.granted and not entry.shed:
+                    timeout = None
+                    if entry.deadline_at is not None:
+                        timeout = entry.deadline_at - self._clock()
+                        if timeout <= 0:
+                            break
+                    self._cv.wait(timeout)
+                if not entry.granted:
+                    try:
+                        self._leg_waiters.remove(entry)
+                    except ValueError:
+                        pass
+                    shed_why = "deadline cannot be met in queue"
+                else:
+                    waited = self._clock() - t0
+            gauges = self._gauge_values_locked()
+        return self._finish_admit(
+            cls, cost, shed_why, waited, batchable=False, index=None,
+            t0=t0, gauges=gauges, leg=True,
+        )
+
+    def _finish_admit(
+        self,
+        cls: str,
+        cost: QueryCost,
+        shed_why: Optional[str],
+        waited: float,
+        batchable: bool,
+        index: Optional[str],
+        t0: float,
+        gauges: tuple,
+        leg: bool = False,
+    ) -> Ticket:
+        # stats I/O happens OUTSIDE the lock: with the statsd backend
+        # every emission is a UDP sendto, and syscalls under sched.mu
+        # would serialize ALL admission behind the metrics socket (the
+        # blocking-host-work-under-lock shape LOCK002 exists to reject)
+        self._emit_gauges(gauges)
+        stats = (
+            self.stats.with_tags(f"class:{cls}")
+            if self.stats is not None
+            else None
+        )
+        if shed_why is not None:
+            if stats is not None:
+                stats.count("sched.shed", 1)
+            raise ShedError(
+                f"query shed ({shed_why}); retry after {self.retry_after:g}s",
+                retry_after=self.retry_after,
+            )
+        if stats is not None:
+            stats.count("sched.admit", 1)
+            stats.timing("sched.wait_ms", waited)
+        return Ticket(
+            self, cls, cost, waited, batchable=batchable, index=index,
+            granted_at=t0 + waited, leg=leg,
+        )
+
+    def _pump_legs_locked(self) -> None:
+        """FIFO grant for the leg lane: freed slots go to the oldest
+        live waiter; expired heads are purged (their waiter raises)."""
+        now = self._clock()
+        touched = False
+        while self._inflight_leg < self.max_concurrent and self._leg_waiters:
+            head = self._leg_waiters.popleft()
+            touched = True
+            if head.deadline_at is not None and head.deadline_at <= now:
+                head.shed = True
+                continue
+            head.granted = True
+            self._inflight_leg += 1
+            self._inflight_bytes += head.cost.device_bytes
+        if touched:
+            self._cv.notify_all()
+
+    def _release(self, ticket: Ticket) -> None:
+        if ticket.leg:
+            with self._cv:
+                self._inflight_leg -= 1
+                self._inflight_bytes -= ticket.cost.device_bytes
+                dt = max(0.0, self._clock() - ticket.granted_at)
+                self._leg_svc_ewma = (
+                    dt
+                    if self._leg_svc_ewma <= 0.0
+                    else 0.8 * self._leg_svc_ewma + 0.2 * dt
+                )
+                self._pump_legs_locked()
+                # freed leg bytes may unblock byte-gated PUBLIC heads
+                self._pump_locked()
+                gauges = self._gauge_values_locked()
+                self._cv.notify_all()
+            self._emit_gauges(gauges)
+            return
+        with self._cv:
+            self._inflight -= 1
+            self._inflight_bytes -= ticket.cost.device_bytes
+            if ticket.batchable and not ticket._batch_done:
+                self._drop_batchable_locked(ticket.index)
+            # learned service time drives the early-shed feasibility check
+            dt = max(0.0, self._clock() - ticket.granted_at)
+            self._svc_ewma = (
+                dt
+                if self._svc_ewma <= 0.0
+                else 0.8 * self._svc_ewma + 0.2 * dt
+            )
+            self._pump_locked()
+            gauges = self._gauge_values_locked()
+            self._cv.notify_all()
+        self._emit_gauges(gauges)
+
+    def _drop_batchable_locked(self, index: Optional[str]) -> None:
+        left = self._inflight_batchable.get(index, 0) - 1
+        if left > 0:
+            self._inflight_batchable[index] = left
+        else:
+            self._inflight_batchable.pop(index, None)
+
+    def _dequeued_batchable_locked(self, entry: _Entry) -> None:
+        """Keep the O(1) queued-batchable counter in step with every
+        path that removes an entry from a class queue."""
+        if not entry.batchable:
+            return
+        left = self._queued_batchable.get(entry.index, 0) - 1
+        if left > 0:
+            self._queued_batchable[entry.index] = left
+        else:
+            self._queued_batchable.pop(entry.index, None)
+
+    def _release_batchable(self, ticket: Ticket) -> None:
+        """Ticket.done_batching(): the hint-relevant part of the query
+        is over even though the slot is still held."""
+        with self._cv:
+            self._drop_batchable_locked(ticket.index)
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._queued_total_locked()
+
+    def load(self, index: Optional[str] = None) -> int:
+        """BATCHABLE queries on `index` that could line up behind a batch
+        leader — the adaptive-batching hint fed to exec/batcher.py's
+        CountBatcher (which queues per index). Only batcher-eligible
+        (pure-Count, same-index) traffic counts: Row/TopN/remote queries
+        and other indexes' Counts can never join this batch, and
+        inflating the hint with them would tax every solo Count a full
+        hold window under mixed load. Capped at max_concurrent: queued
+        queries hold no ticket, so at most the concurrency cap's worth
+        of calls can ever reach the batcher simultaneously."""
+        with self._cv:
+            return min(
+                self._inflight_batchable.get(index, 0)
+                + self._queued_batchable.get(index, 0),
+                self.max_concurrent,
+            )
+
+    def pending(self) -> tuple:
+        """(queued, inflight) across BOTH lanes (leak-guard surface)."""
+        with self._cv:
+            return (
+                self._queued_total_locked() + len(self._leg_waiters),
+                self._inflight + self._inflight_leg,
+            )
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "inflight": self._inflight,
+                "inflightBytes": self._inflight_bytes,
+                "inflightLegs": self._inflight_leg,
+                "waitingLegs": len(self._leg_waiters),
+                "queued": {
+                    cls: len(q) for cls, q in self._queues.items() if q
+                },
+                "maxConcurrent": self.max_concurrent,
+                "queueDepth": self.max_queue_depth,
+                "byteBudget": self._effective_byte_budget(),
+            }
+
+    # -- internals (all *_locked run under self._cv) -----------------------
+
+    def _effective_byte_budget(self) -> int:
+        if self._byte_budget > 0:
+            return self._byte_budget
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+        return DEVICE_CACHE.budget_bytes
+
+    def _bytes_ok_locked(self, cost: QueryCost) -> bool:
+        budget = self._effective_byte_budget()
+        if cost.device_bytes > budget:
+            # a query heavier than the whole budget still runs — alone
+            # w.r.t. BYTES (byte-weightless writes may share) — exactly
+            # like devcache admits a single over-budget entry
+            return self._inflight_bytes == 0
+        return self._inflight_bytes + cost.device_bytes <= budget
+
+    def _fits_with_reservation_locked(
+        self, cost: QueryCost, reserved: QueryCost
+    ) -> bool:
+        """May this entry be granted while `reserved` (a byte-gated WFQ
+        head) waits for bytes? Zero-byte work always may (it cannot
+        delay the head); byte-weighted work only if it leaves the head's
+        earmark intact — which, while the head is actually gated, it
+        cannot, so the earmark drains and the head is never starved."""
+        if cost.device_bytes == 0:
+            return True
+        return (
+            self._inflight_bytes
+            + cost.device_bytes
+            + reserved.device_bytes
+            <= self._effective_byte_budget()
+        )
+
+    def _queued_total_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _vtime_floor_locked(self) -> float:
+        active = [
+            self._vtime[cls] for cls, q in self._queues.items() if q
+        ]
+        return min(active) if active else 0.0
+
+    def _account_grant_locked(
+        self, cls: str, cost: QueryCost, queued: bool, batchable: bool,
+        index: Optional[str],
+    ) -> None:
+        self._inflight += 1
+        self._inflight_bytes += cost.device_bytes
+        if batchable:
+            self._inflight_batchable[index] = (
+                self._inflight_batchable.get(index, 0) + 1
+            )
+        if queued:
+            # WFQ credit is consumed only by CONTENDED grants: advancing
+            # virtual time on uncontended fast-path grants would bank a
+            # huge lag for whichever class idles, inverting the priority
+            # order for many rounds at the moment contention starts.
+            # The global clock advances to the granted entry's start tag
+            # (SFQ), anchoring later (re-)activations.
+            start = self._vtime.get(cls, 0.0)
+            self._vglobal = max(self._vglobal, start)
+            self._vtime[cls] = start + 1.0 / CLASS_WEIGHTS[cls]
+
+    def _pump_locked(self) -> None:
+        """Grant queued entries while capacity allows, WFQ order: the
+        class whose head would FINISH first in virtual time (vtime +
+        1/weight) wins — interactive's small increments beat batch's big
+        ones whenever both queues are non-empty. A byte-gated head
+        blocks only ITS class (per-class FIFO preserved) and RESERVES
+        its bytes: byte-weightless entries from other classes are still
+        granted (work-conserving for writes), but byte-weighted ones
+        must not eat the earmark — otherwise a steady cheap stream
+        could refill the budget forever and starve the gated head."""
+        now = self._clock()
+        granted_any = False
+        byte_blocked: set = set()
+        reserved: Optional[QueryCost] = None
+        while self._inflight < self.max_concurrent:
+            best_cls = None
+            best_finish = 0.0
+            for cls, q in self._queues.items():
+                if cls in byte_blocked:
+                    continue
+                while q and q[0].deadline_at is not None and q[0].deadline_at <= now:
+                    expired = q.popleft()
+                    self._dequeued_batchable_locked(expired)
+                    expired.shed = True  # its waiter raises ShedError
+                    granted_any = True  # wake it
+                if not q:
+                    continue
+                finish = self._vtime[cls] + 1.0 / CLASS_WEIGHTS[cls]
+                if best_cls is None or finish < best_finish:
+                    best_cls, best_finish = cls, finish
+            if best_cls is None:
+                break
+            head = self._queues[best_cls][0]
+            if not self._bytes_ok_locked(head.cost):
+                if reserved is None:
+                    reserved = head.cost  # earmark its bytes
+                byte_blocked.add(best_cls)
+                continue  # other classes may still have grantable heads
+            if reserved is not None and not self._fits_with_reservation_locked(
+                head.cost, reserved
+            ):
+                byte_blocked.add(best_cls)
+                continue
+            self._queues[best_cls].popleft()
+            self._dequeued_batchable_locked(head)
+            head.granted = True
+            self._account_grant_locked(
+                best_cls,
+                head.cost,
+                queued=True,
+                batchable=head.batchable,
+                index=head.index,
+            )
+            granted_any = True
+        if granted_any:
+            self._cv.notify_all()
+
+    def _deadline_feasible_locked(self, deadline_at: float) -> bool:
+        """Can a query joining the back of the queue RIGHT NOW plausibly
+        start before `deadline_at`? Uses the learned per-query service
+        EWMA: `ahead` queries drain over max_concurrent lanes, so the
+        expected wait is ~rounds x svc. Conservative on purpose — with
+        no history (ewma 0) every deadline is feasible, and a feasible
+        verdict only means "queue and see" (the in-queue expiry check
+        still sheds a miss); an infeasible verdict sheds immediately so
+        the sender re-maps while it still has deadline budget."""
+        if self._svc_ewma <= 0.0:
+            return True
+        ahead = self._queued_total_locked() + self._inflight
+        rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
+        return self._clock() + rounds * self._svc_ewma <= deadline_at
+
+    def _leg_feasible_locked(self, deadline_at: float) -> bool:
+        """Leg-lane counterpart of _deadline_feasible_locked, against the
+        leg service EWMA (legs run shard subsets — different timings)."""
+        if self._leg_svc_ewma <= 0.0:
+            return True
+        ahead = len(self._leg_waiters) + self._inflight_leg
+        rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
+        return self._clock() + rounds * self._leg_svc_ewma <= deadline_at
+
+    def _gauge_values_locked(self) -> tuple:
+        # gauges cover BOTH lanes (like pending()): a node shedding legs
+        # with "internal-leg queue full" must not look idle on /metrics
+        return (
+            self._queued_total_locked() + len(self._leg_waiters),
+            self._inflight + self._inflight_leg,
+            self._inflight_bytes,
+        )
+
+    def _emit_gauges(self, vals: tuple) -> None:
+        """Called WITHOUT the lock held (statsd emission is a syscall)."""
+        if self.stats is None:
+            return
+        queued, inflight, inflight_bytes = vals
+        self.stats.gauge("sched.queue_depth", queued)
+        self.stats.gauge("sched.inflight", inflight)
+        self.stats.gauge("sched.inflight_bytes", inflight_bytes)

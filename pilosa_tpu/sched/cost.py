@@ -1,0 +1,156 @@
+"""Per-query cost estimation for admission control.
+
+Admission must be weighted by real HBM pressure, not query count: a
+`Count(Row(f=1))` touches one `uint32[S, W]` row stack while a BSI
+`Row(v > 7)` drags `bit_depth + 2` plane stacks onto the device. The
+estimator walks the parsed PQL call tree — the same structure
+exec/executor.py lowers to a plan — and prices it with exactly the
+accounting `_stack_guard` uses for `BudgetExceeded`: one row stack is
+`n_shards * WORDS_PER_ROW * 4` bytes, and no single dispatch may hold
+more than a quarter of the devcache budget (larger queries are chunked
+by the executor, so the *peak* per-dispatch residency is capped at that
+quarter while the *sweep count* grows instead).
+
+The estimate is intentionally cheap (no lowering, no fragment access)
+and intentionally conservative-but-bounded: admission weighting, not
+billing. Estimation must never fail a query — any error degrades to
+ZERO_COST and the query is admitted on the concurrency cap alone.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from pilosa_tpu.pql import Call, Query
+
+# Row-stack equivalents charged for rank/tally calls (TopN, GroupBy):
+# they tile over the field's rows in bounded chunks rather than stacking
+# everything at once (executor tally bundles), so a flat charge models
+# the working set without reading fragment row counts at admission time.
+_TALLY_ROW_EQUIV = 16
+
+# Plane count assumed for a BSI reference whose field can't be resolved
+# at admission time (index/field not created yet — the executor will
+# reject it later; admission just needs a finite weight).
+_DEFAULT_BSI_PLANES = 18
+
+_WRITE_CALLS = frozenset(
+    {"Set", "Clear", "Store", "ClearRow", "SetRowAttrs", "SetColumnAttrs"}
+)
+
+
+@dataclass(frozen=True)
+class QueryCost:
+    """What one query costs to run.
+
+    device_bytes — estimated PEAK per-dispatch operand residency (bytes);
+    sweeps — estimated jitted dispatches (chunking inflates this, never
+    the peak); write — mutates data (writes skip stacked lowering, so
+    they carry no device weight, but they still hold a concurrency slot).
+    """
+
+    device_bytes: int = 0
+    sweeps: int = 0
+    write: bool = False
+
+
+ZERO_COST = QueryCost()
+
+
+def _bsi_planes(idx, field_name: Optional[str]) -> int:
+    """Plane stacks a BSI reference to `field_name` materializes:
+    bit_depth magnitude planes + sign + existence."""
+    if idx is not None and field_name:
+        f = idx.field(field_name)
+        depth = getattr(getattr(f, "options", None), "bit_depth", 0) if f else 0
+        if depth:
+            return depth + 2
+    return _DEFAULT_BSI_PLANES
+
+
+def _call_rows(idx, c: Call) -> float:
+    """Row-stack equivalents the call's operand set occupies."""
+    if c.name in _WRITE_CALLS:
+        return 0.0
+    rows = 0.0
+    if c.name == "Row":
+        conds = c.condition_args()
+        if conds:
+            for fname in conds:
+                rows += _bsi_planes(idx, fname)
+        else:
+            rows += 1.0
+    elif c.name in ("Sum", "Min", "Max"):
+        fname = c.args.get("field") or c.args.get("_field")
+        fname = fname if isinstance(fname, str) else None
+        rows += _bsi_planes(idx, fname)
+    elif c.name in ("TopN", "GroupBy", "Rows"):
+        rows += _TALLY_ROW_EQUIV
+    elif c.name == "Not":
+        rows += 1.0  # the existence stack
+    for child in c.children:
+        rows += _call_rows(idx, child)
+    for v in c.args.values():
+        if isinstance(v, Call):
+            rows += _call_rows(idx, v)
+    return rows
+
+
+def _shard_count(idx, shards: Optional[Sequence[int]]) -> int:
+    if shards is not None:
+        return max(1, len(shards))
+    if idx is not None:
+        try:
+            return max(1, len(idx.available_shards()))
+        except Exception:  # noqa: BLE001 - estimation must never fail
+            return 1
+    return 1
+
+
+def estimate(
+    idx,
+    query,
+    shards: Optional[Sequence[int]] = None,
+    shard_count: Optional[int] = None,
+) -> QueryCost:
+    """Estimate `query` (a parsed Query/Call, or raw PQL text) against
+    index object `idx` (may be None — e.g. not created yet).
+    `shard_count` overrides the shard-axis size — the api layer passes
+    this node's expected LOCAL share in a multi-node cluster, since a
+    coordinator's own device only materializes the shards it owns (the
+    rest are charged by the peers admitting the fan-out legs)."""
+    from pilosa_tpu.core.devcache import DEVICE_CACHE
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+    try:
+        if isinstance(query, str):
+            from pilosa_tpu.pql import parse
+
+            query = parse(query)
+        calls = query.calls if isinstance(query, Query) else [query]
+        n_shards = (
+            max(1, shard_count)
+            if shard_count is not None
+            else _shard_count(idx, shards)
+        )
+        stack_bytes = n_shards * WORDS_PER_ROW * 4
+        # the executor's _stack_guard chunks any dispatch whose stacks
+        # would exceed a quarter of the devcache budget
+        dispatch_cap = max(1, DEVICE_CACHE.budget_bytes // 4)
+        peak = 0
+        sweeps = 0
+        write = False
+        for c in calls:
+            if c.name in _WRITE_CALLS:
+                write = True
+                continue
+            raw = int(_call_rows(idx, c) * stack_bytes)
+            if raw <= 0:
+                continue
+            peak = max(peak, min(raw, dispatch_cap))
+            sweeps += max(1, math.ceil(raw / dispatch_cap))
+        return QueryCost(device_bytes=peak, sweeps=sweeps, write=write)
+    except Exception:  # noqa: BLE001 - never fail admission on estimation
+        return ZERO_COST

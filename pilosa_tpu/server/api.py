@@ -128,72 +128,173 @@ class API:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
     ):
-        """Execute PQL, with a trace span, per-query stats and slow-query
-        logging; returns the full QueryResponse incl. column attr sets
-        (reference: api.go:135 Query + executor spans executor.go:113-115,
-        LongQueryTime api.go:1157)."""
+        """Execute PQL, with admission control (pilosa_tpu/sched/), a
+        trace span, per-query stats and slow-query logging; returns the
+        full QueryResponse incl. column attr sets (reference: api.go:135
+        Query + executor spans executor.go:113-115, LongQueryTime
+        api.go:1157).
+
+        Admission happens BEFORE the span/stat machinery: a shed query
+        (ShedError -> HTTP 429 + Retry-After) never counts as executed.
+        The priority class comes from the X-Pilosa-Priority header
+        (internal fan-out legs default to the `internal` class) and the
+        remaining deadline from X-Pilosa-Deadline, stamped by the
+        distributed executor so remote nodes shed early instead of
+        timing out late."""
         import time as _time
 
         from pilosa_tpu.utils import tracing
 
         self._validate("query")
+        pql_text = query if isinstance(query, str) else str(query)
+        if isinstance(query, str):
+            from pilosa_tpu.pql import parse
+            from pilosa_tpu.pql.parser import ParseError
+
+            try:
+                query = parse(query)
+            except ParseError:
+                # parsing now happens before the span/stat machinery (the
+                # admission cost estimate needs the call tree), but a
+                # malformed-PQL flood must still show on query dashboards
+                # — count it before the 400 surfaces
+                stats = self.server.stats.with_tags(f"index:{index}")
+                stats.count("query_n")
+                stats.timing("query_ms", 0.0)
+                raise
         opt = ExecOptions(
             remote=remote,
             column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
         )
-        span = (
-            self.server.tracer.start_span_from_headers("api.query", headers)
-            if headers
-            else self.server.tracer.start_span("api.query")
-        )
-        t0 = _time.perf_counter()
-        with span:
-            span.set_tag("index", index)
-            span.set_tag("remote", remote)
-            try:
-                batched, parsed = self._query_batched(index, query, shards, opt)
-                if batched is not None:
-                    return batched
-                return self.server.executor.execute_response(
-                    index, parsed if parsed is not None else query,
-                    shards=shards, opt=opt,
+        ticket = self._admit(index, query, shards, remote, headers, opt)
+        # everything from here on runs under the ticket's try/finally —
+        # even a failure building the span must release the slot, or the
+        # node would bleed concurrency capacity until restart
+        try:
+            span = (
+                self.server.tracer.start_span_from_headers(
+                    "api.query", headers
                 )
-            finally:
-                dt = _time.perf_counter() - t0
-                stats = self.server.stats.with_tags(f"index:{index}")
-                stats.count("query_n")
-                stats.timing("query_ms", dt)
-                lqt = self.server.long_query_time
-                if lqt > 0 and dt > lqt:
-                    self.server.logger(
-                        f"slow query ({dt:.3f}s > {lqt:.3f}s) on {index!r}: "
-                        f"{query[:200]}"
+                if headers
+                else self.server.tracer.start_span("api.query")
+            )
+            t0 = _time.perf_counter()
+            with span:
+                span.set_tag("index", index)
+                span.set_tag("remote", remote)
+                if ticket is not None:
+                    span.set_tag("sched.class", ticket.cls)
+                    span.set_tag(
+                        "sched.wait_ms", round(ticket.waited * 1000.0, 3)
                     )
+                try:
+                    batched, parsed = self._query_batched(
+                        index, query, shards, opt
+                    )
+                    if ticket is not None:
+                        # past the batcher: this query can no longer be
+                        # anyone's batch mate — drop it from the
+                        # adaptive-batching hint before serialization
+                        ticket.done_batching()
+                    if batched is not None:
+                        return batched
+                    return self.server.executor.execute_response(
+                        index, parsed if parsed is not None else query,
+                        shards=shards, opt=opt,
+                    )
+                finally:
+                    dt = _time.perf_counter() - t0
+                    stats = self.server.stats.with_tags(f"index:{index}")
+                    stats.count("query_n")
+                    stats.timing("query_ms", dt)
+                    lqt = self.server.long_query_time
+                    if lqt > 0 and dt > lqt:
+                        self.server.logger(
+                            f"slow query ({dt:.3f}s > {lqt:.3f}s) on "
+                            f"{index!r}: {pql_text[:200]}"
+                        )
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def _admit(self, index, query, shards, remote, headers, opt):
+        """Admission gate: estimate the query's device cost and block
+        until the scheduler grants a slot (or raise ShedError -> 429).
+        Returns the Ticket to release after execution, or None when the
+        scheduler is disabled (max-concurrent-queries = 0)."""
+        scheduler = getattr(self.server, "scheduler", None)
+        if scheduler is None:
+            return None
+        from pilosa_tpu.sched import admission as admod
+        from pilosa_tpu.sched import cost as costmod
+
+        cls = None
+        deadline = None
+        if headers is not None:
+            cls = headers.get(admod.PRIORITY_HEADER)
+            raw_deadline = headers.get(admod.DEADLINE_HEADER)
+            if raw_deadline:
+                try:
+                    deadline = float(raw_deadline)
+                except ValueError:
+                    deadline = None
+        if remote and not cls:
+            cls = admod.CLASS_INTERNAL
+        idx = self.holder.index(index)
+        shard_count = None
+        if shards is None and idx is not None:
+            # multi-node coordinator: this node's device only holds its
+            # expected LOCAL share of the fan-out (peers charge their
+            # legs' shards themselves); charging the full cluster-wide
+            # shard axis would over-throttle the coordinator
+            nodes = max(1, len(self.cluster.nodes))
+            if nodes > 1:
+                try:
+                    total = max(1, len(idx.available_shards()))
+                except Exception:  # noqa: BLE001 - estimation best-effort
+                    total = 1
+                import math as _math
+
+                share = min(1.0, self.cluster.replica_n / nodes)
+                shard_count = max(1, _math.ceil(total * share))
+        qcost = costmod.estimate(
+            idx, query, shards, shard_count=shard_count
+        )
+        from pilosa_tpu.exec import batcher as batchmod
+
+        # only batcher-eligible traffic feeds the adaptive-batching hint
+        # — same predicate the routing in _query_batched uses, so the
+        # hint can never count a query the batcher would divert
+        batchable = batchmod.batch_eligible(query, shards, opt)
+        return scheduler.admit(
+            cls=cls,
+            cost=qcost,
+            deadline=deadline,
+            batchable=batchable,
+            index=index,
+            # remote legs ride the scheduler's separate internal lane: a
+            # coordinator blocks on its legs WHILE holding its own slot,
+            # so legs competing for coordinator slots across nodes could
+            # hold-and-wait until every deadline expired
+            leg=remote,
+        )
 
     def _query_batched(self, index, query, shards, opt):
         """Route pure-Count requests through the group-commit batcher
         (exec/batcher.py): concurrent single-Count clients share one
-        multi-root dispatch. Returns (response, parsed_query); response is
-        None when the request is not batchable, and the caller reuses
-        parsed_query so the hot path parses the PQL exactly once."""
-        if (
-            shards is not None
-            or opt.remote
-            or opt.column_attrs
-            or opt.exclude_row_attrs
-            or opt.exclude_columns
-        ):
-            return None, None
+        multi-root dispatch. `query` is already parsed (query_response
+        parses once, up front, for admission cost estimation). Returns
+        (response, query); response is None when the request is not
+        batchable."""
         import dataclasses
 
         from pilosa_tpu.exec import batcher as batchmod
         from pilosa_tpu.exec.executor import QueryResponse
-        from pilosa_tpu.pql import parse
 
-        q = parse(query) if isinstance(query, str) else query
-        if not batchmod.batchable(q):
+        q = query
+        if not batchmod.batch_eligible(q, shards, opt):
             return None, q
         results = self.server.count_batcher.run(
             index,

@@ -52,11 +52,15 @@ class ClientError(Exception):
         status: Optional[int] = None,
         retryable: bool = False,
         uri: str = "",
+        retry_after: Optional[float] = None,
     ):
         super().__init__(msg)
         self.status = status
         self.retryable = retryable
         self.uri = uri
+        # peer-suggested backoff (the Retry-After on a 429 load shed);
+        # the retry loop honors it instead of the policy's base backoff
+        self.retry_after = retry_after
 
 
 class BreakerOpenError(ClientError):
@@ -119,11 +123,25 @@ class InternalClient:
         """Map a raw attempt failure onto a classified ClientError."""
         if isinstance(e, urllib.error.HTTPError):
             detail = e.read().decode("utf-8", "replace")[:500]
+            retry_after = None
+            raw_ra = None
+            if e.headers:
+                # prefer the precise vendor header (sub-second sheds);
+                # the standard Retry-After is integer delta-seconds
+                raw_ra = e.headers.get("X-Pilosa-Retry-After") or e.headers.get(
+                    "Retry-After"
+                )
+            if raw_ra:
+                try:
+                    retry_after = float(raw_ra)
+                except ValueError:
+                    retry_after = None
             err = ClientError(
                 f"{method} {url} -> {e.code}: {detail}",
                 status=e.code,
                 retryable=faults.retryable_status(e.code),
                 uri=uri,
+                retry_after=retry_after,
             )
         elif isinstance(e, (ssl.SSLCertVerificationError, ssl.CertificateError)) or (
             isinstance(e, urllib.error.URLError)
@@ -149,13 +167,17 @@ class InternalClient:
         content_type: str = "application/json",
         timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
+        headers_fn=None,
         check_breaker: bool = True,
     ) -> bytes:
         """One logical RPC: up to `retry_policy.max_attempts` attempts
         within a `timeout` (default `self.timeout`) TOTAL budget, backoff
         between attempts, per-peer breaker consulted before each dial
         (`check_breaker=False` for liveness probes, which must reach even
-        a shunned peer so it can recover)."""
+        a shunned peer so it can recover). `headers_fn(remaining)` is
+        re-evaluated per attempt with the budget's remaining seconds, so
+        budget-derived headers (X-Pilosa-Deadline) shrink across retries
+        instead of overstating the sender's patience."""
         url = uri.rstrip("/") + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -179,6 +201,9 @@ class InternalClient:
                 req.add_header("Content-Type", content_type)
             if headers:
                 for k, v in headers.items():
+                    req.add_header(k, v)
+            if headers_fn is not None:
+                for k, v in headers_fn(remaining).items():
                     req.add_header(k, v)
             if span is not None and getattr(span, "trace_id", ""):
                 req.add_header(tracing.TRACE_HEADER, span.trace_id)
@@ -216,10 +241,13 @@ class InternalClient:
             # the peer (one deadline-pressed query must not shun healthy
             # replicas for everyone else)
             if breakers is not None:
-                if not err.retryable and err.status is not None:
-                    # an HTTP status (4xx) proves the peer alive+healthy;
-                    # other non-retryables (e.g. cert verification) prove
-                    # nothing about liveness and must not close a breaker
+                if err.status is not None and (
+                    not err.retryable or err.status == 429
+                ):
+                    # an HTTP status (4xx, or a 429 admission shed) proves
+                    # the peer alive+healthy — a LOADED peer is not a DEAD
+                    # peer, and opening its breaker would turn transient
+                    # load shedding into a cooldown-long outage
                     breakers.record(uri, True)
                 elif err.retryable and not (
                     timed_out and remaining < _TIMEOUT_PENALTY_FLOOR
@@ -228,10 +256,16 @@ class InternalClient:
                 else:
                     # neutral: release a half-open probe slot this attempt
                     # may hold, or the unrecorded probe pins allow() false
+                    # (non-retryables without a status — e.g. cert
+                    # verification — prove nothing about liveness)
                     breakers.record_neutral(uri)
             if not err.retryable or attempts >= policy.max_attempts:
                 raise err
             delay = policy.backoff(attempts)
+            if err.retry_after is not None:
+                # the peer said when to come back (429 load shed):
+                # honor it instead of hammering a saturated node
+                delay = max(delay, err.retry_after)
             if budget.remaining() <= delay:
                 raise err  # no budget left for another attempt
             if self.stats is not None:
@@ -255,18 +289,43 @@ class InternalClient:
         shards: Optional[Sequence[int]] = None,
         remote: bool = False,
         timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
+        priority: Optional[str] = None,
     ) -> List[Any]:
         """`timeout` (total budget) lets the distributed executor bound
-        each fan-out RPC by the query deadline's remaining time."""
+        each fan-out RPC by the query deadline's remaining time;
+        `deadline` (remaining seconds) and `priority` ride as headers so
+        the peer's admission controller (pilosa_tpu/sched/) sheds a leg
+        that can no longer meet the sender's budget EARLY — a 429 the
+        retry/failover plane absorbs — instead of timing out late."""
+        from pilosa_tpu.sched import admission as _admission
+
         body = {"query": query, "remote": remote}
         if shards is not None:
             body["shards"] = list(shards)
+
+        def hdrs(remaining: float) -> Dict[str, str]:
+            # re-stamped per attempt: a retry after a burned attempt
+            # must advertise the SHRUNKEN remaining budget, or the peer
+            # queues the leg for time the sender no longer has
+            h = {
+                _admission.PRIORITY_HEADER: (
+                    priority or _admission.CLASS_INTERNAL
+                )
+            }
+            if deadline is not None:
+                h[_admission.DEADLINE_HEADER] = (
+                    f"{max(0.0, min(deadline, remaining)):.3f}"
+                )
+            return h
+
         resp = self._json(
             "POST",
             uri,
             f"/internal/index/{index}/query",
             json.dumps(body).encode(),
             timeout=timeout,
+            headers_fn=hdrs,
         )
         if resp.get("error"):
             # remote payload error: the peer is alive and executed the

@@ -23,10 +23,18 @@ import numpy as np
 
 from pilosa_tpu.exec.executor import ExecError, NotFoundError
 from pilosa_tpu.pql.parser import ParseError
+from pilosa_tpu.sched.admission import ShedError
 from pilosa_tpu.server import wire
 from pilosa_tpu.server.api import ApiError, DisabledError
 
 _ROUTES: List[Tuple[str, re.Pattern, str]] = []
+
+_REQUIRED = object()
+
+
+class BadParam(ValueError):
+    """Malformed/missing query parameter -> 400 with a JSON error body
+    (instead of a bare int() traceback surfacing as an opaque message)."""
 
 
 def route(method: str, pattern: str):
@@ -66,16 +74,55 @@ class Handler(BaseHTTPRequestHandler):
         return json.loads(data) if data else {}
 
     def _reply(self, obj: Any, code: int = 200, raw: Optional[bytes] = None,
-               content_type: str = "application/json") -> None:
+               content_type: str = "application/json",
+               extra_headers: Optional[Dict[str, str]] = None) -> None:
         body = raw if raw is not None else json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if extra_headers:
+            for k, v in extra_headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def _error(self, msg: str, code: int = 400) -> None:
         self._reply({"error": msg}, code=code)
+
+    def _int_param(self, name: str, default: Any = _REQUIRED) -> Optional[int]:
+        """Validated integer query parameter: absent -> `default` (or 400
+        when required), non-numeric -> 400 with a JSON error body naming
+        the parameter (satellite: `?shard=abc` must be a client error,
+        never an opaque coercion failure)."""
+        raw = self.query.get(name)
+        if raw is None:
+            if default is _REQUIRED:
+                raise BadParam(f"missing required query parameter {name!r}")
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            raise BadParam(
+                f"query parameter {name!r} must be an integer, got {raw!r}"
+            ) from None
+
+    def _str_param(self, name: str) -> str:
+        raw = self.query.get(name)
+        if not raw:
+            raise BadParam(f"missing required query parameter {name!r}")
+        return raw
+
+    def _int_list_param(self, name: str) -> List[int]:
+        raw = self.query.get(name, "")
+        try:
+            # no empty-segment filtering: "1,,2" is a client typo that
+            # must 400, not silently become [1, 2]
+            return [int(s) for s in raw.split(",")]
+        except ValueError:
+            raise BadParam(
+                f"query parameter {name!r} must be comma-separated "
+                f"integers, got {raw!r}"
+            ) from None
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
@@ -91,6 +138,26 @@ class Handler(BaseHTTPRequestHandler):
                     getattr(self, fn_name)(**match.groupdict())
                 except (NotFoundError,) as e:
                     self._error(str(e), 404)
+                except ShedError as e:
+                    # admission-control load shed: 429 is retryable per
+                    # server/faults.py, so internode callers fail over /
+                    # back off instead of treating this as a hard error.
+                    # Retry-After must be RFC 9110 delta-seconds (an
+                    # integer) or standard client stacks ignore it; the
+                    # precise value rides a vendor header for the
+                    # internode client's sub-second backoff.
+                    import math
+
+                    self._reply(
+                        {"error": str(e)},
+                        code=429,
+                        extra_headers={
+                            "Retry-After": str(
+                                max(1, math.ceil(e.retry_after))
+                            ),
+                            "X-Pilosa-Retry-After": f"{e.retry_after:g}",
+                        },
+                    )
                 except DisabledError as e:
                     self._error(str(e), 503)
                 except (ExecError, ApiError, ParseError, ValueError, KeyError) as e:
@@ -158,7 +225,7 @@ class Handler(BaseHTTPRequestHandler):
     def get_fragment_nodes(self):
         """Owner nodes of one shard (reference: handleGetFragmentNodes)."""
         index = self.query.get("index", "")
-        shard = int(self.query.get("shard", "0"))
+        shard = self._int_param("shard", 0)
         self._reply(self.api.shard_nodes(index, shard))
 
     @route(
@@ -172,7 +239,10 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/metrics")
     def get_metrics(self):
-        """Prometheus exposition (reference: http/handler.go:282)."""
+        """Prometheus exposition (reference: http/handler.go:282).
+        Device-cache residency gauges are refreshed at scrape time — they
+        are cheap reads of counters the cache already keeps."""
+        self.node.publish_cache_gauges()
         reg = getattr(self.node.stats, "registry", None)
         text = reg.prometheus_text() if reg is not None else ""
         self._reply(None, raw=text.encode(), content_type="text/plain; version=0.0.4")
@@ -180,6 +250,7 @@ class Handler(BaseHTTPRequestHandler):
     @route("GET", "/debug/vars")
     def get_debug_vars(self):
         """expvar-style dump (reference: http/handler.go:281)."""
+        self.node.publish_cache_gauges()
         reg = getattr(self.node.stats, "registry", None)
         self._reply(reg.snapshot() if reg is not None else {})
 
@@ -242,7 +313,7 @@ class Handler(BaseHTTPRequestHandler):
         else:
             pql = body.decode("utf-8")
             if "shards" in self.query:
-                shards = [int(s) for s in self.query["shards"].split(",")]
+                shards = self._int_list_param("shards")
 
         def flag(name: str, d: Optional[dict] = None) -> bool:
             if d is not None and name in d:
@@ -318,9 +389,9 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/export")
     def get_export(self):
-        index = self.query["index"]
-        field = self.query["field"]
-        shard = int(self.query["shard"]) if "shard" in self.query else None
+        index = self._str_param("index")
+        field = self._str_param("field")
+        shard = self._int_param("shard", None)
         csv = self.api.export_csv(index, field, shard)
         self._reply(None, raw=csv.encode(), content_type="text/csv")
 
@@ -330,7 +401,7 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/index/(?P<index>[^/]+)/shard-nodes")
     def get_shard_nodes(self, index: str):
-        self._reply(self.api.shard_nodes(index, int(self.query["shard"])))
+        self._reply(self.api.shard_nodes(index, self._int_param("shard")))
 
     # -- internal routes ---------------------------------------------------
 
@@ -495,16 +566,18 @@ class Handler(BaseHTTPRequestHandler):
         self._reply({})
 
     def _fragment(self):
-        idx = self.node.holder.index(self.query["index"])
+        index = self._str_param("index")
+        idx = self.node.holder.index(index)
         if idx is None:
-            raise NotFoundError(f"index not found: {self.query['index']}")
-        f = idx.field(self.query["field"])
+            raise NotFoundError(f"index not found: {index}")
+        field = self._str_param("field")
+        f = idx.field(field)
         if f is None:
-            raise NotFoundError(f"field not found: {self.query['field']}")
+            raise NotFoundError(f"field not found: {field}")
         v = f.views.get(self.query.get("view", "standard"))
         if v is None:
             return None
-        return v.fragment_if_exists(int(self.query["shard"]))
+        return v.fragment_if_exists(self._int_param("shard"))
 
     @route("GET", "/internal/fragment/blocks")
     def get_fragment_blocks(self):
@@ -515,11 +588,12 @@ class Handler(BaseHTTPRequestHandler):
     @route("GET", "/internal/fragment/block/data")
     def get_block_data(self):
         binary = wire.ARRAYS_CTYPE in (self.headers.get("Accept") or "")
+        block = self._int_param("block")  # validate even for absent frags
         frag = self._fragment()
         if frag is None:
             rows = cols = np.zeros(0, np.uint64)
         else:
-            rows, cols = frag.block_pairs(int(self.query["block"]))
+            rows, cols = frag.block_pairs(block)
         if binary:
             self._reply(
                 None,
@@ -597,16 +671,17 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/internal/translate/data")
     def get_translate_data(self):
-        idx = self.node.holder.index(self.query["index"])
+        index = self._str_param("index")
+        idx = self.node.holder.index(index)
         if idx is None:
-            raise NotFoundError(f"index not found: {self.query['index']}")
+            raise NotFoundError(f"index not found: {index}")
         store = idx.translate_store
         if "field" in self.query:
             f = idx.field(self.query["field"])
             if f is None:
                 raise NotFoundError(f"field not found: {self.query['field']}")
             store = f.translate_store
-        entries, offset = store.entries_since(int(self.query.get("offset", 0)))
+        entries, offset = store.entries_since(self._int_param("offset", 0))
         self._reply({"entries": entries, "offset": offset})
 
 

@@ -67,6 +67,11 @@ class NodeServer:
         breaker_threshold: int = 5,  # consecutive failures before open
         breaker_cooldown: float = 2.0,  # seconds open before half-open
         query_deadline: float = 30.0,  # distributed fan-out wall bound
+        max_concurrent_queries: int = 16,  # admission cap (0 disables sched)
+        admission_queue_depth: int = 128,  # bounded admission queue
+        admission_byte_budget: int = 0,  # in-flight bytes; 0 = devcache budget
+        admission_default_class: str = "interactive",  # headerless queries
+        shed_retry_after: float = 1.0,  # Retry-After seconds on 429
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -128,6 +133,24 @@ class NodeServer:
         from pilosa_tpu.exec.batcher import CountBatcher
 
         self.count_batcher = CountBatcher()
+        self.count_batcher.stats = self.stats
+        # query admission control & QoS (pilosa_tpu/sched/): every query
+        # is admitted before it may dispatch — bounded concurrency, a
+        # bounded priority queue, 429 load shedding — and the observed
+        # load feeds the count batcher so batch size grows under load
+        self.scheduler = None
+        if max_concurrent_queries > 0:
+            from pilosa_tpu.sched.admission import AdmissionController
+
+            self.scheduler = AdmissionController(
+                max_concurrent=max_concurrent_queries,
+                queue_depth=admission_queue_depth,
+                byte_budget=admission_byte_budget,
+                default_class=admission_default_class,
+                retry_after=shed_retry_after,
+                stats=self.stats,
+            )
+            self.count_batcher.load_hint = self.scheduler.load
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.probe_interval = probe_interval
@@ -365,6 +388,20 @@ class NodeServer:
             )
             self._runtime_thread.start()
         return self
+
+    def publish_cache_gauges(self) -> None:
+        """Refresh device-cache residency gauges at scrape time (the
+        /metrics and /debug/vars handlers call this just before
+        rendering): HBM residency is the TPU analog of the reference's
+        mmap/page-cache pressure, so operators need it on dashboards."""
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+        snap = DEVICE_CACHE.stats_snapshot()
+        self.stats.gauge("devcache.resident_bytes", snap["resident_bytes"])
+        self.stats.gauge("devcache.entries", snap["entries"])
+        self.stats.gauge("devcache.evictions", snap["evictions"])
+        self.stats.gauge("devcache.hits", snap["hits"])
+        self.stats.gauge("devcache.misses", snap["misses"])
 
     def _ticker_error(self, ticker: str, exc: BaseException) -> None:
         """Background tickers must survive any failure, but never silently:

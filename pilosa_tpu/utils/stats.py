@@ -53,6 +53,24 @@ STAT_NAMES = frozenset(
         "runtime.threads",
         "runtime.gc_objects",
         "runtime.open_files",
+        # query admission control & QoS (sched/admission.py); admit/shed/
+        # wait series carry a "class:<interactive|batch|internal>" tag
+        "sched.queue_depth",
+        "sched.inflight",
+        "sched.inflight_bytes",
+        "sched.admit",
+        "sched.shed",
+        "sched.wait_ms",
+        # cross-request count batching (exec/batcher.py): calls merged
+        # into each executed round
+        "batcher.batch_size",
+        # device-cache residency (core/devcache.py, refreshed at scrape
+        # time by server/node.py publish_cache_gauges)
+        "devcache.resident_bytes",
+        "devcache.entries",
+        "devcache.evictions",
+        "devcache.hits",
+        "devcache.misses",
     }
 )
 

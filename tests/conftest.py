@@ -55,6 +55,23 @@ def _lock_discipline_guard():
 
 
 @pytest.fixture(autouse=True)
+def _sched_leak_guard():
+    """State-leak guard for admission control: every AdmissionController
+    alive after a test must be idle — a shed or finished query that
+    leaves a queue entry or a held concurrency slot behind would starve
+    every later query on that node."""
+    yield
+    from pilosa_tpu.sched import admission
+
+    leaked = admission.leaked_state()
+    if leaked:
+        pytest.fail(
+            "admission controller(s) left non-idle (id, queued, inflight): "
+            f"{leaked}"
+        )
+
+
+@pytest.fixture(autouse=True)
 def _fault_plane_leak_guard():
     """State-leak guard: a test that installs a process-global
     FaultInjector or BreakerRegistry (faults.install_injector /

@@ -323,3 +323,100 @@ class TestEndToEnd:
         (row,) = api.query("bj", "Row(f=1)")
         assert sorted(int(c) for c in row.columns()) == [1, 9]
         assert batchmod.STATS["leader"] == 0  # never entered the batcher
+
+
+class TestBatchSizeStat:
+    def test_solo_round_records_one(self):
+        from pilosa_tpu.utils.stats import StatsClient
+
+        b = CountBatcher()
+        st = StatsClient()
+        b.stats = st
+        b.run("i", parse("Count(Row(f=1))"), lambda q: [1])
+        hist = st.registry.snapshot().get("batcher.batch_size")
+        assert hist is not None and hist["count"] == 1 and hist["max"] == 1
+
+    def test_merged_round_records_total_calls(self):
+        from pilosa_tpu.utils.stats import StatsClient
+
+        b = CountBatcher()
+        st = StatsClient()
+        b.stats = st
+        release = threading.Event()
+        started = threading.Event()
+
+        def execute(q):
+            started.set()
+            if not release.is_set():
+                release.wait(5)
+            return list(range(len(q.calls)))
+
+        results = {}
+
+        def follower(i):
+            results[i] = b.run("i", parse("Count(Row(f=2))"), execute)
+
+        leader = threading.Thread(
+            target=lambda: b.run("i", parse("Count(Row(f=1))"), execute),
+            daemon=True,
+        )
+        leader.start()
+        started.wait(5)
+        followers = [
+            threading.Thread(target=follower, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for th in followers:
+            th.start()
+        # wait for all three to be queued behind the leader
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with b._mu:
+                if len(b._queue.get("i", ())) == 3:
+                    break
+            time.sleep(0.002)
+        release.set()
+        leader.join(5)
+        for th in followers:
+            th.join(5)
+        hist = st.registry.snapshot()["batcher.batch_size"]
+        assert hist["max"] >= 3  # the merged follower round
+        assert all(len(r) == 1 for r in results.values())
+
+    def test_run_builds_its_queue_as_a_deque(self):
+        """The waiter queue created by run() itself must be a deque —
+        the list-as-queue pop(0) was O(n) per dequeue (satellite fix)."""
+        from collections import deque
+
+        b = CountBatcher()
+        release = threading.Event()
+        started = threading.Event()
+
+        def execute(q):
+            started.set()
+            release.wait(5)
+            return list(range(len(q.calls)))
+
+        leader = threading.Thread(
+            target=lambda: b.run("i", parse("Count(Row(f=1))"), execute),
+            daemon=True,
+        )
+        leader.start()
+        started.wait(5)
+        follower = threading.Thread(
+            target=lambda: b.run("i", parse("Count(Row(f=2))"), execute),
+            daemon=True,
+        )
+        follower.start()
+        deadline = time.monotonic() + 5
+        queue_obj = None
+        while time.monotonic() < deadline:
+            with b._mu:
+                queue_obj = b._queue.get("i")
+                if queue_obj is not None and len(queue_obj) == 1:
+                    break
+            time.sleep(0.002)
+        assert isinstance(queue_obj, deque), type(queue_obj)
+        release.set()
+        leader.join(5)
+        follower.join(5)

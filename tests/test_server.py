@@ -476,3 +476,79 @@ def test_reference_route_parity():
             "DELETE", f"{uri}/internal/index/ri/field/f/remote-available-shards/7"
         )
         assert 7 not in f.remote_available_shards
+
+
+def test_bad_numeric_query_params_return_400_json():
+    """Satellite: malformed numeric params must be client errors with a
+    JSON body naming the parameter, never opaque coercion messages."""
+    import urllib.error
+
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        c[0].api.create_index("qp")
+        c[0].api.create_field("qp", "f", {"type": "set"})
+
+        def expect_400(url):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_json("GET", url)
+            assert ei.value.code == 400
+            body = json.loads(ei.value.read())
+            ei.value.close()
+            return body["error"]
+
+        msg = expect_400(f"{uri}/index/qp/shard-nodes?shard=abc")
+        assert "shard" in msg and "abc" in msg
+        msg = expect_400(f"{uri}/index/qp/shard-nodes")
+        assert "shard" in msg and "missing" in msg
+        msg = expect_400(f"{uri}/internal/fragment/nodes?index=qp&shard=xyz")
+        assert "shard" in msg
+        msg = expect_400(
+            f"{uri}/internal/fragment/block/data"
+            "?index=qp&field=f&shard=0&block=nope"
+        )
+        assert "block" in msg
+        msg = expect_400(f"{uri}/export?index=qp&field=f&shard=1.5")
+        assert "shard" in msg
+        # text-path shards list on the query route; empty segments are
+        # typos that must 400, not silently drop
+        for bad in ("1,two", "1,,2", ","):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_json(
+                    "POST", f"{uri}/index/qp/query?shards={bad}",
+                    b"Count(Row(f=1))", ctype="text/plain",
+                )
+            assert ei.value.code == 400, bad
+            assert "shards" in json.loads(ei.value.read())["error"]
+            ei.value.close()
+
+
+def test_devcache_counters_exported_on_metrics_and_debug_vars():
+    """Satellite: device-cache residency counters must appear as gauges
+    in the Prometheus text and /debug/vars (regression test)."""
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        c[0].api.create_index("dm")
+        c[0].api.create_field("dm", "f", {"type": "set"})
+        c[0].api.query("dm", "Set(1, f=1) Set(2, f=1)")
+        c[0].api.query("dm", "Count(Row(f=1))")  # touches the devcache
+        text = http_json("GET", f"{uri}/metrics").decode()
+        for name in (
+            "pilosa_tpu_devcache_resident_bytes",
+            "pilosa_tpu_devcache_entries",
+            "pilosa_tpu_devcache_evictions",
+            "pilosa_tpu_devcache_hits",
+            "pilosa_tpu_devcache_misses",
+        ):
+            assert f"# TYPE {name} gauge" in text, name
+            assert f"\n{name} " in text, name
+        dbg = http_json("GET", f"{uri}/debug/vars")
+        for key in (
+            "devcache.resident_bytes",
+            "devcache.entries",
+            "devcache.evictions",
+            "devcache.hits",
+            "devcache.misses",
+        ):
+            assert key in dbg, key
+        # a query ran: the cache saw at least one lookup
+        assert dbg["devcache.hits"] + dbg["devcache.misses"] > 0
