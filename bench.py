@@ -316,6 +316,84 @@ def main():
         _ = np.asarray(outs[-1])  # one sync for the whole batch
         topn_filtered_device_ms = (time.perf_counter() - t0) * 1000 / TB
 
+        # ---- BSI Sum device work, RTT-amortized (config 3) ----
+        # The exact shape Sum dispatches at bench scale: per-plane
+        # popcounts of planes[D,S,W] & exists[S,W] in one fused [D]
+        # reduction (the executor's fused aggregate read; the 2^d
+        # weighting is an exact host combine). Salted back-to-back with
+        # ONE final sync — without this the config-3 number sits on the
+        # tunnel RTT floor (VERDICT weak #2).
+        planes_dev = jax.device_put(
+            np.stack(
+                [
+                    (
+                        rng.integers(0, 2**32, shape, np.uint32) & exists_h
+                    ).astype(np.uint32)
+                    for _ in range(BSI_DEPTH)
+                ]
+            )
+        )
+        exists_dev = jax.device_put(exists_h)
+
+        @jax.jit
+        def bsi_sum_once(exists, planes, salt):
+            src = jnp.bitwise_xor(exists, salt)
+            return jnp.sum(
+                jax.lax.population_count(jnp.bitwise_and(planes, src[None])),
+                axis=(1, 2),
+                dtype=jnp.uint32,
+            )
+
+        _ = np.asarray(bsi_sum_once(exists_dev, planes_dev, np.uint32(0)))
+        t0 = time.perf_counter()
+        outs = [
+            bsi_sum_once(exists_dev, planes_dev, np.uint32(i + 1))
+            for i in range(TB)
+        ]
+        _ = np.asarray(outs[-1])  # one sync for the whole batch
+        bsi_sum_device_ms = (time.perf_counter() - t0) * 1000 / TB
+        del planes_dev, exists_dev
+
+        # ---- GroupBy device work, RTT-amortized (config 4) ----
+        # The tally kernel at config-4 geometry: ga's 8 rows crossed with
+        # the 24 (gb x gc) pair rows over 64 shards -> [8, 24, S] counts,
+        # the same _counts_cross the executor's group_by_device runs.
+        from pilosa_tpu.exec import groupby as gbm_dev
+
+        gb_shape3 = (GB_SHARDS, WORDS_PER_ROW)
+        ga_dev = jax.device_put(
+            np.stack(
+                [
+                    rng.integers(0, 2**32, gb_shape3, np.uint32)
+                    & rng.integers(0, 2**32, gb_shape3, np.uint32)
+                    for _ in range(8)
+                ]
+            )
+        )
+        gbc_dev = jax.device_put(
+            np.stack(
+                [
+                    rng.integers(0, 2**32, gb_shape3, np.uint32)
+                    & rng.integers(0, 2**32, gb_shape3, np.uint32)
+                    for _ in range(24)
+                ]
+            )
+        )
+
+        @jax.jit
+        def groupby_tally_once(ga, gbc, salt):
+            return gbm_dev._counts_cross(jnp.bitwise_xor(ga, salt), gbc)
+
+        _ = np.asarray(groupby_tally_once(ga_dev, gbc_dev, np.uint32(0)))
+        t0 = time.perf_counter()
+        outs = [
+            groupby_tally_once(ga_dev, gbc_dev, np.uint32(i + 1))
+            for i in range(TB)
+        ]
+        _ = np.asarray(outs[-1])  # one sync for the whole batch
+        groupby_device_ms = (time.perf_counter() - t0) * 1000 / TB
+        del ga_dev, gbc_dev
+
         # ---- tunnel RTT (dispatch + sync of a trivial op) ----
         tiny = jax.device_put(np.uint32(1))
         add1 = jax.jit(lambda x: x + 1)
@@ -397,13 +475,26 @@ def main():
         groupby_ms = _median_ms(lambda: api.query("gbx", q_gb), 5)
 
         # HBM-pressure eviction: budget below the ~250 MB count working
-        # set; results must stay correct while operands re-stage per query
+        # set; results must stay correct while operands re-stage per query.
+        # With extent-granular paging (pilosa_tpu/hbm/) only the evicted
+        # slices re-upload — hbm_restage_mb_per_query records the actual
+        # PCIe traffic per query under pressure (monolithic staging
+        # re-shipped the full working set every time: the 30-40x cliff).
+        from pilosa_tpu.hbm import residency as hbm_res
+
         old_budget = DEVICE_CACHE.budget_bytes
         DEVICE_CACHE.budget_bytes = 128 << 20
         DEVICE_CACHE.clear()
         got = api.query("bx", q_count)[0]
         assert got == expect, (got, expect)
-        hbm_evict_count_ms = _median_ms(lambda: api.query("bx", q_count), 5)
+        restage0 = hbm_res.stats_snapshot()["restage_bytes"]
+        evict_reps = 5
+        hbm_evict_count_ms = _median_ms(
+            lambda: api.query("bx", q_count), evict_reps
+        )
+        hbm_restage_mb_per_query = (
+            hbm_res.stats_snapshot()["restage_bytes"] - restage0
+        ) / evict_reps / (1 << 20)
         DEVICE_CACHE.budget_bytes = old_budget
         DEVICE_CACHE.clear()
         got = api.query("bx", q_count)[0]  # restore + re-verify
@@ -468,8 +559,13 @@ def main():
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),
                     "topn_filtered_device_ms": round(topn_filtered_device_ms, 3),
                     "bsi_sum_1b_cols_ms": round(sum_ms, 3),
+                    "bsi_sum_device_ms": round(bsi_sum_device_ms, 3),
                     "groupby_3f_64shards_ms": round(groupby_ms, 3),
+                    "groupby_device_ms": round(groupby_device_ms, 3),
                     "hbm_evict_count_ms": round(hbm_evict_count_ms, 3),
+                    "hbm_restage_mb_per_query": round(
+                        hbm_restage_mb_per_query, 2
+                    ),
                     "mesh_scaling": mesh_scaling,
                     "batch": BATCH,
                     "n_shards": n_shards,

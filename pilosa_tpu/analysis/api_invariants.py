@@ -52,6 +52,7 @@ _SECTION_CLASSES = {
     "Config": "",
     "ClusterConfig": "cluster",
     "SchedConfig": "sched",
+    "HbmConfig": "hbm",
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",
     "TracingConfig": "tracing",

@@ -55,6 +55,18 @@ class SchedConfig:
 
 
 @dataclass
+class HbmConfig:
+    # HBM residency manager (pilosa_tpu/hbm/): operand stacks page in
+    # and out of the device budget as shard-major EXTENTS instead of
+    # monolithic entries, so a budget below one query's working set
+    # re-stages only evicted slices (docs/configuration.md "HBM
+    # residency")
+    extent_rows: int = 256  # shards per extent; 0 = monolithic staging
+    prefetch_depth: int = 0  # warm-queue bound; 0 disables the prefetcher
+    pin_timeout: float = 60.0  # stale-pin safety valve, seconds; 0 = off
+
+
+@dataclass
 class AntiEntropyConfig:
     interval: float = 0.0  # seconds; 0 disables the loop
 
@@ -98,6 +110,7 @@ class Config:
     max_writes_per_request: int = 5000
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
+    hbm: HbmConfig = field(default_factory=HbmConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -170,6 +183,7 @@ class Config:
         for sect_name, sect in (
             ("cluster", self.cluster),
             ("sched", self.sched),
+            ("hbm", self.hbm),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
             ("tracing", self.tracing),

@@ -113,6 +113,21 @@ def _build_parser() -> argparse.ArgumentParser:
         help="Retry-After seconds sent with 429 load-shed responses",
     )
     sp.add_argument(
+        "--hbm-extent-rows", type=int,
+        help="shards per HBM operand extent — the paging granularity "
+        "under memory pressure (0 stages whole stacks monolithically)",
+    )
+    sp.add_argument(
+        "--hbm-prefetch-depth", type=int,
+        help="queued warm tasks the background extent prefetcher holds "
+        "(0 disables prefetching)",
+    )
+    sp.add_argument(
+        "--hbm-pin-timeout", type=float,
+        help="seconds before a leaked extent pin is forcibly released "
+        "(safety valve; 0 disables)",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -187,6 +202,9 @@ _FLAG_KNOBS = {
     "admission_byte_budget": ("sched", "admission_byte_budget"),
     "admission_default_class": ("sched", "admission_default_class"),
     "shed_retry_after": ("sched", "shed_retry_after"),
+    "hbm_extent_rows": ("hbm", "extent_rows"),
+    "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
+    "hbm_pin_timeout": ("hbm", "pin_timeout"),
     "anti_entropy_interval": ("anti_entropy", "interval"),
     "metric_service": ("metric", "service"),
     "metric_host": ("metric", "host"),
@@ -318,6 +336,9 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         admission_byte_budget=cfg.sched.admission_byte_budget,
         admission_default_class=cfg.sched.admission_default_class,
         shed_retry_after=cfg.sched.shed_retry_after,
+        hbm_extent_rows=cfg.hbm.extent_rows,
+        hbm_prefetch_depth=cfg.hbm.prefetch_depth,
+        hbm_pin_timeout=cfg.hbm.pin_timeout,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

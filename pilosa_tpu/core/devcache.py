@@ -1,4 +1,4 @@
-"""Budgeted LRU cache for device-resident (HBM) arrays.
+"""Budgeted LRU cache for device-resident (HBM) arrays — the extent store.
 
 The reference bounds storage residency with mmap + explicit resource caps
 (/root/reference/roaring.go:1437 RemapRoaringStorage, syswrap/mmap.go map
@@ -9,22 +9,44 @@ budget, so residency must be *bounded* and cold entries must fall back to
 the host store (a rebuild away, as a page fault is in the reference).
 
 One process-global DeviceCache instance backs:
-- Fragment per-row device arrays (core/fragment.py row_device), and
-- View-level multi-shard row stacks (core/view.py row_stack),
-so the budget is enforced jointly across all fragments and stacks.
+- Fragment per-row device arrays (core/fragment.py row_device),
+- View-level multi-shard row stacks (core/view.py row_stack), and
+- Operand EXTENTS (pilosa_tpu/hbm/residency.py): shard-major slices of a
+  stacked operand, individually tracked so an HBM budget below one query's
+  working set evicts and re-stages *slices*, not whole stacks,
+so the budget is enforced jointly across all fragments, stacks and extents.
 
 Keys are (owner, *rest) tuples where `owner` is a per-object token from
 `new_owner_token()`; `invalidate_owner` drops everything an object cached
 (fragment close / replace-from-stream).
+
+Three properties the hbm/ residency layer leans on:
+
+- get_or_build is SINGLE-FLIGHT: concurrent callers of the same key run
+  exactly one build; the rest wait and share the result (a thundering herd
+  of identical device_puts would overshoot the byte ledger and waste PCIe).
+- Entries can be PINNED (refcounted): a pinned entry is never evicted —
+  eviction is deferred until unpin — so an extent in use by an in-flight
+  compiled dispatch cannot be dropped mid-query. Explicit invalidation of
+  a pinned entry removes it from lookup immediately (new queries rebuild
+  under the new version key) but its bytes stay on the ledger until the
+  last unpin, because the device memory genuinely is still held by the
+  in-flight operand ("zombie" bytes).
+- `pin_timeout` is a leak safety valve: a pin held longer than the timeout
+  (default: forever disabled here; the server wires hbm-pin-timeout) is
+  forcibly released by the evictor, so a leaked pin degrades to an
+  eviction, never to a permanently wedged budget.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from collections import OrderedDict
-from typing import Callable, Dict, Hashable, Set, Tuple
+from contextlib import contextmanager
+from typing import Callable, Dict, Hashable, Iterable, Set, Tuple
 
-from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 
 _DEFAULT_BUDGET_MB = 4096
 
@@ -64,21 +86,53 @@ class DeviceCache:
 
     A single entry larger than the whole budget is still admitted (the query
     needs it to run) but is evicted as soon as anything else is inserted —
-    the budget bounds *steady-state* residency.
+    the budget bounds *steady-state* residency. Likewise, when every entry
+    is pinned the cache may sit over budget transiently; eviction resumes
+    as pins release.
     """
 
-    def __init__(self, budget_bytes: int | None = None):
+    def __init__(
+        self,
+        budget_bytes: int | None = None,
+        pin_timeout: float = 0.0,  # seconds; 0 = stale-pin reclaim off
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self._mu = TrackedLock("devcache.mu")
+        # single-flight get_or_build: waiters park here while a peer builds
+        self._build_cv = TrackedCondition(self._mu, name="devcache.build_cv")
+        self._building: Set[Tuple] = set()
         self._entries: "OrderedDict[Tuple, object]" = OrderedDict()
         self._sizes: Dict[Tuple, int] = {}
         self._by_owner: Dict[Hashable, Set[Tuple]] = {}
         self._bytes = 0
+        # pin refcounts + first-pin time (for the stale-pin safety valve)
+        self._pins: Dict[Tuple, int] = {}
+        self._pin_t0: Dict[Tuple, float] = {}
+        # invalidated-while-pinned entries: gone from lookup, bytes still
+        # on the ledger until the last unpin releases the device memory
+        self._zombies: Dict[Tuple, int] = {}
+        # operand extents (hbm/residency.py) are flagged at insert so the
+        # hbm.* gauges can report them separately from per-row entries
+        self._extent_keys: Set[Tuple] = set()
+        # eviction-deferral sessions (deferred_eviction): while a query's
+        # lowering stages its operand set, evicting to make room for
+        # operand K must not take operand K+1's resident extents — LRU's
+        # cyclic-scan cascade would re-upload the whole working set every
+        # query, the exact cliff extents exist to remove. Residency may
+        # transiently exceed the budget up to the query's working set
+        # (the same overshoot the oversized-entry rule already allows);
+        # the ledger settles back under budget when the session ends.
+        self._defer_evict = 0
+        self.pin_timeout = pin_timeout
+        self._clock = clock
         self.budget_bytes = (
             budget_bytes if budget_bytes is not None else _env_budget_bytes()
         )
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.evicted_extent_bytes = 0  # cumulative; paging tests diff this
+        self.stale_pin_reclaims = 0
 
     # -- core --------------------------------------------------------------
 
@@ -92,22 +146,68 @@ class DeviceCache:
                 self.misses += 1
             return arr
 
-    def put(self, key: Tuple, arr) -> None:
+    def put(self, key: Tuple, arr, *, extent: bool = False) -> None:
         nb = _nbytes(arr)
         with self._mu:
-            if key in self._entries:
-                self._drop_locked(key)
-            self._entries[key] = arr
-            self._sizes[key] = nb
-            self._by_owner.setdefault(key[0], set()).add(key)
-            self._bytes += nb
-            self._evict_locked(keep=key)
+            self._put_locked(key, arr, nb, extent=extent)
 
-    def get_or_build(self, key: Tuple, build: Callable[[], object]):
-        arr = self.get(key)
-        if arr is None:
+    def _put_locked(self, key: Tuple, arr, nb: int, *, extent: bool) -> None:
+        if key in self._entries:
+            # replace: the old bytes leave the ledger even if pinned (the
+            # pins transfer to the new array — stage-level code only pins
+            # entries it just fetched/built, so a same-key replace means
+            # the pin holder is being handed the new array anyway)
+            self._drop_locked(key, replacing=True)
+        self._entries[key] = arr
+        self._sizes[key] = nb
+        self._by_owner.setdefault(key[0], set()).add(key)
+        if extent:
+            self._extent_keys.add(key)
+        self._bytes += nb
+        self._evict_locked(keep=key)
+
+    def get_or_build(
+        self,
+        key: Tuple,
+        build: Callable[[], object],
+        *,
+        extent: bool = False,
+        pin: bool = False,
+    ):
+        """Return the cached array for `key`, building it at most once
+        process-wide even under concurrent callers (single-flight). With
+        pin=True the returned entry is pinned under the same lock hold
+        that found/inserted it — no eviction window in between."""
+        with self._mu:
+            while True:
+                arr = self._entries.get(key)
+                if arr is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    if pin:
+                        self._pin_locked(key)
+                    return arr
+                if key not in self._building:
+                    self._building.add(key)
+                    self.misses += 1
+                    break
+                # a peer is building this key: wait for its insert instead
+                # of double-building (and double-charging the byte ledger)
+                self._build_cv.wait()
+        try:
             arr = build()
-            self.put(key, arr)
+        except BaseException:
+            with self._mu:
+                self._building.discard(key)
+                self._build_cv.notify_all()
+            raise
+        nb = _nbytes(arr)
+        with self._mu:
+            self._building.discard(key)
+            self._put_locked(key, arr, nb, extent=extent)
+            if pin:
+                self._pin_locked(key)
+            self._build_cv.notify_all()
         return arr
 
     def invalidate(self, key: Tuple) -> None:
@@ -125,29 +225,128 @@ class DeviceCache:
             self._entries.clear()
             self._sizes.clear()
             self._by_owner.clear()
+            self._extent_keys.clear()
+            self._pins.clear()
+            self._pin_t0.clear()
+            self._zombies.clear()
             self._bytes = 0
+
+    @contextmanager
+    def deferred_eviction(self):
+        """Suspend budget eviction for the duration (nestable; settles —
+        evicts down to budget — when the outermost session exits). Used
+        by the stacked lowering around operand staging; see _defer_evict."""
+        with self._mu:
+            self._defer_evict += 1
+        try:
+            yield
+        finally:
+            with self._mu:
+                self._defer_evict -= 1
+                if self._defer_evict == 0:
+                    self._evict_locked(keep=None)
+
+    # -- pinning -----------------------------------------------------------
+
+    def pin_if_present(self, key: Tuple) -> bool:
+        """Pin `key` iff it is resident; True when the pin was taken."""
+        with self._mu:
+            if key not in self._entries:
+                return False
+            self._entries.move_to_end(key)
+            self._pin_locked(key)
+            return True
+
+    def _pin_locked(self, key: Tuple) -> None:
+        n = self._pins.get(key, 0)
+        self._pins[key] = n + 1
+        if n == 0:
+            self._pin_t0[key] = self._clock()
+
+    def unpin(self, key: Tuple) -> None:
+        """Release one pin. Unpinning an unknown key is a no-op (the pin
+        may have been force-released by the stale-pin safety valve)."""
+        with self._mu:
+            n = self._pins.get(key, 0)
+            if n <= 1:
+                self._pins.pop(key, None)
+                self._pin_t0.pop(key, None)
+                zb = self._zombies.pop(key, None)
+                if zb is not None:
+                    # last pin on an invalidated entry: the in-flight
+                    # operand is done with it — bytes leave the ledger now
+                    self._bytes -= zb
+                if n == 1:
+                    # unpinned entries become evictable: settle any debt
+                    # deferred while the dispatch was in flight
+                    self._evict_locked(keep=None)
+            else:
+                self._pins[key] = n - 1
+
+    def unpin_all(self, keys: Iterable[Tuple]) -> None:
+        for key in keys:
+            self.unpin(key)
+
+    def _pinned_locked(self, key: Tuple) -> bool:
+        if key not in self._pins:
+            return False
+        if (
+            self.pin_timeout > 0
+            and self._clock() - self._pin_t0.get(key, 0.0) > self.pin_timeout
+        ):
+            # leak safety valve: a pin this old is a bug, not a dispatch;
+            # force-release it so the budget cannot wedge permanently
+            self._pins.pop(key, None)
+            self._pin_t0.pop(key, None)
+            self.stale_pin_reclaims += 1
+            return False
+        return True
+
+    @property
+    def pinned_bytes(self) -> int:
+        with self._mu:
+            return self._pinned_bytes_locked()
+
+    def _pinned_bytes_locked(self) -> int:
+        total = 0
+        for key in self._pins:
+            total += self._sizes.get(key) or self._zombies.get(key, 0)
+        return total
 
     # -- internals ---------------------------------------------------------
 
-    def _drop_locked(self, key: Tuple) -> None:
+    def _drop_locked(self, key: Tuple, replacing: bool = False) -> None:
         self._entries.pop(key, None)
-        self._bytes -= self._sizes.pop(key, 0)
+        nb = self._sizes.pop(key, 0)
+        if not replacing and key in self._pins:
+            # invalidated while an in-flight dispatch holds it: the array
+            # lives until the last unpin, so its bytes stay accounted
+            self._zombies[key] = self._zombies.get(key, 0) + nb
+        else:
+            self._bytes -= nb
+        self._extent_keys.discard(key)
         owner_keys = self._by_owner.get(key[0])
         if owner_keys is not None:
             owner_keys.discard(key)
             if not owner_keys:
                 del self._by_owner[key[0]]
 
-    def _evict_locked(self, keep: Tuple) -> None:
-        while self._bytes > self.budget_bytes and len(self._entries) > 1:
-            key = next(iter(self._entries))
+    def _evict_locked(self, keep) -> None:
+        if self._bytes <= self.budget_bytes or self._defer_evict > 0:
+            return
+        for key in list(self._entries):
+            if self._bytes <= self.budget_bytes or len(self._entries) <= 1:
+                break
             if key == keep:
                 # the just-inserted entry is the only way to finish the
                 # current query; evict around it
-                self._entries.move_to_end(key)
-                key = next(iter(self._entries))
-                if key == keep:
-                    break
+                continue
+            if self._pinned_locked(key):
+                # pinned by an in-flight dispatch: eviction is DEFERRED —
+                # the budget may be transiently exceeded; unpin() retries
+                continue
+            if key in self._extent_keys:
+                self.evicted_extent_bytes += self._sizes.get(key, 0)
             self._drop_locked(key)
             self.evictions += 1
 
@@ -156,6 +355,16 @@ class DeviceCache:
     @property
     def bytes_used(self) -> int:
         return self._bytes
+
+    def owner_resident_bytes(self, owner: Hashable) -> int:
+        """Resident bytes cached under one owner token (the admission
+        cost estimator discounts queries whose operands are already on
+        device, sched/cost.py)."""
+        with self._mu:
+            keys = self._by_owner.get(owner)
+            if not keys:
+                return 0
+            return sum(self._sizes.get(k, 0) for k in keys)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -171,11 +380,16 @@ class DeviceCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "budget_bytes": self.budget_bytes,
+                "resident_extents": len(self._extent_keys),
+                "pinned_bytes": self._pinned_bytes_locked(),
+                "evicted_extent_bytes": self.evicted_extent_bytes,
+                "stale_pin_reclaims": self.stale_pin_reclaims,
             }
 
 
-# Process-global instance shared by fragments and views. Tests may swap the
-# budget (set_budget) or replace the instance outright.
+# Process-global instance shared by fragments, views and the hbm extent
+# layer. Tests may swap the budget (set_budget) or replace the instance
+# outright.
 DEVICE_CACHE = DeviceCache()
 
 

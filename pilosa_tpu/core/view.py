@@ -123,9 +123,13 @@ class View:
     #
     # A "stack" is one row materialized across a shard list as a dense
     # uint32[S, W] device array (shard-axis sharded under an active mesh).
-    # Stacks are cached in the global budgeted device cache, keyed by the
-    # fragments' mutation versions — a write to any covered fragment makes
-    # the key miss and the stack rebuild lazily.
+    # Staging goes through the HBM residency layer (pilosa_tpu/hbm/):
+    # big stacks are split into shard-major EXTENTS that page in/out of
+    # the budgeted device cache individually, keyed by the fragments'
+    # mutation versions — a write to any covered fragment makes the keys
+    # miss and the affected slices rebuild lazily. Callers on the compiled
+    # query path pass their lowering's ExtentTable so the staged extents
+    # stay pinned through the plan's dispatch.
 
     def _stack_key(self, kind: str, ident, shards: tuple) -> tuple:
         from pilosa_tpu.parallel import mesh as pmesh
@@ -136,10 +140,11 @@ class View:
         )
         return (self._stack_token, kind, ident, shards, versions, pmesh.mesh_epoch())
 
-    def row_stack(self, row_id: int, shards) -> Optional[object]:
+    def row_stack(self, row_id: int, shards, extents=None) -> Optional[object]:
         """uint32[S, W] device stack of one row over `shards`, or None when
-        no listed shard has a fragment (the row is wholly absent)."""
-        from pilosa_tpu.parallel import mesh as pmesh
+        no listed shard has a fragment (the row is wholly absent).
+        `extents` (hbm.ExtentTable) receives the pinned extent keys."""
+        from pilosa_tpu.hbm import residency as hbm_res
 
         shards = tuple(shards)
         with self._mu:
@@ -148,21 +153,24 @@ class View:
             return None
         key = self._stack_key("row", row_id, shards)
 
-        def build():
+        def build_slice(lo: int, hi: int):
             rows = [
                 f.row_words(row_id)
                 if f is not None
                 else np.zeros(WORDS_PER_ROW, np.uint32)
-                for f in frags
+                for f in frags[lo:hi]
             ]
-            return pmesh.put_stack(np.stack(rows))
+            return np.stack(rows)
 
-        return DEVICE_CACHE.get_or_build(key, build)
+        return hbm_res.stage_row_stack(
+            key, len(shards), build_slice, table=extents
+        )
 
-    def plane_stack(self, row_ids, shards) -> Optional[object]:
+    def plane_stack(self, row_ids, shards, extents=None) -> Optional[object]:
         """uint32[D, S, W] device stack (BSI planes × shards), or None when
-        no listed shard has a fragment."""
-        from pilosa_tpu.parallel import mesh as pmesh
+        no listed shard has a fragment. Extents slice the shard axis: one
+        slice pages all D planes for its shard range together."""
+        from pilosa_tpu.hbm import residency as hbm_res
 
         row_ids = tuple(row_ids)
         shards = tuple(shards)
@@ -172,25 +180,26 @@ class View:
             return None
         key = self._stack_key("planes", row_ids, shards)
 
-        def build():
+        def build_slice(lo: int, hi: int):
+            part = frags[lo:hi]
             if not row_ids:  # bit_depth 0: empty plane axis
-                planes = np.zeros((0, len(frags), WORDS_PER_ROW), np.uint32)
-            else:
-                zeros = np.zeros(WORDS_PER_ROW, np.uint32)
-                planes = np.stack(
-                    [
-                        np.stack(
-                            [
-                                f.row_words(r) if f is not None else zeros
-                                for f in frags
-                            ]
-                        )
-                        for r in row_ids
-                    ]
-                )
-            return pmesh.put_stack(planes)
+                return np.zeros((0, len(part), WORDS_PER_ROW), np.uint32)
+            zeros = np.zeros(WORDS_PER_ROW, np.uint32)
+            return np.stack(
+                [
+                    np.stack(
+                        [
+                            f.row_words(r) if f is not None else zeros
+                            for f in part
+                        ]
+                    )
+                    for r in row_ids
+                ]
+            )
 
-        return DEVICE_CACHE.get_or_build(key, build)
+        return hbm_res.stage_plane_stack(
+            key, len(shards), build_slice, table=extents
+        )
 
     # -- fan-down helpers (view.go:367-474) --------------------------------
 

@@ -27,7 +27,17 @@ from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
-from pilosa_tpu.pql import Query
+from pilosa_tpu.pql import Call, Query
+
+
+def _noop_pad_call() -> Call:
+    """Zero-row no-op lane for pow2 padding: `Count(Difference())` lowers
+    to a PZero root — an all-zero stack that adds no operand reads and no
+    meaningful device work — unlike repeating the batch's last call, which
+    re-ran real (possibly heavy) device work for every pad lane (up to
+    ~2x waste on odd batch sizes). Pad results are masked out of the
+    per-waiter slices by construction (slicing stops at the real calls)."""
+    return Call(name="Count", children=[Call(name="Difference")])
 
 # Bound on calls merged into one execution: keeps lowered plan shapes in a
 # small family (compile cache) and bounds result-slicing latency for the
@@ -225,12 +235,13 @@ class CountBatcher:
             return
         calls = [c for w in batch for c in w.query.calls]
         self._record_round(len(calls))
-        # pad to a pow2 call count (repeat the last call; extras dropped):
-        # the multi-root plan compiles once per size family instead of once
-        # per distinct batch size
+        # pad to a pow2 call count with zero-row no-op lanes (masked out
+        # of results by the per-waiter slicing below): the multi-root plan
+        # compiles once per size family instead of once per distinct
+        # batch size, and the pad lanes cost ~no device work
         n_real = len(calls)
         target = 1 << max(n_real - 1, 0).bit_length()
-        calls = calls + [calls[-1]] * (target - n_real)
+        calls = calls + [_noop_pad_call() for _ in range(target - n_real)]
         merged = Query(calls=calls)
         try:
             _bump("merged_execs")

@@ -223,11 +223,18 @@ class _StackedLowering:
         collect: bool = False,
         no_sparse_guard: bool = False,
     ):
+        from pilosa_tpu.hbm import residency as hbm_res
+
         self.ex = ex
         self.idx = idx
         self.shards = list(shards)
         self.operands: List[Any] = []
         self.scalars: List[int] = []
+        # extent pins taken while staging this lowering's operand stacks
+        # (hbm/residency.py): ownership transfers to the lowered plan,
+        # which releases them after its compiled dispatch; every failure
+        # path below must release instead (no pin may outlive its query)
+        self.extents = hbm_res.ExtentTable()
         self._call_memo: Dict[int, PNode] = {}
         self._leaf_memo: Dict[Tuple, Any] = {}
         # collect mode: walk the tree recording touched views (semantic
@@ -270,7 +277,7 @@ class _StackedLowering:
                 node = PLeaf(0)
             else:
                 self._stack_guard(view)
-                arr = view.row_stack(row_id, self.shards)
+                arr = view.row_stack(row_id, self.shards, extents=self.extents)
                 if arr is None:
                     node = PZero()
                 else:
@@ -288,7 +295,9 @@ class _StackedLowering:
                 return 0
             self._stack_guard(view, mult=bit_depth)
             arr = view.plane_stack(
-                range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth), self.shards
+                range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth),
+                self.shards,
+                extents=self.extents,
             )
             if arr is None:
                 self._leaf_memo[key] = None
@@ -653,6 +662,60 @@ class Executor:
         return s
 
     # ------------------------------------------------------------------
+    # prefetch warming (pilosa_tpu/hbm/)
+    # ------------------------------------------------------------------
+
+    _WARM_BITMAP = frozenset(
+        {"Row", "Range", "Union", "Intersect", "Difference", "Xor", "Not",
+         "All", "Shift"}
+    )
+
+    def warm(self, index_name: str, query, shards=None) -> int:
+        """Stage a query's operand extents WITHOUT dispatching — the
+        prefetch path (hbm/prefetch.py). Dispatches serialize behind
+        plan._DISPATCH_MU but host->device staging does not, so a queued
+        query's extents ride PCIe while the current dispatch runs.
+
+        Best-effort by contract: every failure is swallowed (a warm miss
+        costs only the staging the real query would do anyway), the query
+        is deep-copied before translation (the admission-held original
+        must not be mutated), and nothing is pinned past this call.
+        Returns the number of call trees warmed (introspection/tests)."""
+        import copy
+
+        warmed = 0
+        try:
+            idx = self.holder.index(index_name)
+            if idx is None:
+                return 0
+            q = (
+                copy.deepcopy(query)
+                if isinstance(query, Query)
+                else parse(str(query))
+            )
+            translation.translate_query(idx, q)
+            for c in q.calls:
+                child = None
+                if c.name == "Count" and len(c.children) == 1:
+                    child = c.children[0]
+                elif c.name in self._WARM_BITMAP:
+                    child = c
+                if child is None:
+                    continue
+                try:
+                    shard_list = self._shards_for(idx, shards, child)
+                    plans = self._lower_plans(idx, child, shard_list)
+                except Exception:  # noqa: BLE001 - warming is best-effort
+                    continue
+                if plans:
+                    for sp in plans:
+                        sp.release_extents()
+                    warmed += 1
+        except Exception:  # noqa: BLE001 - warming must never raise
+            pass
+        return warmed
+
+    # ------------------------------------------------------------------
     # dispatch (executor.go:274)
     # ------------------------------------------------------------------
 
@@ -723,7 +786,10 @@ class Executor:
         if lowered is None:
             return None
         roots, low, n_out, out_shards = lowered
-        return StackedPlan(roots[0], low.operands, low.scalars, n_out, out_shards)
+        return StackedPlan(
+            roots[0], low.operands, low.scalars, n_out, out_shards,
+            extents=low.extents,
+        )
 
     def _lower_plans(self, idx: Index, c: Call, shard_list) -> Optional[List[StackedPlan]]:
         """One stacked plan when the operands fit the device budget; a
@@ -741,10 +807,22 @@ class Executor:
                 return []
             roots, low, n_out, out_shards = lowered
             return [
-                StackedPlan(roots[0], low.operands, low.scalars, n_out, out_shards)
+                StackedPlan(
+                    roots[0], low.operands, low.scalars, n_out, out_shards,
+                    extents=low.extents,
+                )
             ]
 
         return self._chunk_by_budget(list(shard_list), one)
+
+    @staticmethod
+    def _release_chunk_extents(items) -> None:
+        """Unpin the extent tables of lowered-but-abandoned chunk results
+        (plans carry one; BSI operand tuples already released theirs)."""
+        for it in items or ():
+            rel = getattr(it, "release_extents", None)
+            if rel is not None:
+                rel()
 
     @staticmethod
     def _chunk_by_budget(shard_list, lower_one):
@@ -752,7 +830,9 @@ class Executor:
         lower_one(chunk) returns a list of per-chunk results ([] = empty
         range) or None for genuinely unsupported shapes; BudgetExceeded
         splits the shard axis until chunks fit (or bottoms out below 16
-        shards, where the per-shard fallback takes over)."""
+        shards, where the per-shard fallback takes over). A half that
+        fails must not abandon the other half's lowered plans with their
+        extent pins still held."""
         try:
             return lower_one(shard_list)
         except BudgetExceeded:
@@ -760,8 +840,14 @@ class Executor:
                 return None  # can't subdivide usefully: per-shard fallback
             mid = len(shard_list) // 2
             left = Executor._chunk_by_budget(shard_list[:mid], lower_one)
-            right = Executor._chunk_by_budget(shard_list[mid:], lower_one)
+            try:
+                right = Executor._chunk_by_budget(shard_list[mid:], lower_one)
+            except BaseException:
+                Executor._release_chunk_extents(left)
+                raise
             if left is None or right is None:
+                Executor._release_chunk_extents(left)
+                Executor._release_chunk_extents(right)
                 return None
             return left + right
 
@@ -795,17 +881,31 @@ class Executor:
             aug = shard_list + sorted(extra)
         else:
             aug = shard_list
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
         low = _StackedLowering(self, idx, aug)
         try:
-            roots = [low.lower(c) for c in calls]
+            # defer budget eviction across this query's operand staging:
+            # making room for operand K by evicting operand K+1's extents
+            # (LRU's cyclic-scan cascade) would re-upload the whole
+            # working set every query (core/devcache.py deferred_eviction)
+            with DEVICE_CACHE.deferred_eviction():
+                roots = [low.lower(c) for c in calls]
         except SparseView:
+            low.extents.release()
             return self._lower_roots_compacted(idx, calls, shard_list, aug, k)
         except BudgetExceeded:
+            low.extents.release()
             raise  # recoverable by shard-axis chunking (_lower_plans)
         except Unsupported:
+            low.extents.release()
             return None
+        except BaseException:
+            low.extents.release()  # semantic ExecErrors etc. propagate
+            raise
         if not low.operands:
             # nothing materialized anywhere: trivial (empty) result
+            low.extents.release()
             return self._EMPTY_LOWER if empty_ok else None
         return roots, low, len(shard_list), shard_list
 
@@ -839,14 +939,23 @@ class Executor:
             return None  # nothing anywhere: the serial loop is all-None
         req = set(shard_list)
         n_out = sum(1 for s in compact if s in req)
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
         low = _StackedLowering(self, idx, compact, no_sparse_guard=True)
         try:
-            roots = [low.lower(c) for c in calls]
+            with DEVICE_CACHE.deferred_eviction():
+                roots = [low.lower(c) for c in calls]
         except BudgetExceeded:
+            low.extents.release()
             raise  # recoverable by shard-axis chunking (_lower_plans)
         except Unsupported:
+            low.extents.release()
             return None
+        except BaseException:
+            low.extents.release()
+            raise
         if not low.operands:
+            low.extents.release()
             return None
         # requested shards precede the aug extras in `compact`, so the
         # first n_out positions are exactly the kept requested shards
@@ -859,12 +968,17 @@ class Executor:
         plans = self._lower_plans(idx, c, shard_list)
         if plans is not None:
             segments = {}
-            for sp in plans:
-                stack = np.asarray(sp.rows())
-                for i, shard in enumerate(sp.out_shards):
-                    if stack[i].any():
-                        # copy: a slice view would pin the whole [S, W] stack
-                        segments[shard] = stack[i].copy()
+            try:
+                for sp in plans:
+                    stack = np.asarray(sp.rows())
+                    for i, shard in enumerate(sp.out_shards):
+                        if stack[i].any():
+                            # copy: a slice view would pin the whole [S, W] stack
+                            segments[shard] = stack[i].copy()
+            finally:
+                # a failing chunk must not leave later chunks' extents pinned
+                for sp in plans:
+                    sp.release_extents()
             return self._finish_bitmap_row(idx, c, Row(segments), opt)
         segments = {}
         memo: dict = {}
@@ -1177,7 +1291,10 @@ class Executor:
         if lowered is None:
             return None
         roots, low, n_out, out_shards = lowered
-        mp = MultiCountPlan(roots, low.operands, low.scalars, n_out, out_shards)
+        mp = MultiCountPlan(
+            roots, low.operands, low.scalars, n_out, out_shards,
+            extents=low.extents,
+        )
         return mp.counts()
 
     def _execute_count(self, idx: Index, c: Call, shards) -> int:
@@ -1188,7 +1305,11 @@ class Executor:
         if plans is not None:
             # one jitted dispatch + one [S] host read per (budget-sized)
             # shard chunk — usually exactly one
-            return sum(sp.count() for sp in plans)
+            try:
+                return sum(sp.count() for sp in plans)
+            finally:
+                for sp in plans:
+                    sp.release_extents()
         # Per-shard fallback: the algebra still lowers shard-by-shard, but
         # counts are fetched in fused chunked reads (one [G] transfer per
         # _FALLBACK_READ_CHUNK shards) instead of one host sync per shard —
@@ -1255,30 +1376,39 @@ class Executor:
         ]
         if not bsi_shards:
             return self._BSI_EMPTY
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
         low = _StackedLowering(self, idx, bsi_shards, no_sparse_guard=True)
         try:
-            low._stack_guard(bsiv, mult=f.options.bit_depth + 3)
-            filt = None
-            if filter_call is not None:
-                root = low.lower(filter_call)
-                if isinstance(root, PZero):
+            with DEVICE_CACHE.deferred_eviction():
+                low._stack_guard(bsiv, mult=f.options.bit_depth + 3)
+                filt = None
+                if filter_call is not None:
+                    root = low.lower(filter_call)
+                    if isinstance(root, PZero):
+                        return self._BSI_EMPTY
+                    if not low.operands:
+                        return None
+                    sp = StackedPlan(
+                        root, low.operands, low.scalars, len(bsi_shards)
+                    )
+                    filt = sp.rows_full()
+                exists = bsiv.row_stack(BSI_EXISTS_BIT, low.shards)
+                if exists is None:
                     return self._BSI_EMPTY
-                if not low.operands:
-                    return None
-                sp = StackedPlan(root, low.operands, low.scalars, len(bsi_shards))
-                filt = sp.rows_full()
-            exists = bsiv.row_stack(BSI_EXISTS_BIT, low.shards)
-            if exists is None:
-                return self._BSI_EMPTY
-            sign = bsiv.row_stack(BSI_SIGN_BIT, low.shards)
-            planes = bsiv.plane_stack(
-                range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + f.options.bit_depth),
-                low.shards,
-            )
+                sign = bsiv.row_stack(BSI_SIGN_BIT, low.shards)
+                planes = bsiv.plane_stack(
+                    range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + f.options.bit_depth),
+                    low.shards,
+                )
         except BudgetExceeded:
             raise  # recoverable: _bsi_chunks halves the shard axis
         except Unsupported:
             return None
+        finally:
+            # extent pins here protect the staging window only (the
+            # aggregate dispatches hold the assembled arrays themselves)
+            low.extents.release()
         return exists, sign, planes, filt
 
     def _bsi_chunks(self, idx: Index, c: Call, f: Field, shard_list):
@@ -2466,25 +2596,30 @@ class Executor:
         ]
         if not gb_shards:
             return {}
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
         low = _StackedLowering(self, idx, gb_shards, no_sparse_guard=True)
         planes_list = []
         try:
-            filt = None
-            if filter_call is not None:
-                root = low.lower(filter_call)
-                if isinstance(root, PZero) or not low.operands:
-                    return {}  # filter matches nothing anywhere
-                filt = StackedPlan(
-                    root, low.operands, low.scalars, len(gb_shards)
-                ).rows_full()
-            for v, rows in zip(child_views, child_rows):
-                low._stack_guard(v, mult=max(len(rows), 1))
-                p = v.plane_stack(rows, low.shards)
-                if p is None:
-                    return {}
-                planes_list.append(p)
+            with DEVICE_CACHE.deferred_eviction():
+                filt = None
+                if filter_call is not None:
+                    root = low.lower(filter_call)
+                    if isinstance(root, PZero) or not low.operands:
+                        return {}  # filter matches nothing anywhere
+                    filt = StackedPlan(
+                        root, low.operands, low.scalars, len(gb_shards)
+                    ).rows_full()
+                for v, rows in zip(child_views, child_rows):
+                    low._stack_guard(v, mult=max(len(rows), 1))
+                    p = v.plane_stack(rows, low.shards)
+                    if p is None:
+                        return {}
+                    planes_list.append(p)
         except Unsupported:
             return None
+        finally:
+            low.extents.release()  # staging-window pins (see _stacked_bsi)
         from pilosa_tpu.exec import groupby as qgb
 
         return qgb.group_by_device(planes_list, child_rows, filt)

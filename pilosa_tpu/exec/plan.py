@@ -275,9 +275,17 @@ class StackedPlan:
     `out_shards` maps output stack positions 0..n_shards-1 back to shard
     ids: under compacted lowering (SparseView recovery) the stack covers
     only present shards, so consumers must not assume position == the
-    requested shard list."""
+    requested shard list.
 
-    __slots__ = ("root", "operands", "scalars", "n_shards", "out_shards")
+    `extents` (hbm.ExtentTable, optional) holds the pins staging took on
+    this plan's operand extents: they stay pinned — unevictable — from
+    lowering THROUGH the compiled dispatch, and are released in the
+    dispatch `finally` (under the same _DISPATCH_MU hold, so release
+    ordering matches the one-program-at-a-time execution model). Release
+    is idempotent; re-dispatching a released plan runs unpinned, which is
+    safe — the assembled operand arrays hold their own device buffers."""
+
+    __slots__ = ("root", "operands", "scalars", "n_shards", "out_shards", "extents")
 
     def __init__(
         self,
@@ -286,53 +294,74 @@ class StackedPlan:
         scalars: List[int],
         n_shards: int,
         out_shards: Optional[List[int]] = None,
+        extents=None,
     ):
         self.root = root
         self.operands = operands
         self.scalars = scalars
         self.n_shards = n_shards
         self.out_shards = out_shards
+        self.extents = extents
 
     def _scalar_args(self) -> Tuple:
         return tuple(jnp.uint32(s) for s in self.scalars)
+
+    def release_extents(self) -> None:
+        """Unpin this plan's operand extents (idempotent). Called by the
+        dispatch methods' finally; executor error paths also call it so a
+        lowered-but-never-dispatched plan cannot leak pins."""
+        if self.extents is not None:
+            self.extents.release()
 
     def count(self) -> int:
         """Total count: ONE jitted dispatch + one [S] host read, summed in
         exact Python ints (replaces the per-shard int() sync loop)."""
         STATS["evals"] += 1
         with _DISPATCH_MU:
-            counts = _eval_jit(
-                self.root, "count", tuple(self.operands), self._scalar_args()
-            )
-            host = np.asarray(counts[: self.n_shards], dtype=np.uint64)
+            try:
+                counts = _eval_jit(
+                    self.root, "count", tuple(self.operands), self._scalar_args()
+                )
+                host = np.asarray(counts[: self.n_shards], dtype=np.uint64)
+            finally:
+                self.release_extents()
         return int(host.sum())
 
     def shard_counts(self) -> np.ndarray:
         STATS["evals"] += 1
         with _DISPATCH_MU:
-            counts = _eval_jit(
-                self.root, "count", tuple(self.operands), self._scalar_args()
-            )
-            return np.asarray(counts)[: self.n_shards]
+            try:
+                counts = _eval_jit(
+                    self.root, "count", tuple(self.operands), self._scalar_args()
+                )
+                return np.asarray(counts)[: self.n_shards]
+            finally:
+                self.release_extents()
 
     def rows(self) -> jax.Array:
         """Materialized [S, W] result stack (padded shards trimmed)."""
         STATS["evals"] += 1
         with _DISPATCH_MU:
-            out = _eval_jit(
-                self.root, "row", tuple(self.operands), self._scalar_args()
-            )
-            return out[: self.n_shards].block_until_ready()
+            try:
+                out = _eval_jit(
+                    self.root, "row", tuple(self.operands), self._scalar_args()
+                )
+                return out[: self.n_shards].block_until_ready()
+            finally:
+                self.release_extents()
 
     def rows_full(self) -> jax.Array:
         """Materialized result stack INCLUDING mesh-padded shards (all-zero
         rows), for composing with other padded [S, W] stacks on device."""
         STATS["evals"] += 1
         with _DISPATCH_MU:
-            out = _eval_jit(
-                self.root, "row", tuple(self.operands), self._scalar_args()
-            )
-            return out.block_until_ready()
+            try:
+                out = _eval_jit(
+                    self.root, "row", tuple(self.operands), self._scalar_args()
+                )
+                return out.block_until_ready()
+            finally:
+                self.release_extents()
 
 
 class MultiCountPlan:
@@ -340,25 +369,35 @@ class MultiCountPlan:
     multi-Count PQL query as ONE jitted dispatch + one [N, S] host read
     (the per-dispatch overhead and any shared operand reads amortize over
     the batch — the reference answers each call separately,
-    executor.go:231 execute loop)."""
+    executor.go:231 execute loop). Extent pins release after the dispatch,
+    as in StackedPlan."""
 
-    __slots__ = ("roots", "operands", "scalars", "n_shards", "out_shards")
+    __slots__ = ("roots", "operands", "scalars", "n_shards", "out_shards", "extents")
 
-    def __init__(self, roots, operands, scalars, n_shards, out_shards=None):
+    def __init__(self, roots, operands, scalars, n_shards, out_shards=None,
+                 extents=None):
         self.roots = list(roots)
         self.operands = operands
         self.scalars = scalars
         self.n_shards = n_shards
         self.out_shards = out_shards
+        self.extents = extents
+
+    def release_extents(self) -> None:
+        if self.extents is not None:
+            self.extents.release()
 
     def counts(self) -> List[int]:
         STATS["evals"] += 1
         with _DISPATCH_MU:
-            out = _eval_multi_jit(
-                tuple(self.roots),
-                "count",
-                tuple(self.operands),
-                tuple(jnp.uint32(s) for s in self.scalars),
-            )
-            h = np.asarray(out, dtype=np.uint64)[:, : self.n_shards]
+            try:
+                out = _eval_multi_jit(
+                    tuple(self.roots),
+                    "count",
+                    tuple(self.operands),
+                    tuple(jnp.uint32(s) for s in self.scalars),
+                )
+                h = np.asarray(out, dtype=np.uint64)[:, : self.n_shards]
+            finally:
+                self.release_extents()
         return [int(x) for x in h.sum(axis=1)]

@@ -1,0 +1,96 @@
+"""Background extent prefetcher: warm the NEXT query's operands while the
+current dispatch runs.
+
+The compiled dispatch serializes behind exec/plan.py's _DISPATCH_MU, but
+host->device staging does not — so while one query occupies the device, a
+queued query's extents can ride PCIe concurrently. The admission
+controller feeds this (sched/admission.py maybe_prefetch): whenever its
+queue peek says a new arrival will wait, the arrival's warm closure (a
+stage-only lowering, exec/executor.py Executor.warm) is offered here.
+
+Single worker + bounded queue, both deliberate: one worker cannot compete
+with query threads for host CPU, and the bounded deque sheds (drops the
+oldest offer) under burst instead of growing a backlog of stale warms.
+offer() never blocks and the worker swallows every task error — prefetch
+is an optimization, never a failure source. Thread discipline follows the
+tracked-lock rules (utils/locks.py); the worker marks itself with
+residency.prefetching() so warmed extents are credited as prefetch hits
+when the real query lands on them.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, Optional
+
+from pilosa_tpu.hbm import residency
+from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+
+
+class Prefetcher:
+    def __init__(self, depth: int = 4, logger: Optional[Callable] = None):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self.depth = depth
+        self.logger = logger or (lambda msg: None)
+        self._mu = TrackedLock("hbm.prefetch_mu")
+        self._cv = TrackedCondition(self._mu, name="hbm.prefetch_cv")
+        self._q: Deque[Callable[[], None]] = deque()
+        self._closing = False
+        self._thread: Optional[threading.Thread] = None
+        self.offered = 0
+        self.dropped = 0
+
+    def start(self) -> "Prefetcher":
+        with self._mu:
+            if self._thread is not None:
+                return self
+            self._closing = False
+            self._thread = threading.Thread(
+                target=self._run, name="hbm-prefetch", daemon=True
+            )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._mu:
+            self._closing = True
+            self._q.clear()
+            self._cv.notify_all()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def offer(self, warm: Callable[[], None]) -> bool:
+        """Enqueue a warm task; never blocks. Under burst the OLDEST offer
+        is dropped — the freshest queued query is the one most likely to
+        still be waiting when its extents arrive."""
+        with self._mu:
+            if self._closing or self._thread is None:
+                return False
+            self.offered += 1
+            if len(self._q) >= self.depth:
+                self._q.popleft()
+                self.dropped += 1
+            self._q.append(warm)
+            self._cv.notify()
+            return True
+
+    def idle(self) -> bool:
+        with self._mu:
+            return not self._q
+
+    def _run(self) -> None:
+        while True:
+            with self._mu:
+                while not self._q and not self._closing:
+                    self._cv.wait()
+                if self._closing:
+                    return
+                task = self._q.popleft()
+            try:
+                with residency.prefetching():
+                    task()
+            except Exception as e:  # noqa: BLE001 - warming must never fail anything
+                self.logger(f"hbm prefetch task error: {e!r}")

@@ -1,0 +1,293 @@
+"""Extent-granular operand residency.
+
+A stacked query operand is `uint32[S, W]` (one row across S shards) or
+`uint32[D, S, W]` (D BSI planes x S shards). Staged monolithically, an HBM
+budget below one query's working set churns the WHOLE operand set per
+query. Here the shard axis is split into EXTENTS — fixed-size shard-major
+slices of `hbm-extent-rows` row-planes — that are individually LRU-tracked
+in the device cache (core/devcache.py), so under pressure only the evicted
+slices re-upload and the operand is reassembled with one device-side
+concat (HBM bandwidth, not PCIe).
+
+Anti-thrash protocol (the reason extents beat plain LRU's cyclic-scan
+pathology): staging an operand first PINS its already-resident extents,
+then builds the missing ones — so staging extent k can never evict extent
+k-1 of the same operand, and a budget one slice short of the working set
+costs one slice of re-upload per query, not the whole working set. The
+pins are handed to the plan's ExtentTable and held through the compiled
+dispatch (exec/plan.py releases them in its dispatch `finally`), so an
+in-flight operand's extents are never evicted mid-query; with no table
+(ad-hoc callers) they release when assembly returns.
+
+Mesh note: under an active device mesh (parallel/mesh.py) operands carry
+NamedSharding placement and XLA owns their layout across chips — extent
+slicing would fight the SPMD partitioner, so mesh-placed stacks stage
+monolithically (still budget-tracked). Extent paging targets the
+single-chip serving path, where the measured eviction cliff lives.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+from pilosa_tpu.core.devcache import DEVICE_CACHE
+from pilosa_tpu.utils.locks import TrackedLock
+
+_DEFAULT_EXTENT_ROWS = 256
+
+
+def _env_extent_rows() -> int:
+    raw = os.environ.get("PILOSA_TPU_HBM_EXTENT_ROWS")
+    try:
+        return int(raw) if raw else _DEFAULT_EXTENT_ROWS
+    except ValueError:
+        return _DEFAULT_EXTENT_ROWS
+
+
+_extent_rows = _env_extent_rows()
+
+_stats_mu = TrackedLock("hbm.stats_mu")
+_counters: Dict[str, int] = {
+    "restage_bytes": 0,  # host->device upload bytes through this layer
+    "prefetch_hits": 0,  # query staging hit an extent the prefetcher warmed
+    "prefetch_staged": 0,  # extents the prefetcher uploaded
+}
+_prefetched_keys: set = set()
+
+_tls = threading.local()
+
+
+def configure(
+    extent_rows: Optional[int] = None, pin_timeout: Optional[float] = None
+) -> None:
+    """Install the server's [hbm] knobs (cli/config.py -> server/node.py).
+    extent_rows <= 0 disables extent slicing (monolithic staging);
+    pin_timeout is the stale-pin safety valve on the shared device cache."""
+    global _extent_rows
+    if extent_rows is not None:
+        _extent_rows = int(extent_rows)
+    if pin_timeout is not None:
+        DEVICE_CACHE.pin_timeout = float(pin_timeout)
+
+
+def extent_rows() -> int:
+    return _extent_rows
+
+
+def _bump(key: str, value: int = 1) -> None:
+    with _stats_mu:
+        _counters[key] += value
+
+
+def reset_stats() -> None:
+    with _stats_mu:
+        for k in _counters:
+            _counters[k] = 0
+        _prefetched_keys.clear()
+
+
+def stats_snapshot() -> Dict[str, int]:
+    """hbm.* gauge values (NodeServer.publish_cache_gauges): residency
+    comes from the shared device-cache ledger, traffic counters from this
+    module."""
+    snap = DEVICE_CACHE.stats_snapshot()
+    with _stats_mu:
+        return {
+            "resident_extents": snap["resident_extents"],
+            "pinned_bytes": snap["pinned_bytes"],
+            "restage_bytes": _counters["restage_bytes"],
+            "prefetch_hits": _counters["prefetch_hits"],
+            "prefetch_staged": _counters["prefetch_staged"],
+            "evicted_extent_bytes": snap["evicted_extent_bytes"],
+        }
+
+
+@contextmanager
+def prefetching():
+    """Mark this thread as the prefetch worker: extents it stages are
+    remembered, and a later query hit on one counts as a prefetch hit."""
+    _tls.active = True
+    try:
+        yield
+    finally:
+        _tls.active = False
+
+
+def _in_prefetch() -> bool:
+    return getattr(_tls, "active", False)
+
+
+class ExtentTable:
+    """The extents one lowered plan's operands are pinned on. Ownership of
+    one pin per key transfers here from staging; exec/plan.py releases in
+    its dispatch `finally`. Release is idempotent — double release (e.g.
+    an error path AND the plan finally) never over-decrements."""
+
+    __slots__ = ("_keys", "_released")
+
+    def __init__(self) -> None:
+        self._keys: List[Tuple] = []
+        self._released = False
+
+    def add(self, keys: List[Tuple]) -> None:
+        if self._released:
+            # staging after release (a plan re-lowered late): hold nothing
+            DEVICE_CACHE.unpin_all(keys)
+            return
+        self._keys.extend(keys)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        DEVICE_CACHE.unpin_all(self._keys)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    @property
+    def keys(self) -> List[Tuple]:
+        return list(self._keys)
+
+
+# ---------------------------------------------------------------------------
+# staging
+# ---------------------------------------------------------------------------
+
+
+def _note_upload(nbytes: int, key: Tuple, built: bool) -> None:
+    """Book one extent acquisition: uploads count restage bytes; hits on
+    prefetcher-staged extents count prefetch hits."""
+    if built:
+        _bump("restage_bytes", nbytes)
+        if _in_prefetch():
+            _bump("prefetch_staged")
+            with _stats_mu:
+                _prefetched_keys.add(key)
+        return
+    if not _in_prefetch():
+        with _stats_mu:
+            if key in _prefetched_keys:
+                _prefetched_keys.discard(key)
+                _counters["prefetch_hits"] += 1
+
+
+def _stage(
+    key_base: Tuple,
+    n_shards: int,
+    build_slice: Callable[[int, int], object],
+    shard_axis: int,
+    table: Optional[ExtentTable],
+):
+    """Assemble one device operand from per-extent cache entries.
+
+    build_slice(lo, hi) -> host ndarray covering shard positions [lo, hi)
+    of the stack. Returns the assembled device array; every extent ends
+    pinned exactly once — ownership goes to `table` (released after the
+    plan's dispatch) or is released here when no table is given."""
+    import jax
+
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    rows = _extent_rows
+    if pmesh.active_mesh() is not None or rows <= 0 or n_shards <= rows:
+        # monolithic: mesh-placed stacks (XLA owns cross-chip layout) and
+        # stacks no bigger than one extent. Same cache key as the classic
+        # path; still budget-tracked and pin-protected.
+        built: List[bool] = []
+
+        def build_all():
+            built.append(True)
+            arr = pmesh.put_stack(build_slice(0, n_shards))
+            return arr
+
+        arr = DEVICE_CACHE.get_or_build(
+            key_base, build_all, extent=True, pin=True
+        )
+        _note_upload(int(getattr(arr, "nbytes", 0)), key_base, bool(built))
+        if table is not None:
+            table.add([key_base])
+        else:
+            DEVICE_CACHE.unpin(key_base)
+        return arr
+
+    spans = [(lo, min(lo + rows, n_shards)) for lo in range(0, n_shards, rows)]
+    keys = [key_base + ("ext", rows, i) for i in range(len(spans))]
+    # pass 1: pin every already-resident extent of this operand BEFORE
+    # building any missing one — otherwise staging slice k evicts slice
+    # k-1 and a cyclic scan re-uploads the whole stack (LRU's classic
+    # sequential-scan pathology, i.e. the monolithic cliff all over again)
+    resident = [DEVICE_CACHE.pin_if_present(k) for k in keys]
+    # `held` tracks EVERY pin this staging owns from the start (incl.
+    # pass-1 pins on extents the loop has not reached yet): a build
+    # failure mid-loop must release all of them, not just the visited ones
+    held: List[Tuple] = [k for k, r in zip(keys, resident) if r]
+    parts = []
+    try:
+        for (lo, hi), key, was_resident in zip(spans, keys, resident):
+            arr = None
+            if was_resident:
+                arr = DEVICE_CACHE.get(key)
+                if arr is None:
+                    # invalidated between pin and get (write landed): the
+                    # pin now guards a zombie — drop it and rebuild fresh
+                    DEVICE_CACHE.unpin(key)
+                    held.remove(key)
+                    was_resident = False
+                else:
+                    _note_upload(
+                        int(getattr(arr, "nbytes", 0)), key, built=False
+                    )
+            if arr is None:
+                built = []
+
+                def build(lo=lo, hi=hi, built=built):
+                    built.append(True)
+                    return jax.device_put(build_slice(lo, hi))
+
+                arr = DEVICE_CACHE.get_or_build(
+                    key, build, extent=True, pin=True
+                )
+                held.append(key)
+                _note_upload(
+                    int(getattr(arr, "nbytes", 0)), key, bool(built)
+                )
+            parts.append(arr)
+    except BaseException:
+        DEVICE_CACHE.unpin_all(held)
+        raise
+    if table is not None:
+        table.add(held)
+    assembled = (
+        parts[0]
+        if len(parts) == 1
+        else jax.numpy.concatenate(parts, axis=shard_axis)
+    )
+    if table is None:
+        DEVICE_CACHE.unpin_all(held)
+    return assembled
+
+
+def stage_row_stack(
+    key_base: Tuple,
+    n_shards: int,
+    build_slice: Callable[[int, int], object],
+    table: Optional[ExtentTable] = None,
+):
+    """uint32[S, W] operand: extents slice axis 0 (the shard axis)."""
+    return _stage(key_base, n_shards, build_slice, 0, table)
+
+
+def stage_plane_stack(
+    key_base: Tuple,
+    n_shards: int,
+    build_slice: Callable[[int, int], object],
+    table: Optional[ExtentTable] = None,
+):
+    """uint32[D, S, W] operand: extents slice axis 1; every extent carries
+    all D planes for its shard range (one slice pages the whole magnitude
+    ladder for those shards together — they are always used together)."""
+    return _stage(key_base, n_shards, build_slice, 1, table)

@@ -218,6 +218,10 @@ class AdmissionController:
         # on the query hot path, and scanning whole queues under
         # sched.mu there would serialize admission behind it
         self._queued_batchable: Dict[Optional[str], int] = {}
+        # optional HBM extent prefetcher (hbm/prefetch.py, wired by
+        # NodeServer when hbm-prefetch-depth > 0): maybe_prefetch() peeks
+        # the admitted queue and warms arrivals that are about to wait
+        self.prefetcher = None
         _live_controllers.add(self)
 
     # -- public surface ----------------------------------------------------
@@ -509,6 +513,29 @@ class AdmissionController:
         is over even though the slot is still held."""
         with self._cv:
             self._drop_batchable_locked(ticket.index)
+
+    def maybe_prefetch(self, warm: Optional[Callable[[], None]]) -> bool:
+        """Admitted-queue peek feeding the HBM prefetcher: when a new
+        arrival would WAIT (slots full or a queue already formed), its
+        warm closure — a stage-only lowering, Executor.warm — is offered
+        to the background prefetcher so the query's operand extents ride
+        PCIe while the current dispatch occupies the device. Queries that
+        would take the fast path are never offered: they are about to
+        stage for themselves anyway. Returns True when offered. The peek
+        is racy by design — warming an extent twice is a cache hit, and
+        warming for a query that got in anyway costs nothing."""
+        if warm is None or self.prefetcher is None:
+            return False
+        with self._cv:
+            would_wait = (
+                self._queued_total_locked() > 0
+                or self._inflight >= self.max_concurrent
+            )
+        if not would_wait:
+            return False
+        # offer OUTSIDE sched.mu: the prefetcher takes its own lock and
+        # admission must never serialize behind another subsystem's mutex
+        return self.prefetcher.offer(warm)
 
     def queue_depth(self) -> int:
         with self._cv:

@@ -15,6 +15,15 @@ The estimate is intentionally cheap (no lowering, no fragment access)
 and intentionally conservative-but-bounded: admission weighting, not
 billing. Estimation must never fail a query — any error degrades to
 ZERO_COST and the query is admitted on the concurrency cap alone.
+
+Residency discount: bytes already resident on device don't need to be
+staged again, so the in-flight byte account reads TRUE residency — the
+estimate subtracts what the HBM extent store (core/devcache.py via
+pilosa_tpu/hbm/) currently holds for the views of the fields THIS query
+references (summed by the views' owner tokens, so there is no
+cross-index or cross-field aliasing). A warm repeat query therefore
+admits nearly byte-free instead of double-charging HBM the budget
+already accounts for.
 """
 
 from __future__ import annotations
@@ -98,6 +107,47 @@ def _call_rows(idx, c: Call) -> float:
     return rows
 
 
+def _referenced_fields(c: Call, out: set) -> None:
+    """Field names a call tree touches (same extraction rules as the
+    executor's _field_arg_name / condition args), for scoping the
+    residency discount to views this query can actually reuse."""
+    for k in c.args:
+        if not k.startswith("_") and k not in ("from", "to"):
+            out.add(k)
+    fname = c.args.get("field") or c.args.get("_field")
+    if isinstance(fname, str):
+        out.add(fname)
+    for child in c.children:
+        _referenced_fields(child, out)
+    for v in c.args.values():
+        if isinstance(v, Call):
+            _referenced_fields(v, out)
+
+
+def resident_bytes(idx, field_names: Optional[set] = None) -> int:
+    """Device bytes currently cached for `idx`'s views (row stacks, BSI
+    plane extents, per-row arrays), summed by owner token — restricted
+    to `field_names` when given, so a query is only discounted for views
+    IT touches (field A's warm gigabytes must not zero out field B's
+    cold admission weight). Metadata walk only — no fragment or device
+    access."""
+    from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+    total = 0
+    try:
+        fields = getattr(idx, "_fields", None) or {}
+        for name, f in fields.items():
+            if field_names is not None and name not in field_names:
+                continue
+            for v in getattr(f, "views", {}).values():
+                token = getattr(v, "_stack_token", None)
+                if token is not None:
+                    total += DEVICE_CACHE.owner_resident_bytes(token)
+    except Exception:  # noqa: BLE001 - estimation must never fail
+        return 0
+    return total
+
+
 def _shard_count(idx, shards: Optional[Sequence[int]]) -> int:
     if shards is not None:
         return max(1, len(shards))
@@ -151,6 +201,15 @@ def estimate(
                 continue
             peak = max(peak, min(raw, dispatch_cap))
             sweeps += max(1, math.ceil(raw / dispatch_cap))
+        if peak and idx is not None:
+            # cached-resident discount: operands already in HBM stage for
+            # free, so don't charge the byte account for them twice —
+            # scoped to the fields THIS query references
+            touched: set = set()
+            for c in calls:
+                _referenced_fields(c, touched)
+            if touched:
+                peak = max(0, peak - resident_bytes(idx, touched))
         return QueryCost(device_bytes=peak, sweeps=sweeps, write=write)
     except Exception:  # noqa: BLE001 - never fail admission on estimation
         return ZERO_COST

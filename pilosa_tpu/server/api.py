@@ -190,20 +190,24 @@ class API:
                         "sched.wait_ms", round(ticket.waited * 1000.0, 3)
                     )
                 try:
-                    batched, parsed = self._query_batched(
-                        index, query, shards, opt
-                    )
-                    if ticket is not None:
-                        # past the batcher: this query can no longer be
-                        # anyone's batch mate — drop it from the
-                        # adaptive-batching hint before serialization
-                        ticket.done_batching()
-                    if batched is not None:
-                        return batched
-                    return self.server.executor.execute_response(
-                        index, parsed if parsed is not None else query,
-                        shards=shards, opt=opt,
-                    )
+                    # per-query profiling hook: a real cProfile context
+                    # only while a /debug/pprof window is open (one
+                    # attribute read otherwise, server/profiling.py)
+                    with self.server.profiler.maybe_profile():
+                        batched, parsed = self._query_batched(
+                            index, query, shards, opt
+                        )
+                        if ticket is not None:
+                            # past the batcher: this query can no longer
+                            # be anyone's batch mate — drop it from the
+                            # adaptive-batching hint before serialization
+                            ticket.done_batching()
+                        if batched is not None:
+                            return batched
+                        return self.server.executor.execute_response(
+                            index, parsed if parsed is not None else query,
+                            shards=shards, opt=opt,
+                        )
                 finally:
                     dt = _time.perf_counter() - t0
                     stats = self.server.stats.with_tags(f"index:{index}")
@@ -268,6 +272,21 @@ class API:
         # — same predicate the routing in _query_batched uses, so the
         # hint can never count a query the batcher would divert
         batchable = batchmod.batch_eligible(query, shards, opt)
+        # HBM prefetch feed (hbm/prefetch.py): if this query is about to
+        # wait, stage its operand extents in the background while the
+        # current dispatch holds the device. Local reads only: a remote
+        # leg's shards are warmed by its own node, and a multi-node
+        # coordinator's local device holds just its share (warming the
+        # whole cluster-wide shard axis here would churn local HBM).
+        if (
+            not remote
+            and not qcost.write
+            and len(self.cluster.nodes) <= 1
+        ):
+            warm_q = query
+            scheduler.maybe_prefetch(
+                lambda: self.server.executor.warm(index, warm_q, shards)
+            )
         return scheduler.admit(
             cls=cls,
             cost=qcost,

@@ -258,6 +258,22 @@ class Handler(BaseHTTPRequestHandler):
     def get_debug_traces(self):
         self._reply(self.node.tracer.to_json())
 
+    @route("GET", "/debug/pprof")
+    def get_debug_pprof(self):
+        """On-demand CPU profile of a live node (reference:
+        http/handler.go:281 net/http/pprof). Blocks for ?seconds=N
+        (default 2, capped) while every query that executes runs under
+        cProfile; replies with the aggregated pstats text."""
+        from pilosa_tpu.server.profiling import ProfileWindowBusy
+
+        seconds = self._int_param("seconds", 2)
+        try:
+            text = self.node.profiler.capture(seconds)
+        except ProfileWindowBusy as e:
+            self._error(str(e), 409)
+            return
+        self._reply(None, raw=text.encode(), content_type="text/plain")
+
     @route("GET", "/schema")
     def get_schema(self):
         self._reply({"indexes": self.api.schema()})

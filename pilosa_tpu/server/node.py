@@ -72,6 +72,9 @@ class NodeServer:
         admission_byte_budget: int = 0,  # in-flight bytes; 0 = devcache budget
         admission_default_class: str = "interactive",  # headerless queries
         shed_retry_after: float = 1.0,  # Retry-After seconds on 429
+        hbm_extent_rows: int = 256,  # shards per operand extent; 0 = monolithic
+        hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
+        hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -151,6 +154,24 @@ class NodeServer:
                 stats=self.stats,
             )
             self.count_batcher.load_hint = self.scheduler.load
+        # HBM residency manager (pilosa_tpu/hbm/): extent-granular paging
+        # over the shared device cache, plus the optional background
+        # prefetcher fed by the scheduler's admitted-queue peek. The
+        # [hbm] knobs are PROCESS-global (like PILOSA_TPU_HBM_BUDGET_MB):
+        # all in-process nodes share one device and one extent store, so
+        # the last-constructed server's values win — multi-node-in-one-
+        # process harnesses must configure them consistently.
+        from pilosa_tpu import hbm as hbmmod
+
+        hbmmod.configure(
+            extent_rows=hbm_extent_rows, pin_timeout=hbm_pin_timeout
+        )
+        self.prefetcher = None
+        if hbm_prefetch_depth > 0 and self.scheduler is not None:
+            self.prefetcher = hbmmod.Prefetcher(
+                depth=hbm_prefetch_depth, logger=self.logger
+            ).start()
+            self.scheduler.prefetcher = self.prefetcher
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.probe_interval = probe_interval
@@ -164,6 +185,10 @@ class NodeServer:
         from pilosa_tpu.utils import tracing as tracingmod
 
         self.tracer = tracingmod.global_tracer()
+        # on-demand query profiling window (GET /debug/pprof?seconds=N)
+        from pilosa_tpu.server.profiling import QueryProfiler
+
+        self.profiler = QueryProfiler()
         self._httpd = None
         self._http_thread = None
         self._ae_thread = None
@@ -402,6 +427,15 @@ class NodeServer:
         self.stats.gauge("devcache.evictions", snap["evictions"])
         self.stats.gauge("devcache.hits", snap["hits"])
         self.stats.gauge("devcache.misses", snap["misses"])
+        # HBM residency manager gauges (pilosa_tpu/hbm/): extent paging,
+        # pin pressure and prefetch effectiveness
+        from pilosa_tpu import hbm as hbmmod
+
+        hsnap = hbmmod.stats_snapshot()
+        self.stats.gauge("hbm.resident_extents", hsnap["resident_extents"])
+        self.stats.gauge("hbm.pinned_bytes", hsnap["pinned_bytes"])
+        self.stats.gauge("hbm.restage_bytes", hsnap["restage_bytes"])
+        self.stats.gauge("hbm.prefetch_hits", hsnap["prefetch_hits"])
 
     def _ticker_error(self, ticker: str, exc: BaseException) -> None:
         """Background tickers must survive any failure, but never silently:
@@ -444,6 +478,9 @@ class NodeServer:
 
     def stop(self) -> None:
         self._closing.set()
+        self.profiler.close()  # unblock any open /debug/pprof window
+        if self.prefetcher is not None:
+            self.prefetcher.stop()  # joins the warm worker before teardown
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

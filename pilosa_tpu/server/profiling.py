@@ -1,0 +1,122 @@
+"""On-demand CPU profiling of a live node (reference: net/http/pprof at
+http/handler.go:281, `/debug/pprof/profile?seconds=N`).
+
+Python's cProfile is per-thread — enabling it in the HTTP handler thread
+that *requested* the profile would profile nothing but its own sleep. So
+the capture window works the way the node actually executes: while a
+window is open, every query run by server/api.py executes under its own
+cProfile.Profile (queries ARE the hot path — dispatch, staging, host
+reads all happen on the query thread), and the per-query profiles merge
+into one pstats report returned when the window closes. The requesting
+handler blocks for the window, exactly like Go's pprof endpoint.
+
+Outside a window the cost is one attribute read per query; profiling
+overhead exists only while an operator is actively capturing.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import threading
+import time
+from contextlib import nullcontext
+from typing import Callable, Optional
+
+from pilosa_tpu.utils.locks import TrackedLock
+
+MAX_WINDOW_SECONDS = 120.0
+
+
+class ProfileWindowBusy(Exception):
+    """A capture window is already open (one at a time: overlapping
+    windows would double-profile every query and interleave reports)."""
+
+
+class _QueryProfile:
+    """Context manager profiling one query into the active window."""
+
+    def __init__(self, profiler: "QueryProfiler"):
+        self._profiler = profiler
+        self._prof = cProfile.Profile()
+
+    def __enter__(self):
+        self._prof.enable()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self._prof.disable()
+        self._profiler._collect(self._prof)
+
+
+class QueryProfiler:
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._mu = TrackedLock("profiling.mu")
+        self._active = False
+        self._profiles: list = []
+        self._queries = 0
+        self._clock = clock
+        # set when the node is shutting down so a blocked capture returns
+        self._wake = threading.Event()
+
+    def maybe_profile(self):
+        """Per-query hook (server/api.py): a real profiling context while
+        a window is open, a no-op otherwise. The fast path is one
+        unlocked bool read — profiling must cost nothing when idle."""
+        if not self._active:
+            return nullcontext()
+        return _QueryProfile(self)
+
+    def _collect(self, prof: cProfile.Profile) -> None:
+        with self._mu:
+            if self._active:
+                self._profiles.append(prof)
+                self._queries += 1
+
+    def capture(self, seconds: float) -> str:
+        """Open a window, block for `seconds`, return aggregated pstats
+        text of every query that executed meanwhile."""
+        seconds = min(max(float(seconds), 0.0), MAX_WINDOW_SECONDS)
+        with self._mu:
+            if self._active:
+                raise ProfileWindowBusy(
+                    "a profile capture window is already open"
+                )
+            self._profiles = []
+            self._queries = 0
+            self._wake.clear()
+            self._active = True
+        try:
+            deadline = self._clock() + seconds
+            while not self._wake.is_set():
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    break
+                self._wake.wait(min(remaining, 0.25))
+        finally:
+            with self._mu:
+                self._active = False
+                profiles, self._profiles = self._profiles, []
+                queries = self._queries
+        header = (
+            f"pilosa-tpu cProfile capture: {seconds:g}s window, "
+            f"{queries} profiled quer{'y' if queries == 1 else 'ies'}\n"
+        )
+        if not profiles:
+            return header + "(no queries executed during the window)\n"
+        out = io.StringIO()
+        stats: Optional[pstats.Stats] = None
+        for prof in profiles:
+            if stats is None:
+                stats = pstats.Stats(prof, stream=out)
+            else:
+                stats.add(prof)
+        assert stats is not None
+        stats.sort_stats("cumulative")
+        stats.print_stats(80)
+        return header + out.getvalue()
+
+    def close(self) -> None:
+        """Unblock any open capture window (node shutdown)."""
+        self._wake.set()

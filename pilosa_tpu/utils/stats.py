@@ -71,6 +71,13 @@ STAT_NAMES = frozenset(
         "devcache.evictions",
         "devcache.hits",
         "devcache.misses",
+        # HBM residency manager (pilosa_tpu/hbm/): extent-granular paging,
+        # pinning and prefetch gauges, refreshed at scrape time alongside
+        # the devcache gauges
+        "hbm.resident_extents",
+        "hbm.pinned_bytes",
+        "hbm.restage_bytes",
+        "hbm.prefetch_hits",
     }
 )
 

@@ -72,6 +72,25 @@ def _sched_leak_guard():
 
 
 @pytest.fixture(autouse=True)
+def _hbm_pin_leak_guard():
+    """State-leak guard for HBM extent pins (pilosa_tpu/hbm/): every pin
+    staging takes must be released by the plan's dispatch finally or an
+    executor error path. A leaked pin makes its bytes permanently
+    unevictable — the budget wedges a little tighter on every leak."""
+    yield
+    from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+    snap = DEVICE_CACHE.stats_snapshot()
+    if snap["pinned_bytes"]:
+        # clean up so one leak doesn't cascade into later tests
+        DEVICE_CACHE.clear()
+        pytest.fail(
+            f"device-cache extent pins leaked: {snap['pinned_bytes']} "
+            "bytes still pinned after the test"
+        )
+
+
+@pytest.fixture(autouse=True)
 def _fault_plane_leak_guard():
     """State-leak guard: a test that installs a process-global
     FaultInjector or BreakerRegistry (faults.install_injector /

@@ -203,6 +203,72 @@ class TestGroupCommit:
             batchmod.MAX_BATCH_CALLS + 10
         )
 
+    def test_pow2_padding_uses_noop_lanes(self):
+        """Satellite: pad lanes are zero-row no-ops (Count(Difference())
+        -> PZero), NOT repeats of the last real call — repeating a heavy
+        call wasted up to ~2x device work on odd batch sizes. Pads are
+        masked out: every waiter gets exactly its own results."""
+        b = CountBatcher()
+        release = threading.Event()
+        merged_queries = []
+
+        def execute(q):
+            merged_queries.append(q)
+            if len(merged_queries) == 1:
+                release.wait(5)
+            return list(range(len(q.calls)))
+
+        threads = [
+            threading.Thread(
+                target=lambda: b.run("i", parse("Count(Row(f=1))"), execute)
+            )
+        ]
+        threads[0].start()
+        time.sleep(0.05)  # let it take leadership and block in execute
+        outs = []
+        for _ in range(3):  # 3 waiters -> merged round of 3, padded to 4
+            th = threading.Thread(
+                target=lambda: outs.append(
+                    b.run("i", parse("Count(Row(f=1))"), execute)
+                )
+            )
+            th.start()
+            threads.append(th)
+        time.sleep(0.1)
+        release.set()
+        for th in threads:
+            th.join(5)
+        merged = next(q for q in merged_queries if len(q.calls) == 4)
+        real, pad = merged.calls[:3], merged.calls[3]
+        assert all(c.name == "Count" for c in merged.calls)
+        assert all(c.children[0].name == "Row" for c in real)
+        # the pad lane is the zero-row no-op, not a repeat of a real call
+        assert pad.children[0].name == "Difference"
+        assert not pad.children[0].children
+        # pads masked out: each waiter saw exactly one (its own) result
+        assert sorted(len(o) for o in outs) == [1, 1, 1]
+
+    def test_noop_pad_call_counts_zero_end_to_end(self):
+        """The pad lane must execute as a true no-op on the real
+        executor: Count(Difference()) == 0 whatever data exists."""
+        from pilosa_tpu.core.field import FieldOptions
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.pql import Query
+
+        h = Holder().open()
+        idx = h.create_index("padx")
+        f = idx.create_field("f", FieldOptions())
+        f.set_bit(1, 7)
+        ex = Executor(h)
+        pad = batchmod._noop_pad_call()
+        assert ex.execute("padx", Query(calls=[pad])) == [0]
+        # and merged next to a real call, results stay position-correct
+        got = ex.execute(
+            "padx", Query(calls=[parse("Count(Row(f=1))").calls[0], pad])
+        )
+        assert got == [1, 0]
+
     def test_indexes_batch_independently(self):
         b = CountBatcher()
         release = threading.Event()

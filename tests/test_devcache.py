@@ -2,8 +2,14 @@
 
 The reference bounds storage residency via mmap + syswrap caps
 (/root/reference/syswrap/mmap.go, roaring.go:1437 RemapRoaringStorage);
-here the analog is the byte-budgeted LRU over device arrays.
+here the analog is the byte-budgeted LRU over device arrays — now the
+extent store for the HBM residency manager (pilosa_tpu/hbm/): builds are
+single-flight, entries can be pinned (eviction deferred), and invalidation
+of a pinned entry keeps its bytes on the ledger until the last unpin.
 """
+
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -60,6 +66,136 @@ class TestDeviceCacheUnit:
         c.put((t, 0), np.zeros(100, np.uint32))
         c.put((t, 0), np.zeros(50, np.uint32))
         assert c.bytes_used == 200
+
+
+class TestSingleFlightBuilds:
+    def test_concurrent_get_or_build_runs_one_build(self):
+        """Satellite acceptance: two threads get_or_build the same key ->
+        exactly one build runs and the byte ledger never overshoots."""
+        c = DeviceCache(budget_bytes=1 << 20)
+        t = new_owner_token()
+        builds = []
+        entered = threading.Event()
+        release = threading.Event()
+
+        def build():
+            builds.append(threading.current_thread().name)
+            entered.set()
+            release.wait(5)  # hold the build open so peers must wait
+            return np.zeros(64, np.uint32)  # 256 B
+
+        results = {}
+
+        def worker(name):
+            results[name] = c.get_or_build((t, "k"), build)
+
+        threads = [
+            threading.Thread(target=worker, args=(f"w{i}",), name=f"w{i}")
+            for i in range(4)
+        ]
+        threads[0].start()
+        assert entered.wait(5)
+        for th in threads[1:]:
+            th.start()
+        time.sleep(0.05)  # let the waiters park on the build condition
+        release.set()
+        for th in threads:
+            th.join(5)
+        assert len(builds) == 1  # exactly one build process-wide
+        assert c.bytes_used == 256  # no double-charge on the ledger
+        arrs = list(results.values())
+        assert all(a is arrs[0] for a in arrs)  # everyone shares the result
+
+    def test_failed_build_releases_the_flight(self):
+        c = DeviceCache(budget_bytes=1 << 20)
+        t = new_owner_token()
+
+        def boom():
+            raise RuntimeError("build failed")
+
+        with pytest.raises(RuntimeError):
+            c.get_or_build((t, "k"), boom)
+        # the key is not wedged: a later build succeeds
+        arr = c.get_or_build((t, "k"), lambda: np.zeros(8, np.uint32))
+        assert arr is not None
+        assert c.bytes_used == 32
+
+
+class TestPinning:
+    def test_pinned_entry_survives_eviction_pressure(self):
+        """Satellite acceptance: eviction during a pinned dispatch is
+        deferred — the pinned entry is never dropped mid-flight."""
+        c = DeviceCache(budget_bytes=1000)
+        t = new_owner_token()
+        c.put((t, 0), np.zeros(64, np.uint32))  # 256 B
+        assert c.pin_if_present((t, 0))
+        for i in range(1, 12):
+            c.put((t, i), np.zeros(64, np.uint32))
+        assert c.get((t, 0)) is not None  # pinned: deferred, not evicted
+        assert c.stats_snapshot()["pinned_bytes"] == 256
+        c.unpin((t, 0))
+        # unpin settles the deferred debt: back under budget
+        assert c.bytes_used <= 1000
+
+    def test_pin_refcounts_nest(self):
+        c = DeviceCache(budget_bytes=1000)
+        t = new_owner_token()
+        c.put((t, 0), np.zeros(64, np.uint32))
+        assert c.pin_if_present((t, 0))
+        assert c.pin_if_present((t, 0))
+        c.unpin((t, 0))
+        # still pinned once: pressure must not evict it
+        for i in range(1, 12):
+            c.put((t, i), np.zeros(64, np.uint32))
+        assert c.get((t, 0)) is not None
+        c.unpin((t, 0))
+
+    def test_invalidate_while_pinned_keeps_bytes_until_unpin(self):
+        """An in-flight operand's memory is genuinely held even after a
+        write invalidates its entry: lookup misses immediately, the byte
+        ledger releases only at the last unpin (zombie accounting)."""
+        c = DeviceCache(budget_bytes=10_000)
+        t = new_owner_token()
+        c.put((t, 0), np.zeros(64, np.uint32))
+        assert c.pin_if_present((t, 0))
+        c.invalidate_owner(t)
+        assert c.get((t, 0)) is None  # new queries rebuild
+        assert c.bytes_used == 256  # bytes still accounted (in flight)
+        assert c.stats_snapshot()["pinned_bytes"] == 256
+        c.unpin((t, 0))
+        assert c.bytes_used == 0
+        assert c.stats_snapshot()["pinned_bytes"] == 0
+
+    def test_stale_pin_safety_valve(self):
+        """A leaked pin older than pin_timeout is forcibly released by
+        the evictor instead of wedging the budget forever."""
+        clock = [0.0]
+        c = DeviceCache(
+            budget_bytes=1000, pin_timeout=5.0, clock=lambda: clock[0]
+        )
+        t = new_owner_token()
+        c.put((t, 0), np.zeros(64, np.uint32))
+        assert c.pin_if_present((t, 0))  # never unpinned: the "leak"
+        clock[0] = 10.0  # past the timeout
+        for i in range(1, 12):
+            c.put((t, i), np.zeros(64, np.uint32))
+        assert c.get((t, 0)) is None  # reclaimed and evicted
+        assert c.stats_snapshot()["stale_pin_reclaims"] == 1
+        assert c.bytes_used <= 1000
+
+    def test_deferred_eviction_session(self):
+        """deferred_eviction() suspends budget settling until the session
+        exits (the lowering's whole-operand-set staging window)."""
+        c = DeviceCache(budget_bytes=1000)
+        t = new_owner_token()
+        with c.deferred_eviction():
+            for i in range(12):
+                c.put((t, i), np.zeros(64, np.uint32))
+            assert c.bytes_used == 12 * 256  # transiently over budget
+            assert len(c) == 12
+        assert c.bytes_used <= 1000  # settled on exit
+        assert c.get((t, 11)) is not None  # LRU tail kept, head dropped
+        assert c.get((t, 0)) is None
 
 
 class TestFragmentUnderBudget:
