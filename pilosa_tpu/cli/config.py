@@ -108,6 +108,10 @@ class Config:
     verbose: bool = False
     long_query_time: float = 0.0  # seconds; 0 disables slow-query logging
     max_writes_per_request: int = 5000
+    # bulk-import replica fan-out: shard batches ship to their owner
+    # nodes on a bounded thread pool this wide (docs/configuration.md
+    # "Ingest")
+    import_concurrency: int = 8
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
@@ -177,6 +181,7 @@ class Config:
             "verbose": self.verbose,
             "long-query-time": self.long_query_time,
             "max-writes-per-request": self.max_writes_per_request,
+            "import-concurrency": self.import_concurrency,
         }
         for k, v in flat.items():
             out.append(f"{k} = {_toml_value(v)}")

@@ -128,6 +128,11 @@ def _build_parser() -> argparse.ArgumentParser:
         "(safety valve; 0 disables)",
     )
     sp.add_argument(
+        "--import-concurrency", type=int,
+        help="parallel replica-import RPCs per bulk import call (shard "
+        "batches ship to their owner nodes on a pool this wide)",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -188,6 +193,7 @@ _FLAG_KNOBS = {
     "verbose": (None, "verbose"),
     "long_query_time": (None, "long_query_time"),
     "max_writes_per_request": (None, "max_writes_per_request"),
+    "import_concurrency": (None, "import_concurrency"),
     "cluster_hosts": ("cluster", "hosts"),
     "replicas": ("cluster", "replicas"),
     "coordinator": ("cluster", "coordinator"),
@@ -339,6 +345,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         hbm_extent_rows=cfg.hbm.extent_rows,
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,
+        import_concurrency=cfg.import_concurrency,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

@@ -74,6 +74,27 @@ class RankCache:
         self._top = None
         self.recalculate()
 
+    def add_many(self, pairs) -> None:
+        """add() for a whole batch with ONE memo drop and ONE threshold
+        check — the ingest fast path reconciles every touched row of a
+        bulk import here instead of poking the cache once per row."""
+        counts = self._counts
+        n = 0
+        for row_id, count in pairs:
+            if count <= 0:
+                counts.pop(row_id, None)
+            else:
+                counts[row_id] = count
+            n += 1
+        if not n:
+            return
+        self._top = None
+        self._updates += n
+        if self._updates > self.max_size * _RECALC_FACTOR and (
+            len(counts) > self.max_size
+        ):
+            self.recalculate()
+
     def get(self, row_id: int) -> int:
         return self._counts.get(row_id, 0)
 
@@ -120,6 +141,11 @@ class LRUCache(RankCache):
             self._evict()
         self._top = None
 
+    def add_many(self, pairs) -> None:
+        # recently-updated semantics need the per-add touch/evict order
+        for row_id, count in pairs:
+            self.add(row_id, count)
+
     def _evict(self) -> None:
         while len(self._counts) > self.max_size:
             self._counts.pop(next(iter(self._counts)))
@@ -144,6 +170,9 @@ class NoCache:
         pass
 
     def bulk_add(self, pairs) -> None:
+        pass
+
+    def add_many(self, pairs) -> None:
         pass
 
     def get(self, row_id: int) -> int:

@@ -114,6 +114,11 @@ class DeviceCache:
         # operand extents (hbm/residency.py) are flagged at insert so the
         # hbm.* gauges can report them separately from per-row entries
         self._extent_keys: Set[Tuple] = set()
+        # shard coverage per key (hbm staging registers the shard span an
+        # extent covers): invalidate_owner_shard drops only the entries
+        # whose coverage contains the dirty shard — entries with no
+        # recorded coverage are dropped conservatively
+        self._cover: Dict[Tuple, frozenset] = {}
         # eviction-deferral sessions (deferred_eviction): while a query's
         # lowering stages its operand set, evicting to make room for
         # operand K must not take operand K+1's resident extents — LRU's
@@ -146,12 +151,16 @@ class DeviceCache:
                 self.misses += 1
             return arr
 
-    def put(self, key: Tuple, arr, *, extent: bool = False) -> None:
+    def put(
+        self, key: Tuple, arr, *, extent: bool = False, shards=None
+    ) -> None:
         nb = _nbytes(arr)
         with self._mu:
-            self._put_locked(key, arr, nb, extent=extent)
+            self._put_locked(key, arr, nb, extent=extent, shards=shards)
 
-    def _put_locked(self, key: Tuple, arr, nb: int, *, extent: bool) -> None:
+    def _put_locked(
+        self, key: Tuple, arr, nb: int, *, extent: bool, shards=None
+    ) -> None:
         if key in self._entries:
             # replace: the old bytes leave the ledger even if pinned (the
             # pins transfer to the new array — stage-level code only pins
@@ -163,6 +172,8 @@ class DeviceCache:
         self._by_owner.setdefault(key[0], set()).add(key)
         if extent:
             self._extent_keys.add(key)
+        if shards is not None:
+            self._cover[key] = frozenset(shards)
         self._bytes += nb
         self._evict_locked(keep=key)
 
@@ -173,6 +184,7 @@ class DeviceCache:
         *,
         extent: bool = False,
         pin: bool = False,
+        shards=None,
     ):
         """Return the cached array for `key`, building it at most once
         process-wide even under concurrent callers (single-flight). With
@@ -204,7 +216,7 @@ class DeviceCache:
         nb = _nbytes(arr)
         with self._mu:
             self._building.discard(key)
-            self._put_locked(key, arr, nb, extent=extent)
+            self._put_locked(key, arr, nb, extent=extent, shards=shards)
             if pin:
                 self._pin_locked(key)
             self._build_cv.notify_all()
@@ -215,10 +227,53 @@ class DeviceCache:
             if key in self._entries:
                 self._drop_locked(key)
 
+    def invalidate_many(self, keys: Iterable[Tuple]) -> None:
+        """Drop a batch of keys under ONE lock hold (bulk ingest
+        reconciles a whole batch's touched rows in one pass instead of
+        one lock acquisition per row)."""
+        with self._mu:
+            for key in keys:
+                if key in self._entries:
+                    self._drop_locked(key)
+
     def invalidate_owner(self, owner: Hashable) -> None:
         with self._mu:
             for key in list(self._by_owner.get(owner, ())):
                 self._drop_locked(key)
+
+    def invalidate_owners(self, owners: Iterable[Hashable]) -> None:
+        """invalidate_owner for a batch of owner tokens under one lock
+        hold (the ingest fast path drops many fragments' row entries per
+        import call)."""
+        with self._mu:
+            for owner in owners:
+                for key in list(self._by_owner.get(owner, ())):
+                    self._drop_locked(key)
+
+    def invalidate_owner_shard(self, owner: Hashable, shard: int) -> None:
+        """Dirty-extent invalidation: drop only this owner's entries
+        whose registered shard coverage contains `shard` (entries without
+        coverage are dropped conservatively). A single-shard write then
+        frees just the covering extent(s), not the owner's whole stack
+        set — the read side re-stages only those slices."""
+        with self._mu:
+            for key in list(self._by_owner.get(owner, ())):
+                cov = self._cover.get(key)
+                if cov is None or shard in cov:
+                    self._drop_locked(key)
+
+    def invalidate_owner_shards(self, owner: Hashable, shards) -> None:
+        """invalidate_owner_shard for a whole dirty-shard batch under one
+        lock hold (a bulk import touching hundreds of shards runs ONE
+        coverage pass, not one per shard)."""
+        ss = set(shards)
+        if not ss:
+            return
+        with self._mu:
+            for key in list(self._by_owner.get(owner, ())):
+                cov = self._cover.get(key)
+                if cov is None or not ss.isdisjoint(cov):
+                    self._drop_locked(key)
 
     def clear(self) -> None:
         with self._mu:
@@ -226,6 +281,7 @@ class DeviceCache:
             self._sizes.clear()
             self._by_owner.clear()
             self._extent_keys.clear()
+            self._cover.clear()
             self._pins.clear()
             self._pin_t0.clear()
             self._zombies.clear()
@@ -325,6 +381,7 @@ class DeviceCache:
         else:
             self._bytes -= nb
         self._extent_keys.discard(key)
+        self._cover.pop(key, None)
         owner_keys = self._by_owner.get(key[0])
         if owner_keys is not None:
             owner_keys.discard(key)

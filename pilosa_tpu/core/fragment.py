@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+from itertools import repeat
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -226,6 +227,13 @@ class Fragment:
 
         self._mu = TrackedRLock("fragment.mu")
         self._rows: Dict[int, RowBits] = {}
+        # Bulk-ingest fast path (stage_positions): SET positions appended
+        # here are already WAL-framed and device-invalidated but not yet
+        # merged into _rows; every read barrier merges them first
+        # (_sync_locked) in one vectorized pass. len bookkeeping lives in
+        # _pending_n so the hot check is one int compare.
+        self._pending: List[np.ndarray] = []
+        self._pending_n = 0
         # Device residency goes through the process-global budgeted LRU
         # (core/devcache.py): per-row arrays under _token, multi-row stacks
         # under _stack_token (stacks are invalidated wholesale on mutation).
@@ -327,6 +335,7 @@ class Fragment:
         """Persist the rank cache sidecar (reference: holder.go:506
         monitorCacheFlush ticker / cache.go:291 WriteTo)."""
         with self._mu:
+            self._sync_locked()
             if (
                 self.cache_path is not None
                 and self.cache.cache_type != cachemod.CACHE_TYPE_NONE
@@ -338,6 +347,7 @@ class Fragment:
         (reference: api.go RecalculateCaches). Lazy stores count from the
         header index / mapped payloads without materializing rows."""
         with self._mu:
+            self._sync_locked()
             self.cache.clear()
             count_of = getattr(self._rows, "count_of", None)
             if count_of is not None:
@@ -363,27 +373,34 @@ class Fragment:
 
     def row_ids(self) -> List[int]:
         with self._mu:
+            self._sync_locked()
             return sorted(self._rows)
 
     def has_row(self, row_id: int) -> bool:
-        return row_id in self._rows
+        with self._mu:
+            self._sync_locked()
+            return row_id in self._rows
 
     def max_row_id(self) -> Optional[int]:
         with self._mu:
+            self._sync_locked()
             return max(self._rows) if self._rows else None
 
     def min_row_id(self) -> Optional[int]:
         with self._mu:
+            self._sync_locked()
             return min(self._rows) if self._rows else None
 
     def row_words(self, row_id: int) -> np.ndarray:
         """Host dense words for one row (zeros if absent)."""
         with self._mu:
+            self._sync_locked()
             rb = self._rows.get(row_id)
             return rb.to_words() if rb is not None else ob.empty_row()
 
     def row_positions(self, row_id: int) -> np.ndarray:
         with self._mu:
+            self._sync_locked()
             rb = self._rows.get(row_id)
             return rb.to_positions() if rb is not None else np.empty(0, np.uint32)
 
@@ -393,6 +410,7 @@ class Fragment:
         length -1 marks a dense-rep row (the caller routes those through
         the plane path instead of gathering individual words)."""
         with self._mu:
+            self._sync_locked()
             rows = self._rows
             parts = []
             lens = np.empty(len(row_ids), np.int64)
@@ -435,6 +453,7 @@ class Fragment:
 
     def contains(self, row_id: int, col: int) -> bool:
         with self._mu:
+            self._sync_locked()
             rb = self._rows.get(row_id)
             return rb is not None and rb.contains(col % SHARD_WIDTH)
 
@@ -442,6 +461,7 @@ class Fragment:
         """Cardinality of one row (host metadata; used by caches/imports).
         Lazy stores answer from header metadata without materializing."""
         with self._mu:
+            self._sync_locked()
             count_of = getattr(self._rows, "count_of", None)
             if count_of is not None:
                 return count_of(row_id)
@@ -452,6 +472,7 @@ class Fragment:
         """Rank-cache snapshot taken under the fragment lock, so a concurrent
         writer mutating the cache in _apply_positions can't tear the read."""
         with self._mu:
+            self._sync_locked()
             return self.cache.top()
 
     def cache_top_arrays(self):
@@ -460,6 +481,7 @@ class Fragment:
         vectorized TopN paths read these instead of building 10^4s of
         Python tuples per query."""
         with self._mu:
+            self._sync_locked()
             t = self.cache.top()
             memo = self._cache_top_arrays
             if memo is None or memo[0] is not t:
@@ -477,6 +499,7 @@ class Fragment:
         IS the full row->count map. Saves TopN pass-2's O(rows x shards)
         count() walk; pruned caches fall back to row_counts_host."""
         with self._mu:
+            self._sync_locked()
             cache = self.cache
             t = cache.top() if hasattr(cache, "top") else []
             if getattr(cache, "pruned", True):
@@ -502,6 +525,7 @@ class Fragment:
         lock acquisition (TopN pass-2 reads n_shards x n_candidates counts;
         per-call locking would dominate)."""
         with self._mu:
+            self._sync_locked()
             rows = self._rows
             count_of = getattr(rows, "count_of", None)
             if count_of is not None:
@@ -549,15 +573,21 @@ class Fragment:
     def import_positions(
         self, to_set: Optional[np.ndarray], to_clear: Optional[np.ndarray]
     ) -> Tuple[int, int]:
-        """Batched bit mutation by fragment position; the single write path
-        (reference: fragment.go:2053 importPositions). Returns
-        (n_set_changed, n_clear_changed)."""
+        """Batched bit mutation by fragment position; the single EXACT
+        write path (reference: fragment.go:2053 importPositions) — the
+        pending ingest delta is merged first so the returned
+        (n_set_changed, n_clear_changed) counts are exact. WAL framing is
+        one append per import call: set+clear land as one write+flush
+        instead of interleaving two syscall round-trips with the apply."""
         with self._mu:
-            n_set = n_clear = 0
+            self._sync_locked()
+            records = []
             if to_set is not None and len(to_set):
-                self._wal_append(walmod.OP_SET, to_set)
+                records.append((walmod.OP_SET, to_set))
             if to_clear is not None and len(to_clear):
-                self._wal_append(walmod.OP_CLEAR, to_clear)
+                records.append((walmod.OP_CLEAR, to_clear))
+            if records and self._wal is not None:
+                self._wal.append_many(records)
             n_set, n_clear = self._apply_positions(
                 to_set if to_set is not None else np.empty(0, np.uint64),
                 to_clear if to_clear is not None else np.empty(0, np.uint64),
@@ -567,48 +597,121 @@ class Fragment:
                 self.snapshot()
             return n_set, n_clear
 
-    def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
-        # The single mutation funnel: every write path (including WAL replay,
-        # clears from Store/ClearRow, bulk clear imports) flows through here,
-        # so the mutex vector and the rank cache are maintained here and
-        # nowhere else.
-        n_set = n_clear = 0
-        touched = set()
+    def stage_positions(self, positions: np.ndarray, *, notify: bool = True) -> int:
+        """Bulk-ingest fast path: append SET positions to the fragment's
+        pending delta buffer WITHOUT merging them into the row store —
+        the merge (one vectorized pass + a single rank-cache
+        reconciliation, however many batches accumulated) is deferred to
+        the next read barrier (_sync_locked). Durability is NOT deferred:
+        the batch is WAL-framed here, so a crash before the merge replays
+        it on open. Returns the number of staged positions (an upper
+        bound on changed bits; exact change counts exist only at merge
+        time — callers needing them use import_positions).
 
-        def _by_row(positions):
-            """(row_id, cols) groups via one sort (utils/arrays) — a
-            boolean mask per row would rescan the batch n_rows times."""
-            rows = (positions // SHARD_WIDTH).astype(np.int64)
-            cols = (positions % SHARD_WIDTH).astype(np.uint32)
-            for row_id, sl in group_slices(rows):
-                yield int(row_id), cols[sl]
+        notify=False skips the per-fragment device-cache invalidation and
+        the on_mutate hook (the version still bumps): the field-level
+        bulk router batches those into one device-cache pass for ALL
+        fragments it touched, instead of two global-lock hits per shard.
+
+        Mutex fields cannot take this path (last-write-wins needs the
+        mutex vector consulted at apply time)."""
+        if self._mutex_map is not None:
+            raise ValueError("stage_positions is not supported on mutex fields")
+        positions = np.asarray(positions, dtype=np.uint64)
+        n = len(positions)
+        if not n:
+            return 0
+        with self._mu:
+            self._wal_append(walmod.OP_SET, positions)
+            self._pending.append(positions)
+            self._pending_n += n
+            self._op_n += n
+            self.version += 1
+            if notify:
+                DEVICE_CACHE.invalidate_owner(self._token)
+                DEVICE_CACHE.invalidate_owner(self._stack_token)
+                if self.on_mutate is not None:
+                    self.on_mutate()
+            if self._op_n > self.max_op_n:
+                self.snapshot()  # merges pending first (snapshot reads rows)
+        return n
+
+    def _sync_locked(self) -> None:
+        """Merge the pending ingest delta into the row store. Called (under
+        self._mu) at the top of every host read; device rebuild paths all
+        funnel through row_words, so a staged-then-queried fragment is
+        merged exactly once, not per row. Device invalidation and version
+        bumps already happened at stage time — this only moves bits and
+        reconciles the rank cache."""
+        if not self._pending_n:
+            return
+        parts = self._pending
+        self._pending = []
+        self._pending_n = 0
+        inc = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        touched: set = set()
+        self._bulk_set_sparse(inc, touched)
+        rows_store = self._rows
+        self.cache.add_many(
+            (rid, rb.count() if (rb := rows_store.get(rid)) is not None else 0)
+            for rid in touched
+        )
+        if rowstore_mod.PARANOIA:
+            self._paranoia_check(touched)
+
+    def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
+        # The single EXACT mutation funnel: every write path (including WAL
+        # replay, clears from Store/ClearRow, bulk clear imports) flows
+        # through here or through _sync_locked, so the mutex vector and the
+        # rank cache are maintained here and nowhere else. Per-row Python
+        # work is limited to the row-store handoff: set/clear merges are one
+        # sort + group_slices pass each, the mutex vector updates at
+        # C speed (dict.update over a zip), and the rank-cache/device-cache
+        # reconciliation is a single deferred pass per batch instead of two
+        # pokes per touched row.
+        n_set = n_clear = 0
+        touched: set = set()
 
         if len(to_set):
             if self._mutex_map is None:
                 n_set += self._bulk_set_sparse(to_set, touched)
             else:
-                for row_id, row_cols in _by_row(to_set):
+                rows = (to_set // SHARD_WIDTH).astype(np.int64)
+                cols = (to_set % SHARD_WIDTH).astype(np.uint32)
+                for row_id, sl in group_slices(rows):
+                    row_id = int(row_id)
                     rb = self._rows.get(row_id)
                     if rb is None:
                         rb = self._rows[row_id] = RowBits(SHARD_WIDTH)
+                    row_cols = cols[sl]
                     n_set += rb.add(row_cols)
                     touched.add(row_id)
-                    for c in row_cols:
-                        self._mutex_map[int(c)] = row_id
+                    self._mutex_map.update(
+                        zip(row_cols.tolist(), repeat(row_id))
+                    )
         if len(to_clear):
-            for row_id, row_cols in _by_row(to_clear):
-                rb = self._rows.get(row_id)
-                if rb is not None:
-                    n_clear += rb.discard(row_cols)
-                    touched.add(row_id)
-                if self._mutex_map is not None:
-                    for c in row_cols:
-                        if self._mutex_map.get(int(c)) == row_id:
-                            del self._mutex_map[int(c)]
-        for row_id in touched:
-            rb = self._rows.get(row_id)
-            self.cache.add(row_id, rb.count() if rb is not None else 0)
-            DEVICE_CACHE.invalidate((self._token, row_id))
+            n_clear += self._bulk_clear_sparse(to_clear, touched)
+            if self._mutex_map is not None:
+                mm = self._mutex_map
+                rows = (to_clear // SHARD_WIDTH).astype(np.int64)
+                cols = (to_clear % SHARD_WIDTH).astype(np.uint32)
+                for row_id, sl in group_slices(rows):
+                    row_id = int(row_id)
+                    for c in cols[sl].tolist():
+                        if mm.get(c) == row_id:
+                            del mm[c]
+        if touched:
+            rows_store = self._rows
+            self.cache.add_many(
+                (
+                    rid,
+                    rb.count() if (rb := rows_store.get(rid)) is not None else 0,
+                )
+                for rid in touched
+            )
+            DEVICE_CACHE.invalidate_many(
+                (self._token, rid) for rid in touched
+            )
         if rowstore_mod.PARANOIA:
             self._paranoia_check(touched)
         if touched:
@@ -683,6 +786,67 @@ class Fragment:
         n += len(merged) - before
         return n
 
+    def _bulk_clear_sparse(self, to_clear: np.ndarray, touched: set) -> int:
+        """Clear a batch of keyed positions with ONE merged membership test
+        for all sparse-rep rows (the clear-side mirror of _bulk_set_sparse):
+        stored position arrays and the incoming batch are re-keyed into the
+        same row-major space, a single searchsorted pass marks the cleared
+        keys, and each shrunken row takes a copy of its surviving slice.
+        Dense-rep rows keep the per-row word path (bitwise_and.at inside
+        RowBits.discard). Returns how many bits were actually cleared."""
+        rows_arr = to_clear // SHARD_WIDTH
+        uniq_rows = np.unique(rows_arr).astype(np.uint64)
+        dense_rows: List[int] = []
+        sparse_rows: List[int] = []
+        for r in uniq_rows:
+            rb = self._rows.get(int(r))
+            if rb is None:
+                continue
+            (dense_rows if rb.dense is not None else sparse_rows).append(int(r))
+        n = 0
+        if dense_rows:
+            m = np.isin(rows_arr, np.array(dense_rows, np.uint64))
+            cols = (to_clear[m] % SHARD_WIDTH).astype(np.uint32)
+            for row_id, sl in group_slices(rows_arr[m].astype(np.int64)):
+                rb = self._rows[int(row_id)]
+                n += rb.discard(cols[sl])
+                touched.add(int(row_id))
+        if not sparse_rows:
+            return n
+        inc_mask = np.isin(rows_arr, np.array(sparse_rows, np.uint64))
+        inc = np.unique(to_clear[inc_mask].astype(np.uint64))
+        parts = []
+        for rid in sparse_rows:
+            p = self._rows.get(rid).positions
+            if len(p):
+                parts.append(
+                    p.astype(np.uint64) + np.uint64(rid) * np.uint64(SHARD_WIDTH)
+                )
+        if not parts:
+            return n
+        stored = np.concatenate(parts)
+        idx = np.searchsorted(inc, stored)
+        idxc = np.minimum(idx, len(inc) - 1)
+        hit = (idx < len(inc)) & (inc[idxc] == stored)
+        kept = stored[~hit]
+        n += len(stored) - len(kept)
+        all_pos = (kept % np.uint64(SHARD_WIDTH)).astype(np.uint32)
+        edges = np.searchsorted(
+            kept,
+            np.array(
+                [r * SHARD_WIDTH for r in sparse_rows]
+                + [(sparse_rows[-1] + 1) * SHARD_WIDTH],
+                np.uint64,
+            ),
+        )
+        for i, rid in enumerate(sparse_rows):
+            rb = self._rows.get(rid)
+            sl = all_pos[edges[i] : edges[i + 1]]
+            if len(sl) != rb.count():
+                rb.positions = sl.copy()
+            touched.add(rid)
+        return n
+
     def import_row_words(self, row_id: int, words: np.ndarray) -> int:
         """Word-level bulk union into one row — the device-native analog of
         the reference's zero-parse roaring import (fragment.go:2255
@@ -697,6 +861,7 @@ class Fragment:
                 f"import_row_words: want shape ({SHARD_WIDTH // 32},), got {words.shape}"
             )
         with self._mu:
+            self._sync_locked()
             if self._wal is not None:
                 payload = np.empty(1 + words.nbytes // 8, np.uint64)
                 payload[0] = row_id
@@ -1013,6 +1178,7 @@ class Fragment:
         """Bits as (row_ids, in-shard cols) arrays, row-major sorted,
         optionally restricted to rows in [row_lo, row_hi)."""
         with self._mu:
+            self._sync_locked()
             rows_out = []
             cols_out = []
             for row_id in sorted(self._rows):
@@ -1065,6 +1231,7 @@ class Fragment:
         import io
 
         with self._mu:
+            self._sync_locked()
             buf = io.BytesIO()
             walmod.write_snapshot_stream(buf, self.shard, SHARD_WIDTH, self._rows)
             return buf.getvalue()
@@ -1084,6 +1251,11 @@ class Fragment:
                 f"fragment stream shard width {n_bits} != local {SHARD_WIDTH}"
             )
         with self._mu:
+            # pending deltas describe the REPLACED contents; the forced
+            # snapshot below truncates their WAL records with everything
+            # else, so they must not merge into the new rows
+            self._pending = []
+            self._pending_n = 0
             self._rows = rows
             DEVICE_CACHE.invalidate_owner(self._token)
             DEVICE_CACHE.invalidate_owner(self._stack_token)
@@ -1107,6 +1279,10 @@ class Fragment:
         """Write full snapshot and reset the WAL
         (reference: fragment.go:2337-2395)."""
         with self._mu:
+            # the pending delta MUST merge before the snapshot is written:
+            # truncate() below discards its WAL records, so unmerged bits
+            # would otherwise be lost
+            self._sync_locked()
             if self.path is None:
                 self._op_n = 0
                 return

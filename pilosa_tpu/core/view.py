@@ -85,11 +85,13 @@ class View:
                     cache_type=self.cache_type,
                     cache_size=self.cache_size,
                 ).open()
-                # any write to a covered fragment invalidates this view's
-                # cross-shard stacks (version keys would miss anyway; this
-                # frees the stale HBM immediately instead of waiting on LRU)
-                frag.on_mutate = lambda: DEVICE_CACHE.invalidate_owner(
-                    self._stack_token
+                # dirty-extent invalidation: a write reports WHICH shard
+                # changed, and only the stack entries whose extent span
+                # covers it are dropped (stale version keys would miss
+                # anyway; this frees exactly the stale HBM immediately
+                # instead of churning the whole owner or waiting on LRU)
+                frag.on_mutate = lambda s=shard: DEVICE_CACHE.invalidate_owner_shard(
+                    self._stack_token, s
                 )
                 self.fragments[shard] = frag
             return frag
@@ -132,13 +134,17 @@ class View:
     # stay pinned through the plan's dispatch.
 
     def _stack_key(self, kind: str, ident, shards: tuple) -> tuple:
+        # fragment versions are NOT part of the base key: staging appends
+        # each extent's OWN shard-span version slice, so a write to one
+        # shard re-keys only the covering extent instead of the whole
+        # stack (the dirty-extent property the invalidation relies on)
         from pilosa_tpu.parallel import mesh as pmesh
 
-        versions = tuple(
-            f.version if (f := self.fragments.get(s)) is not None else -1
-            for s in shards
-        )
-        return (self._stack_token, kind, ident, shards, versions, pmesh.mesh_epoch())
+        return (self._stack_token, kind, ident, shards, pmesh.mesh_epoch())
+
+    @staticmethod
+    def _frag_versions(frags) -> tuple:
+        return tuple(f.version if f is not None else -1 for f in frags)
 
     def row_stack(self, row_id: int, shards, extents=None) -> Optional[object]:
         """uint32[S, W] device stack of one row over `shards`, or None when
@@ -163,8 +169,44 @@ class View:
             return np.stack(rows)
 
         return hbm_res.stage_row_stack(
-            key, len(shards), build_slice, table=extents
+            key, len(shards), build_slice, table=extents,
+            versions=self._frag_versions(frags), shards=shards,
         )
+
+    def stage_bulk(self, shards: np.ndarray, positions: np.ndarray) -> None:
+        """Bulk-ingest router (the write-side hot path): ONE argsort over
+        the whole batch splits the fragment positions into per-shard
+        views; per-fragment cost is then a WAL frame + a pending-buffer
+        append (Fragment.stage_positions with notify=False). The
+        device-cache work every write owes — dropping the touched
+        fragments' row entries and the dirty shards' covering extents —
+        runs as two batched passes at the end instead of two global-lock
+        hits per shard."""
+        if not len(shards):
+            return
+        # hand-rolled grouping instead of utils/arrays.group_slices: this
+        # is THE write hot path, and group_slices' stable argsort costs
+        # ~4x quicksort on uint64 keys while its per-group index arrays
+        # force a fancy-gather per shard — np.split on the pre-permuted
+        # positions hands out views. Stability is not needed: set bits
+        # commute.
+        order = np.argsort(shards)
+        sh = shards[order]
+        pos = positions[order]
+        bounds = np.flatnonzero(sh[1:] != sh[:-1]) + 1
+        starts = np.concatenate(([0], bounds)).astype(np.int64)
+        uniq = sh[starts]
+        chunks = np.split(pos, bounds)
+        tokens = []
+        dirty = []
+        for shard, chunk in zip(uniq.tolist(), chunks):
+            frag = self.fragment(int(shard))
+            frag.stage_positions(chunk, notify=False)
+            tokens.append(frag._token)
+            tokens.append(frag._stack_token)
+            dirty.append(int(shard))
+        DEVICE_CACHE.invalidate_owners(tokens)
+        DEVICE_CACHE.invalidate_owner_shards(self._stack_token, dirty)
 
     def plane_stack(self, row_ids, shards, extents=None) -> Optional[object]:
         """uint32[D, S, W] device stack (BSI planes × shards), or None when
@@ -198,7 +240,8 @@ class View:
             )
 
         return hbm_res.stage_plane_stack(
-            key, len(shards), build_slice, table=extents
+            key, len(shards), build_slice, table=extents,
+            versions=self._frag_versions(frags), shards=shards,
         )
 
     # -- fan-down helpers (view.go:367-474) --------------------------------

@@ -207,6 +207,29 @@ class WalWriter:
             f.write(rec + payload)
             f.flush()
 
+    def append_many(self, records) -> None:
+        """Frame a batch of (op, positions) records and land them with ONE
+        write + flush — an import call's set AND clear records hit the
+        file together instead of interleaving two syscall round-trips
+        with the apply. Each record keeps its own CRC, so replay-side
+        torn-tail handling is unchanged (the batch just tears as a unit
+        or between records)."""
+        bufs = []
+        for op, positions in records:
+            payload = np.asarray(positions, dtype=np.uint64).tobytes()
+            bufs.append(
+                _REC_HDR.pack(
+                    WAL_MAGIC, op, len(positions), zlib.crc32(payload)
+                )
+            )
+            bufs.append(payload)
+        if not bufs:
+            return
+        data = b"".join(bufs)
+        with self._pin() as f:
+            f.write(data)
+            f.flush()
+
     def truncate(self) -> None:
         """Reset after a snapshot has absorbed all ops."""
         with self._pin() as f:

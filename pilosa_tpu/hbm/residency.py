@@ -181,13 +181,22 @@ def _stage(
     build_slice: Callable[[int, int], object],
     shard_axis: int,
     table: Optional[ExtentTable],
+    versions: Optional[Tuple[int, ...]] = None,
+    shards: Optional[Tuple[int, ...]] = None,
 ):
     """Assemble one device operand from per-extent cache entries.
 
     build_slice(lo, hi) -> host ndarray covering shard positions [lo, hi)
     of the stack. Returns the assembled device array; every extent ends
     pinned exactly once — ownership goes to `table` (released after the
-    plan's dispatch) or is released here when no table is given."""
+    plan's dispatch) or is released here when no table is given.
+
+    `versions` (one entry per shard position) rides INSIDE each extent's
+    cache key as that extent's own span slice: a write to one shard
+    re-keys only the covering extent, so a warm stack re-stages exactly
+    its dirty slices after a write burst. `shards` (the shard ids by
+    position) is registered with the device cache as each entry's
+    coverage, which is what invalidate_owner_shard matches against."""
     import jax
 
     from pilosa_tpu.parallel import mesh as pmesh
@@ -195,9 +204,10 @@ def _stage(
     rows = _extent_rows
     if pmesh.active_mesh() is not None or rows <= 0 or n_shards <= rows:
         # monolithic: mesh-placed stacks (XLA owns cross-chip layout) and
-        # stacks no bigger than one extent. Same cache key as the classic
-        # path; still budget-tracked and pin-protected.
+        # stacks no bigger than one extent. One cache entry covering every
+        # shard; still budget-tracked and pin-protected.
         built: List[bool] = []
+        key = key_base if versions is None else key_base + ("mono", versions)
 
         def build_all():
             built.append(True)
@@ -205,17 +215,22 @@ def _stage(
             return arr
 
         arr = DEVICE_CACHE.get_or_build(
-            key_base, build_all, extent=True, pin=True
+            key, build_all, extent=True, pin=True, shards=shards
         )
-        _note_upload(int(getattr(arr, "nbytes", 0)), key_base, bool(built))
+        _note_upload(int(getattr(arr, "nbytes", 0)), key, bool(built))
         if table is not None:
-            table.add([key_base])
+            table.add([key])
         else:
-            DEVICE_CACHE.unpin(key_base)
+            DEVICE_CACHE.unpin(key)
         return arr
 
     spans = [(lo, min(lo + rows, n_shards)) for lo in range(0, n_shards, rows)]
-    keys = [key_base + ("ext", rows, i) for i in range(len(spans))]
+    keys = [
+        key_base
+        + ("ext", rows, i)
+        + (() if versions is None else (versions[lo:hi],))
+        for i, (lo, hi) in enumerate(spans)
+    ]
     # pass 1: pin every already-resident extent of this operand BEFORE
     # building any missing one — otherwise staging slice k evicts slice
     # k-1 and a cyclic scan re-uploads the whole stack (LRU's classic
@@ -249,7 +264,8 @@ def _stage(
                     return jax.device_put(build_slice(lo, hi))
 
                 arr = DEVICE_CACHE.get_or_build(
-                    key, build, extent=True, pin=True
+                    key, build, extent=True, pin=True,
+                    shards=None if shards is None else shards[lo:hi],
                 )
                 held.append(key)
                 _note_upload(
@@ -276,9 +292,14 @@ def stage_row_stack(
     n_shards: int,
     build_slice: Callable[[int, int], object],
     table: Optional[ExtentTable] = None,
+    versions: Optional[Tuple[int, ...]] = None,
+    shards: Optional[Tuple[int, ...]] = None,
 ):
     """uint32[S, W] operand: extents slice axis 0 (the shard axis)."""
-    return _stage(key_base, n_shards, build_slice, 0, table)
+    return _stage(
+        key_base, n_shards, build_slice, 0, table,
+        versions=versions, shards=shards,
+    )
 
 
 def stage_plane_stack(
@@ -286,8 +307,13 @@ def stage_plane_stack(
     n_shards: int,
     build_slice: Callable[[int, int], object],
     table: Optional[ExtentTable] = None,
+    versions: Optional[Tuple[int, ...]] = None,
+    shards: Optional[Tuple[int, ...]] = None,
 ):
     """uint32[D, S, W] operand: extents slice axis 1; every extent carries
     all D planes for its shard range (one slice pages the whole magnitude
     ladder for those shards together — they are always used together)."""
-    return _stage(key_base, n_shards, build_slice, 1, table)
+    return _stage(
+        key_base, n_shards, build_slice, 1, table,
+        versions=versions, shards=shards,
+    )

@@ -25,11 +25,32 @@ from pilosa_tpu.cluster.topology import (
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core import timeq
 from pilosa_tpu.exec.executor import ExecError, ExecOptions, NotFoundError
-from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXPONENT
 
 
 class ApiError(Exception):
     pass
+
+
+def _group_by_shard(shards: np.ndarray, timestamps):
+    """(shard, index_array, ts_slice) groups from ONE sort
+    (utils/arrays.group_slices) — the O(shards x bits) boolean-mask
+    rescan (and its per-shard full-batch timestamp regather) this import
+    path used to run is gone. Timestamps gather per group from the same
+    index arrays, so each group's ts list aligns with its rows/cols by
+    construction."""
+    from pilosa_tpu.utils.arrays import group_slices
+
+    return [
+        (
+            int(shard),
+            sl,
+            [timestamps[i] for i in sl.tolist()]
+            if timestamps is not None
+            else None,
+        )
+        for shard, sl in group_slices(shards)
+    ]
 
 
 _VIEW_NAME_RE = re.compile(r"[a-z][a-z0-9_]{0,63}")
@@ -425,30 +446,156 @@ class API:
         timestamps: Optional[Sequence] = None,
         local_only: bool = False,
     ) -> dict:
-        """Bulk set-bit import; translates keys, groups bits by shard and
-        routes each shard batch to all its owner nodes (api.go:963-996).
-        Returns an application summary {"applied", "expected", "errors"} so
-        callers can detect reduced durability when a replica was down
-        (r2 advisor: partial application must be visible, not silent)."""
+        """Bulk set-bit import; translates keys, groups bits by shard with
+        ONE argsort (timestamps ride the same permutation — no per-shard
+        batch rescans) and ships every shard batch to its owner nodes
+        concurrently on the bounded import pool (api.go:963-996). The
+        local share applies as ONE batched field import while the replica
+        RPCs are in flight. Returns an application summary {"applied",
+        "expected", "errors"} so callers can detect reduced durability
+        when a replica was down (r2 advisor: partial application must be
+        visible, not silent)."""
+        import time as _time
+
         self._validate("import_bits", write=True)
         idx, f = self._index_field(index, field)
         rows, cols = self._translate_import(idx, f, rows, cols)
-        shards = cols // SHARD_WIDTH
-        summary = {"applied": 0, "expected": 0, "errors": []}
-        for shard in np.unique(shards):
-            m = shards == shard
-            ts = (
-                [timestamps[i] for i in np.nonzero(m)[0]]
-                if timestamps is not None
-                else None
-            )
-            applied, expected, errors = self._route_shard_import(
-                idx, f, int(shard), rows[m], cols[m], clear, ts, local_only
-            )
-            summary["applied"] += applied
-            summary["expected"] += expected
-            summary["errors"] += errors
-        return summary
+        stats = self.server.stats.with_tags(f"index:{index}")
+        span = self.server.tracer.start_span("api.import")
+        with span:
+            span.set_tag("index", index)
+            span.set_tag("field", field)
+            span.set_tag("ingest.bits", int(len(cols)))
+            shards = cols >> np.uint64(SHARD_WIDTH_EXPONENT)
+            summary = {"applied": 0, "expected": 0, "errors": []}
+            t0 = _time.perf_counter()
+            if local_only or len(self.cluster.nodes) == 1:
+                shard_list = [int(s) for s in np.unique(shards)]
+                ts = (
+                    [
+                        timeq.parse_time(t) if t is not None else None
+                        for t in timestamps
+                    ]
+                    if timestamps is not None
+                    else None
+                )
+                f.import_bits(rows, cols, timestamps=ts, clear=clear)
+                idx.track_columns(cols)
+                summary["applied"] = summary["expected"] = len(shard_list)
+                apply_s = _time.perf_counter() - t0
+                route_s = 0.0
+                failed = []
+            else:
+                def local_apply(sel, groups):
+                    lts = None
+                    if timestamps is not None:
+                        lts = [
+                            timeq.parse_time(t) if t is not None else None
+                            for g in groups
+                            for t in g[2]
+                        ]
+                    f.import_bits(
+                        rows[sel], cols[sel], timestamps=lts, clear=clear
+                    )
+                    idx.track_columns(cols[sel])
+
+                def remote_submit(n, g):
+                    return self.server.import_pool.submit(
+                        self.server.client.import_bits,
+                        n.uri, idx.name, f.name, g[0],
+                        rows[g[1]], cols[g[1]], clear,
+                        timestamps=g[2],
+                    )
+
+                shard_list, failed, apply_s, route_s = self._import_routed(
+                    idx, shards, timestamps, local_apply, remote_submit,
+                    "import", summary,
+                )
+            stats.count("ingest.bits", int(len(cols)))
+            stats.count("ingest.batches", len(shard_list))
+            stats.timing("ingest.apply_ms", apply_s)
+            stats.timing("ingest.route_ms", route_s)
+            span.set_tag("ingest.batches", len(shard_list))
+            # applied shards announce BEFORE a fully-failed shard raises:
+            # bits that did land must become query-visible even when a
+            # sibling shard in the same call had no reachable owner
+            if not local_only and shard_list:
+                self._announce_shards(idx.name, f.name, shard_list)
+            if failed:
+                shard, errs = failed[0]
+                raise ApiError(
+                    f"import shard {shard}: no owner reachable: {errs}"
+                )
+            return summary
+
+    def _import_routed(
+        self, idx, shards, timestamps, local_apply, remote_submit, kind,
+        summary,
+    ):
+        """Multi-node shard routing shared by import_bits and
+        import_values: one-sort shard grouping, the remote legs shipped
+        concurrently on the bounded import pool (each RPC rides the PR 1
+        retry/breaker plane inside the client call `remote_submit`
+        makes), the local share applied as ONE batch (`local_apply`)
+        while they fly. Fills `summary` with the partial-application
+        accounting — a down replica is an error entry plus pending-repair
+        debt; a shard with NO live owner lands in `failed` for the caller
+        to raise AFTER announcing what did apply. Returns
+        (applied_shard_list, failed[(shard, errors)], apply_s, route_s)."""
+        import time as _time
+
+        from pilosa_tpu.server.client import ClientError
+
+        groups = _group_by_shard(shards, timestamps)
+        applied = {g[0]: 0 for g in groups}
+        shard_errors = {g[0]: [] for g in groups}
+        local_groups = []
+        remote_jobs = []
+        for g in groups:
+            owners = self.cluster.shard_nodes(idx.name, g[0])
+            summary["expected"] += len(owners)
+            for n in owners:
+                if n.id == self.server.node.id:
+                    local_groups.append(g)
+                else:
+                    remote_jobs.append((n, g))
+        t_route0 = _time.perf_counter()
+        futures = [(n, g, remote_submit(n, g)) for n, g in remote_jobs]
+        t0 = _time.perf_counter()
+        if local_groups:
+            local_apply(np.concatenate([g[1] for g in local_groups]), local_groups)
+            for g in local_groups:
+                applied[g[0]] += 1
+        apply_s = _time.perf_counter() - t0
+        for n, g, fut in futures:
+            try:
+                fut.result()
+                applied[g[0]] += 1
+            except ClientError as e:
+                shard_errors[g[0]].append(f"{n.id}: {e}")
+                # replica fan-out is best-effort per owner: a down replica
+                # is repaired by anti-entropy after it returns (the
+                # reference likewise keeps accepting writes in DEGRADED,
+                # api.go:104). Ledger entries only at replica_n>1: with no
+                # second copy AE has nothing to repair from, so an entry
+                # could never drain (the summary carries the error).
+                if self.cluster.replica_n > 1:
+                    self.holder.record_pending_repair(idx.name, g[0], n.id)
+                    self.server.stats.count("write_replica_dropped", 1)
+                self.server.logger(
+                    f"{kind} shard {g[0]} to replica {n.id} failed "
+                    f"(anti-entropy will repair): {e}"
+                )
+        route_s = _time.perf_counter() - t_route0
+        failed = []
+        for g in groups:
+            if not applied[g[0]]:
+                failed.append((g[0], shard_errors[g[0]]))
+                continue
+            summary["applied"] += applied[g[0]]
+            summary["errors"] += shard_errors[g[0]]
+        shard_list = [g[0] for g in groups if applied[g[0]]]
+        return shard_list, failed, apply_s, route_s
 
     def import_values(
         self,
@@ -458,48 +605,58 @@ class API:
         values: Sequence[int],
         local_only: bool = False,
     ) -> dict:
+        import time as _time
+
         self._validate("import_values", write=True)
         idx, f = self._index_field(index, field)
         _, cols = self._translate_import(idx, f, None, cols)
         values = np.asarray(values, dtype=np.int64)
-        shards = cols // SHARD_WIDTH
-        summary = {"applied": 0, "expected": 0, "errors": []}
-        for shard in np.unique(shards):
-            m = shards == shard
-            owners = self.cluster.shard_nodes(idx.name, int(shard))
-            targets = owners if not local_only else [self.server.node]
-            applied = 0
-            errors = []
-            for n in targets:
-                if n.id == self.server.node.id:
-                    f.import_values(cols[m], values[m])
-                    idx.track_columns(cols[m])
-                    applied += 1
-                else:
-                    from pilosa_tpu.server.client import ClientError
+        stats = self.server.stats.with_tags(f"index:{index}")
+        span = self.server.tracer.start_span("api.import")
+        with span:
+            span.set_tag("index", index)
+            span.set_tag("field", field)
+            span.set_tag("ingest.bits", int(len(cols)))
+            shards = cols >> np.uint64(SHARD_WIDTH_EXPONENT)
+            summary = {"applied": 0, "expected": 0, "errors": []}
+            t0 = _time.perf_counter()
+            if local_only or len(self.cluster.nodes) == 1:
+                shard_list = [int(s) for s in np.unique(shards)]
+                f.import_values(cols, values)
+                idx.track_columns(cols)
+                summary["applied"] = summary["expected"] = len(shard_list)
+                apply_s = _time.perf_counter() - t0
+                route_s = 0.0
+                failed = []
+            else:
+                def local_apply(sel, groups):
+                    f.import_values(cols[sel], values[sel])
+                    idx.track_columns(cols[sel])
 
-                    try:
-                        self.server.client.import_values(
-                            n.uri, index, field, int(shard),
-                            cols[m].tolist(), values[m].tolist(),
-                        )
-                        applied += 1
-                    except ClientError as e:
-                        errors.append(f"{n.id}: {e}")
-                        self.server.logger(
-                            f"import-value shard {shard} to replica {n.id} "
-                            f"failed (anti-entropy will repair): {e}"
-                        )
-            if not applied:
-                raise ApiError(
-                    f"import-value shard {shard}: no owner reachable: {errors}"
+                def remote_submit(n, g):
+                    return self.server.import_pool.submit(
+                        self.server.client.import_values,
+                        n.uri, index, field, g[0],
+                        cols[g[1]], values[g[1]],
+                    )
+
+                shard_list, failed, apply_s, route_s = self._import_routed(
+                    idx, shards, None, local_apply, remote_submit,
+                    "import-value", summary,
                 )
-            summary["applied"] += applied
-            summary["expected"] += len(targets)
-            summary["errors"] += errors
-            if not local_only:
-                self._announce_shard(index, field, int(shard))
-        return summary
+            stats.count("ingest.bits", int(len(cols)))
+            stats.count("ingest.batches", len(shard_list))
+            stats.timing("ingest.apply_ms", apply_s)
+            stats.timing("ingest.route_ms", route_s)
+            span.set_tag("ingest.batches", len(shard_list))
+            if not local_only and shard_list:
+                self._announce_shards(idx.name, f.name, shard_list)
+            if failed:
+                shard, errs = failed[0]
+                raise ApiError(
+                    f"import-value shard {shard}: no owner reachable: {errs}"
+                )
+            return summary
 
     def _index_field(self, index: str, field: str):
         idx = self.holder.index(index)
@@ -523,59 +680,6 @@ class API:
             cols = idx.translate_store.translate_keys(list(cols))
         cols = np.asarray(cols, dtype=np.uint64)
         return rows, cols
-
-    def _route_shard_import(
-        self, idx, f, shard, rows, cols, clear, timestamps, local_only
-    ) -> tuple:
-        """Returns (applied, expected, errors) for durability reporting."""
-        owners = self.cluster.shard_nodes(idx.name, shard)
-        targets = [self.server.node] if local_only else owners
-        applied = 0
-        errors = []
-        for n in targets:
-            if n.id == self.server.node.id:
-                ts = (
-                    [timeq.parse_time(t) if t is not None else None for t in timestamps]
-                    if timestamps is not None
-                    else None
-                )
-                f.import_bits(rows, cols, timestamps=ts, clear=clear)
-                idx.track_columns(cols)
-                applied += 1
-            else:
-                # replica fan-out is best-effort per owner: a down replica
-                # is repaired by anti-entropy after it returns (the
-                # reference likewise keeps accepting writes in DEGRADED,
-                # api.go:104). Zero live owners is still an error —
-                # nothing accepted the write.
-                from pilosa_tpu.server.client import ClientError
-
-                try:
-                    self.server.client.import_bits(
-                        n.uri, idx.name, f.name, shard,
-                        rows.tolist(), cols.tolist(), clear,
-                        timestamps=timestamps,
-                    )
-                    applied += 1
-                except ClientError as e:
-                    errors.append(f"{n.id}: {e}")
-                    # ledger entries only at replica_n>1: with no second
-                    # copy AE has nothing to repair from, so an entry
-                    # could never drain (the summary carries the error)
-                    if self.cluster.replica_n > 1:
-                        self.holder.record_pending_repair(
-                            idx.name, shard, n.id
-                        )
-                        self.server.stats.count("write_replica_dropped", 1)
-                    self.server.logger(
-                        f"import shard {shard} to replica {n.id} failed "
-                        f"(anti-entropy will repair): {e}"
-                    )
-        if not applied:
-            raise ApiError(f"import shard {shard}: no owner reachable: {errors}")
-        if not local_only:
-            self._announce_shard(idx.name, f.name, shard)
-        return applied, len(targets), errors
 
     def import_roaring(
         self,
@@ -660,11 +764,17 @@ class API:
     def _announce_shard(self, index: str, field: str, shard: int) -> None:
         """Tell every node the shard now exists so query fan-out covers it
         (reference: field.AddRemoteAvailableShards broadcast)."""
+        self._announce_shards(index, field, [shard])
+
+    def _announce_shards(self, index: str, field: str, shards: List[int]) -> None:
+        """One availability broadcast for a whole import's shard set — a
+        bulk import covering hundreds of shards announces once, not once
+        per shard."""
         msg = {
             "type": "available-shards",
             "index": index,
             "field": field,
-            "shards": [shard],
+            "shards": list(shards),
         }
         self.receive_message(msg)
         self._broadcast(msg)

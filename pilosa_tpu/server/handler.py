@@ -112,6 +112,33 @@ class Handler(BaseHTTPRequestHandler):
             raise BadParam(f"missing required query parameter {name!r}")
         return raw
 
+    def _bool_param(self, name: str, default: bool = False) -> bool:
+        """Validated boolean query parameter: absent -> default; anything
+        other than 1/0/true/false -> 400 naming the parameter (a typo'd
+        `?clear=ture` must be a client error, never a silent False)."""
+        raw = self.query.get(name)
+        if raw is None:
+            return default
+        if raw in ("1", "true"):
+            return True
+        if raw in ("0", "false", ""):
+            return False
+        raise BadParam(
+            f"query parameter {name!r} must be a boolean "
+            f"(1/0/true/false), got {raw!r}"
+        )
+
+    def _int_path(self, name: str, raw: str) -> int:
+        """Validated integer path component -> 400 naming the component
+        (`/import-roaring/abc` must be a client error, not an opaque
+        404/500)."""
+        try:
+            return int(raw)
+        except ValueError:
+            raise BadParam(
+                f"path parameter {name!r} must be an integer, got {raw!r}"
+            ) from None
+
     def _int_list_param(self, name: str) -> List[int]:
         raw = self.query.get(name, "")
         try:
@@ -372,29 +399,32 @@ class Handler(BaseHTTPRequestHandler):
 
     @route(
         "POST",
-        "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>[0-9]+)",
+        "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>[^/]+)",
     )
     def post_import_roaring(self, index: str, field: str, shard: str):
         """Zero-parse roaring ingest; body is a serialized roaring bitmap
-        (reference route: http/handler.go import-roaring)."""
+        (reference route: http/handler.go import-roaring). shard and the
+        boolean flags are coerced with the validating helpers: garbage
+        -> 400 JSON naming the parameter, never a 500."""
         changed = self.api.import_roaring(
             index,
             field,
-            int(shard),
+            self._int_path("shard", shard),
             self._body(),
-            clear=self.query.get("clear", "") in ("1", "true"),
+            clear=self._bool_param("clear"),
             view=self.query.get("view"),
-            local_only=self.query.get("remote", "") in ("1", "true"),
+            local_only=self._bool_param("remote"),
         )
         self._reply({"changed": changed})
 
     @route(
         "GET",
-        "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/export-roaring/(?P<shard>[0-9]+)",
+        "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/export-roaring/(?P<shard>[^/]+)",
     )
     def get_export_roaring(self, index: str, field: str, shard: str):
         data = self.api.export_roaring(
-            index, field, int(shard), view=self.query.get("view")
+            index, field, self._int_path("shard", shard),
+            view=self.query.get("view"),
         )
         self._reply(None, raw=data, content_type="application/octet-stream")
 
@@ -552,7 +582,7 @@ class Handler(BaseHTTPRequestHandler):
             rows, cols = wire.decode_arrays(self._body(), 2)
             self.api.import_bits(
                 index, field, rows, cols,
-                clear=self.query.get("clear", "") in ("1", "true"),
+                clear=self._bool_param("clear"),
                 local_only=True,
             )
         else:

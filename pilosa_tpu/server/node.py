@@ -75,6 +75,7 @@ class NodeServer:
         hbm_extent_rows: int = 256,  # shards per operand extent; 0 = monolithic
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
+        import_concurrency: int = 8,  # parallel replica-import RPCs per call
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -172,6 +173,13 @@ class NodeServer:
                 depth=hbm_prefetch_depth, logger=self.logger
             ).start()
             self.scheduler.prefetcher = self.prefetcher
+        # bulk-import replica fan-out (server/api.py): shard batches ship
+        # to their owner nodes on this bounded pool concurrently instead
+        # of one serial HTTP round-trip per shard. Threads spawn lazily,
+        # so an idle pool costs nothing.
+        self.import_concurrency = max(1, int(import_concurrency))
+        self._import_pool = None
+        self._import_pool_mu = TrackedLock("node.import_pool_mu")
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.probe_interval = probe_interval
@@ -476,9 +484,29 @@ class NodeServer:
             except Exception as e:  # noqa: BLE001 - keep the ticker alive
                 self._ticker_error("cache-flush", e)
 
+    @property
+    def import_pool(self):
+        """Lazily created bounded thread pool for replica import fan-out
+        (created under a lock on the first multi-node import — two
+        concurrent first imports must not each build a pool and leak one;
+        single-node imports never touch it)."""
+        with self._import_pool_mu:
+            if self._import_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._import_pool = ThreadPoolExecutor(
+                    max_workers=self.import_concurrency,
+                    thread_name_prefix="pilosa-tpu-import",
+                )
+            return self._import_pool
+
     def stop(self) -> None:
         self._closing.set()
         self.profiler.close()  # unblock any open /debug/pprof window
+        with self._import_pool_mu:
+            pool, self._import_pool = self._import_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         if self.prefetcher is not None:
             self.prefetcher.stop()  # joins the warm worker before teardown
         if self._httpd is not None:

@@ -43,6 +43,12 @@ STAT_NAMES = frozenset(
         "query_ms",
         # distributed writes (exec/distributed.py, server/api.py)
         "write_replica_dropped",
+        # bulk ingest (server/api.py import endpoints): bits and shard
+        # batches accepted, local apply vs replica routing latency
+        "ingest.bits",
+        "ingest.batches",
+        "ingest.apply_ms",
+        "ingest.route_ms",
         # internode fault tolerance (server/client.py)
         "internode.retry",
         "internode.breaker_fastfail",

@@ -148,6 +148,63 @@ class TestExtentPaging:
         f.set_bit(5, 2 * SHARD_WIDTH + 7)
         assert ex.execute("hbmx", "Count(Row(f=5))")[0] == 2
 
+    def test_dirty_extent_single_shard_write(self, paging_env):
+        """ISSUE 5 acceptance: warm an 8-extent stack, write ONE bit into
+        one shard, re-run the count — the restage delta is exactly the
+        covering extent's bytes (not the whole stack), and the result
+        matches a cold full re-stage."""
+        hbm_res.configure(extent_rows=1)  # 8 shards -> 8 extents
+        DEVICE_CACHE.budget_bytes = 1 << 30
+        S = 8
+        ex, h = _populated_executor(1, S)
+        q = "Count(Row(f=0))"
+        got1 = ex.execute("hbmx", q)[0]
+        snap1 = hbm_res.stats_snapshot()
+        # warm repeat: fully resident, zero restage
+        assert ex.execute("hbmx", q)[0] == got1
+        snap2 = hbm_res.stats_snapshot()
+        assert snap2["restage_bytes"] == snap1["restage_bytes"]
+
+        f = h.index("hbmx").field("f")
+        changed = f.set_bit(0, 3 * SHARD_WIDTH + 11)  # one bit, shard 3
+        got2 = ex.execute("hbmx", q)[0]
+        assert got2 == got1 + (1 if changed else 0)  # results stay exact
+        snap3 = hbm_res.stats_snapshot()
+        delta = snap3["restage_bytes"] - snap2["restage_bytes"]
+        ext_bytes = 1 * WORDS_PER_ROW * 4
+        stack_bytes = S * WORDS_PER_ROW * 4
+        # the acceptance equality: ONLY the covering extent re-staged
+        assert delta == ext_bytes
+        assert delta < stack_bytes
+        # equality vs a cold run: full re-stage computes the same count
+        DEVICE_CACHE.clear()
+        assert ex.execute("hbmx", q)[0] == got2
+
+    def test_dirty_extent_bulk_ingest_other_row(self, paging_env):
+        """A staged bulk import into OTHER rows of two shards dirties only
+        those shards' extents of the warm operand (fragment versions are
+        the extent key salt, so any write to a covered fragment re-keys
+        its extent — but never its neighbors')."""
+        import numpy as np
+
+        hbm_res.configure(extent_rows=1)
+        DEVICE_CACHE.budget_bytes = 1 << 30
+        S = 8
+        ex, h = _populated_executor(1, S)
+        q = "Count(Row(f=0))"
+        got1 = ex.execute("hbmx", q)[0]
+        snap1 = hbm_res.stats_snapshot()
+        f = h.index("hbmx").field("f")
+        # staged fast path: bits for row 9 into shards 2 and 5
+        f.import_bits(
+            np.array([9, 9], np.uint64),
+            np.array([2 * SHARD_WIDTH + 1, 5 * SHARD_WIDTH + 1], np.uint64),
+        )
+        assert ex.execute("hbmx", q)[0] == got1  # row 0 unchanged
+        snap2 = hbm_res.stats_snapshot()
+        delta = snap2["restage_bytes"] - snap1["restage_bytes"]
+        assert delta == 2 * WORDS_PER_ROW * 4  # the two dirty extents only
+
     def test_cost_discount_scoped_to_referenced_fields(self, paging_env):
         """Field f's warm residency discounts f-queries only — a cold
         query on field g keeps its full admission byte weight."""

@@ -1,0 +1,276 @@
+"""Bulk-ingest fast path tests (ISSUE 5 tentpole).
+
+The write path is now staged: Field.import_bits routes non-mutex SET
+batches through View.stage_bulk -> Fragment.stage_positions, which WAL-
+frames the batch and defers the row-store merge + rank-cache
+reconciliation to the next read barrier. These tests pin down:
+
+- bit-for-bit equivalence of the staged path vs naive per-bit semantics,
+  with reads interleaved between write batches (every read barrier must
+  merge first),
+- the vectorized clear path and the C-speed mutex-vector maintenance,
+- WAL crash-recovery equivalence under the batched framing (satellite):
+  bulk-import, "kill" before snapshot, replay, bit-identical fragment and
+  identical rank-cache TopN order,
+- api.import_bits summary semantics + the argsort-shared timestamp
+  grouping (satellite),
+- the import-roaring handler's shard/boolean param coercion (satellite):
+  garbage -> 400 JSON naming the parameter, never a 500.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import Field, FieldOptions
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import ClusterHarness
+
+
+def _pairs_set(field, n_shards):
+    """{(row, absolute_col)} across every standard-view fragment."""
+    out = set()
+    v = field.view("standard")
+    if v is None:
+        return out
+    for s in v.available_shards():
+        rows, cols = v.fragments[s].pairs()
+        base = s * SHARD_WIDTH
+        out.update(
+            (int(r), int(c) + base) for r, c in zip(rows.tolist(), cols.tolist())
+        )
+    return out
+
+
+class TestStagedFastPath:
+    def test_matches_naive_semantics_with_duplicates(self):
+        h = Holder().open()
+        idx = h.create_index("ing")
+        f = idx.create_field("f", FieldOptions())
+        rng = np.random.default_rng(11)
+        n = 5000
+        rows = rng.integers(0, 40, n).astype(np.uint64)
+        cols = rng.integers(0, 7 * SHARD_WIDTH, n).astype(np.uint64)
+        # duplicates on purpose: every position twice
+        f.import_bits(np.concatenate([rows, rows]), np.concatenate([cols, cols]))
+        want = set(zip(rows.tolist(), cols.tolist()))
+        assert _pairs_set(f, 7) == want
+
+    def test_reads_between_batches_see_staged_bits(self):
+        h = Holder().open()
+        idx = h.create_index("ing2")
+        f = idx.create_field("f", FieldOptions())
+        f.import_bits(np.array([3, 3], np.uint64), np.array([7, SHARD_WIDTH + 9], np.uint64))
+        v = f.view("standard")
+        frag0 = v.fragments[0]
+        # every read barrier must merge the pending delta first
+        assert frag0.has_row(3)
+        assert frag0.contains(3, 7)
+        assert frag0.row_count(3) == 1
+        assert v.fragments[1].row_count(3) == 1
+        assert frag0.cache_top()[0] == (3, 1)
+        # a second staged batch after the merge
+        f.import_bits(np.array([3], np.uint64), np.array([8], np.uint64))
+        assert frag0.row_count(3) == 2
+        assert set(frag0.row_positions(3).tolist()) == {7, 8}
+
+    def test_interleaved_clear_flushes_pending_first(self):
+        h = Holder().open()
+        idx = h.create_index("ing3")
+        f = idx.create_field("f", FieldOptions())
+        f.import_bits(np.array([1, 1, 1], np.uint64), np.array([5, 6, 7], np.uint64))
+        # clear rides the exact path, which must merge the staged bits
+        # before computing changed counts
+        assert f.clear_bit(1, 6)
+        assert not f.clear_bit(1, 99)  # never set
+        f.import_bits(np.array([1], np.uint64), np.array([6], np.uint64))
+        assert _pairs_set(f, 1) == {(1, 5), (1, 6), (1, 7)}
+
+    def test_bulk_clear_sparse_and_dense_rows(self):
+        h = Holder().open()
+        idx = h.create_index("ing4")
+        f = idx.create_field("f", FieldOptions())
+        rng = np.random.default_rng(7)
+        rows = rng.integers(0, 6, 4000).astype(np.uint64)
+        cols = rng.integers(0, SHARD_WIDTH, 4000).astype(np.uint64)
+        f.import_bits(rows, cols)
+        # densify row 0 (beyond the n_words crossover)
+        wide = np.arange(SHARD_WIDTH // 16, dtype=np.uint64) * 8
+        f.import_bits(np.zeros(len(wide), np.uint64), wide)
+        want = set(zip(rows.tolist(), cols.tolist()))
+        want |= {(0, int(c)) for c in wide.tolist()}
+        # clear a mixed batch: some set, some never-set, dense + sparse rows
+        crows = rng.integers(0, 6, 1500).astype(np.uint64)
+        ccols = rng.integers(0, SHARD_WIDTH, 1500).astype(np.uint64)
+        frag = f.view("standard").fragments[0]
+        n_cleared = frag.import_positions(
+            None, crows * np.uint64(SHARD_WIDTH) + ccols
+        )[1]
+        gone = set(zip(crows.tolist(), ccols.tolist()))
+        assert n_cleared == len(want & gone)
+        assert _pairs_set(f, 1) == want - gone
+        # rank cache reconciled in the same batch
+        for r in range(6):
+            assert frag.cache.get(r) == frag.row_count(r)
+
+    def test_mutex_field_keeps_last_write_wins(self):
+        h = Holder().open()
+        idx = h.create_index("ing5")
+        f = idx.create_field("m", FieldOptions(type="mutex", cache_type="none"))
+        rows = np.array([1, 2, 3, 2], np.uint64)
+        cols = np.array([4, 4, 9, 9], np.uint64)
+        f.import_bits(rows, cols)
+        assert _pairs_set(f, 1) == {(2, 4), (2, 9)}
+        # the C-speed mutex-vector update must agree with the stored bits
+        frag = f.view("standard").fragments[0]
+        assert frag._mutex_map == {4: 2, 9: 2}
+
+
+class TestWalCrashRecovery:
+    def test_batched_framing_replay_equivalence(self, tmp_path):
+        """Satellite: bulk-import, kill before snapshot, replay — bit-for-
+        bit fragment equality and identical rank-cache TopN order."""
+        path = os.path.join(str(tmp_path), "frag0")
+        frag = Fragment(path, "i", "f", "standard", 0, max_op_n=10**9).open()
+        rng = np.random.default_rng(3)
+        for _ in range(4):  # several staged batches -> several WAL records
+            pos = (
+                rng.integers(0, 50, 3000).astype(np.uint64) * np.uint64(SHARD_WIDTH)
+                + rng.integers(0, SHARD_WIDTH, 3000).astype(np.uint64)
+            )
+            frag.stage_positions(pos)
+        # one exact import call: its set AND clear records land as ONE
+        # batched WAL write (append_many)
+        to_set = np.array([60 * SHARD_WIDTH + 5, 60 * SHARD_WIDTH + 6], np.uint64)
+        to_clear = np.array([60 * SHARD_WIDTH + 6, 2 * SHARD_WIDTH + 1], np.uint64)
+        frag.import_positions(to_set, to_clear)
+        live_pairs = frag.pairs()
+        live_top = frag.cache_top()
+        # crash: NO close(), NO snapshot — a second Fragment replays the WAL
+        assert os.path.getsize(frag.wal_path) > 0
+        re = Fragment(path, "i", "f", "standard", 0, max_op_n=10**9).open()
+        got_pairs = re.pairs()
+        assert np.array_equal(got_pairs[0], live_pairs[0])
+        assert np.array_equal(got_pairs[1], live_pairs[1])
+        assert re.cache_top() == live_top
+        re.close()
+        frag.close()
+
+    def test_snapshot_merges_pending_before_wal_truncate(self, tmp_path):
+        """A snapshot taken with a pending delta must not lose it: the
+        merge happens before truncate() discards the WAL records."""
+        path = os.path.join(str(tmp_path), "frag1")
+        frag = Fragment(path, "i", "f", "standard", 0, max_op_n=10**9).open()
+        frag.stage_positions(np.array([5 * SHARD_WIDTH + 2], np.uint64))
+        frag.snapshot()
+        assert os.path.getsize(frag.wal_path) == 0
+        frag.close()
+        re = Fragment(path, "i", "f", "standard", 0).open()
+        assert re.contains(5, 2)
+        re.close()
+
+
+class TestApiImport:
+    def test_summary_and_timestamp_grouping(self):
+        with ClusterHarness(1, in_memory=True) as c:
+            api = c[0].api
+            api.create_index("ti")
+            api.create_field(
+                "ti", "t", {"type": "time", "time_quantum": "YMD"}
+            )
+            cols = [3, SHARD_WIDTH + 4, 5, SHARD_WIDTH + 6]
+            ts = [
+                "2019-01-02T00:00",
+                "2020-03-04T00:00",
+                "2019-01-02T00:00",
+                None,
+            ]
+            summary = api.import_bits("ti", "t", [1, 1, 2, 2], cols, timestamps=ts)
+            assert summary["applied"] == summary["expected"] == 2  # 2 shards
+            assert summary["errors"] == []
+            f = c[0].holder.index("ti").field("t")
+            # timestamps rode the argsort permutation: each bit landed in
+            # its own day view, in the right shard
+            v = f.view("standard_20190102")
+            assert v is not None
+            assert v.fragments[0].contains(1, 3)
+            assert v.fragments[0].contains(2, 5)
+            assert 1 not in v.fragments
+            v2 = f.view("standard_20200304")
+            assert v2.fragments[1].contains(1, SHARD_WIDTH + 4)
+            # the None-timestamp bit is standard-view only
+            std = f.view("standard")
+            assert std.fragments[1].contains(2, SHARD_WIDTH + 6)
+            for vname, vv in f.views.items():
+                if vname.startswith("standard_"):
+                    for frag in vv.fragments.values():
+                        assert not frag.contains(2, SHARD_WIDTH + 6)
+
+    def test_parallel_replica_routing_reaches_all_owners(self):
+        with ClusterHarness(3, replica_n=2, in_memory=True) as c:
+            api = c[0].api
+            api.create_index("pr")
+            api.create_field("pr", "f", {"type": "set"})
+            rng = np.random.default_rng(5)
+            cols = rng.integers(0, 6 * SHARD_WIDTH, 500).astype(np.uint64)
+            summary = api.import_bits("pr", "f", [0] * len(cols), cols)
+            assert summary["applied"] == summary["expected"]
+            assert summary["errors"] == []
+            want = int(len(np.unique(cols)))
+            # every node answers the full count (each shard on 2 owners,
+            # queries fan out over live owners)
+            for srv in c.nodes:
+                got = srv.api.query("pr", "Count(Row(f=0))")[0]
+                assert got == want
+
+    def test_ingest_stats_emitted(self):
+        with ClusterHarness(1, in_memory=True) as c:
+            api = c[0].api
+            api.create_index("st")
+            api.create_field("st", "f", {"type": "set"})
+            api.import_bits("st", "f", [1, 1], [3, SHARD_WIDTH + 4])
+            snap = c[0].stats.registry.snapshot()
+            assert snap.get("ingest.bits;index:st") == 2
+            assert snap.get("ingest.batches;index:st") == 2
+            assert "ingest.apply_ms;index:st" in snap
+            assert "ingest.route_ms;index:st" in snap
+
+
+class TestRoaringParamCoercion:
+    def test_bad_shard_and_bool_params_400(self):
+        with ClusterHarness(1, in_memory=True) as c:
+            uri = c[0].node.uri
+            c[0].api.create_index("rc")
+            c[0].api.create_field("rc", "f", {"type": "set"})
+
+            def expect_400(method, url, body=b""):
+                req = urllib.request.Request(url, data=body, method=method)
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=10)
+                assert ei.value.code == 400, url
+                msg = json.loads(ei.value.read())["error"]
+                ei.value.close()
+                return msg
+
+            msg = expect_400(
+                "POST", f"{uri}/index/rc/field/f/import-roaring/abc"
+            )
+            assert "shard" in msg and "abc" in msg
+            msg = expect_400(
+                "POST", f"{uri}/index/rc/field/f/import-roaring/0?clear=ture"
+            )
+            assert "clear" in msg and "ture" in msg
+            msg = expect_400(
+                "POST", f"{uri}/index/rc/field/f/import-roaring/0?remote=2"
+            )
+            assert "remote" in msg
+            msg = expect_400(
+                "GET", f"{uri}/index/rc/field/f/export-roaring/1.5", None
+            )
+            assert "shard" in msg
