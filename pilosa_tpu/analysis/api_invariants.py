@@ -18,6 +18,13 @@ Rules (codes):
 * API005 — a config knob with no corresponding `server` CLI flag
   (every knob must be settable from the command line, per the
   config-precedence contract flags > env > file > defaults).
+* API006 — a span started (`start_span` / `start_span_from_headers` /
+  `record_span`) with a literal name not declared in `utils/tracing.py`
+  `SPAN_NAMES`. The flight recorder's assembly, dashboards, and the
+  slow-query log key on these names; an undeclared span is a stage
+  nothing can attribute.
+* API007 — a declared SPAN_NAMES entry no module starts: stale
+  registry (same contract as API002 for STAT_NAMES).
 
 All facts are extracted statically from the ASTs — the pass never
 imports the package, so it works on broken/half-edited trees too.
@@ -39,6 +46,10 @@ from pilosa_tpu.analysis.framework import (
 __all__ = ["ApiInvariantsPass"]
 
 _EMIT_METHODS = {"count", "gauge", "histogram", "timing", "set_value", "timer"}
+
+# span-starting callables (methods on a tracer, or the module-level
+# helpers in utils/tracing.py that route to the active trace's tracer)
+_SPAN_METHODS = {"start_span", "start_span_from_headers", "record_span"}
 
 # server flags that intentionally do NOT map to config knobs
 _NON_KNOB_FLAGS = {
@@ -82,10 +93,13 @@ class ApiInvariantsPass(Pass):
         findings: List[Finding] = []
         by_rel = {m.rel: m for m in modules}
         stats_mod = by_rel.get("pilosa_tpu/utils/stats.py")
+        tracing_mod = by_rel.get("pilosa_tpu/utils/tracing.py")
         config_mod = by_rel.get("pilosa_tpu/cli/config.py")
         main_mod = by_rel.get("pilosa_tpu/cli/main.py")
         if stats_mod is not None:
             self._check_stats(modules, stats_mod, findings)
+        if tracing_mod is not None:
+            self._check_spans(modules, tracing_mod, findings)
         if config_mod is not None:
             knobs = self._config_knobs(config_mod)
             self._check_docs(config_mod, knobs, findings)
@@ -194,6 +208,85 @@ class ApiInvariantsPass(Pass):
                     message=(
                         f"STAT_NAMES declares {name!r} but no module "
                         "emits it — stale registry entry"
+                    ),
+                )
+            )
+
+    # -- span-name registry ------------------------------------------------
+
+    @staticmethod
+    def _declared_spans(tracing_mod: Module) -> Tuple[Set[str], int]:
+        names: Set[str] = set()
+        line = 1
+        for stmt in tracing_mod.tree.body:
+            if not (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "SPAN_NAMES"
+            ):
+                continue
+            for node in ast.walk(stmt.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    names.add(node.value)
+            line = stmt.lineno
+        return names, line
+
+    def _check_spans(
+        self,
+        modules: Sequence[Module],
+        tracing_mod: Module,
+        findings: List[Finding],
+    ) -> None:
+        names, names_line = self._declared_spans(tracing_mod)
+        started: Set[str] = set()
+        for m in modules:
+            if m.rel == tracing_mod.rel:
+                continue  # the tracer plumbing itself, not start sites
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                # tracer.start_span("x") / tracing.record_span("x", ...)
+                # method style, or a from-imported bare call
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr not in _SPAN_METHODS:
+                        continue
+                elif isinstance(fn, ast.Name):
+                    if fn.id not in _SPAN_METHODS:
+                        continue
+                else:
+                    continue
+                arg = node.args[0]
+                if not (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                ):
+                    continue
+                started.add(arg.value)
+                if arg.value not in names:
+                    findings.append(
+                        Finding(
+                            code="API006",
+                            path=m.rel,
+                            line=node.lineno,
+                            message=(
+                                f"span {arg.value!r} started but not "
+                                "declared in utils/tracing.py SPAN_NAMES"
+                            ),
+                        )
+                    )
+        for name in sorted(names - started):
+            findings.append(
+                Finding(
+                    code="API007",
+                    path=tracing_mod.rel,
+                    line=names_line,
+                    message=(
+                        f"SPAN_NAMES declares {name!r} but no module "
+                        "starts it — stale registry entry"
                     ),
                 )
             )

@@ -82,8 +82,13 @@ class MetricConfig:
 
 @dataclass
 class TracingConfig:
+    # query flight recorder (utils/tracing.py; docs/observability.md).
+    # `enabled` gates spontaneous ROOT sampling only: an incoming trace
+    # header (the sender sampled) and the `profile=true` query option
+    # always record, so flight recording works on demand either way.
     enabled: bool = False
-    sample_rate: float = 1.0
+    sample_rate: float = 1.0  # fraction of root queries traced
+    ring: int = 1024  # spans kept in the per-node ring (/debug/traces)
 
 
 @dataclass

@@ -69,6 +69,11 @@ def _build_parser() -> argparse.ArgumentParser:
         help="fraction of queries traced when tracing is enabled",
     )
     sp.add_argument(
+        "--tracing-ring", type=int,
+        help="spans kept in the per-node flight-recorder ring "
+        "(/debug/traces)",
+    )
+    sp.add_argument(
         "--retry-max-attempts", type=int,
         help="internode RPC attempts within one deadline budget",
     )
@@ -217,6 +222,7 @@ _FLAG_KNOBS = {
     "metric_poll_interval": ("metric", "poll_interval"),
     "tracing_enabled": ("tracing", "enabled"),
     "tracing_sample_rate": ("tracing", "sample_rate"),
+    "tracing_ring": ("tracing", "ring"),
     "tls_certificate": ("tls", "certificate"),
     "tls_key": ("tls", "key"),
     "tls_skip_verify": ("tls", "skip_verify"),
@@ -349,6 +355,9 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,
+        tracing_enabled=cfg.tracing.enabled,
+        trace_sample_rate=cfg.tracing.sample_rate,
+        trace_ring=cfg.tracing.ring,
         long_query_time=cfg.long_query_time,
         logger=new_logger(verbose=cfg.verbose, stream=log_stream),
         tls_cert=os.path.expanduser(cfg.tls.certificate) if cfg.tls.certificate else "",

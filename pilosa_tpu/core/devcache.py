@@ -206,6 +206,9 @@ class DeviceCache:
                 # a peer is building this key: wait for its insert instead
                 # of double-building (and double-charging the byte ledger)
                 self._build_cv.wait()
+        import time as _t
+
+        t_build0 = _t.perf_counter()
         try:
             arr = build()
         except BaseException:
@@ -214,6 +217,15 @@ class DeviceCache:
                 self._build_cv.notify_all()
             raise
         nb = _nbytes(arr)
+        if not extent:
+            # flight-recorder staging attribution for NON-extent entries
+            # (TopN tally bundles etc.) — extent staging is accounted by
+            # hbm/residency, which wraps the whole assembly
+            from pilosa_tpu.utils import tracing as _tracing
+
+            _tracing.note_stage(
+                nbytes=nb, seconds=_t.perf_counter() - t_build0
+            )
         with self._mu:
             self._building.discard(key)
             self._put_locked(key, arr, nb, extent=extent, shards=shards)

@@ -26,6 +26,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
+from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 from pilosa_tpu.pql import Call, Query
 
@@ -132,6 +133,7 @@ class CountBatcher:
                 self._busy[index] = True
                 w = None
         if w is not None:
+            t_wait0 = time.monotonic()
             w.event.wait()
             if w.promoted:
                 # took over leadership: this thread executes the next
@@ -142,6 +144,16 @@ class CountBatcher:
                 self._serve_round(index, execute, first=w)
             else:
                 _bump("batched")
+            # flight record: this query rode along in someone else's
+            # round — the wait (and, when promoted, the round it then
+            # led) is where its milliseconds went
+            tracing.record_span(
+                "exec.batch",
+                time.monotonic() - t_wait0,
+                tags={
+                    "batcher.role": "promoted" if w.promoted else "batched",
+                },
+            )
             if w.error is not None:
                 raise w.error
             return w.results
@@ -183,7 +195,10 @@ class CountBatcher:
         _bump("leader")
         self._record_round(len(query.calls))
         try:
-            return execute(query)
+            with tracing.start_span("exec.batch") as sp:
+                sp.set_tag("batcher.role", "leader")
+                sp.set_tag("batcher.calls", len(query.calls))
+                return execute(query)
         finally:
             self._serve_round(index, execute)
 
@@ -245,7 +260,10 @@ class CountBatcher:
         merged = Query(calls=calls)
         try:
             _bump("merged_execs")
-            res = execute(merged)
+            with tracing.start_span("exec.batch") as sp:
+                sp.set_tag("batcher.role", "merged-leader")
+                sp.set_tag("batcher.calls", n_real)
+                res = execute(merged)
             k = 0
             for w in batch:
                 n = len(w.query.calls)

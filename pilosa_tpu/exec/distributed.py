@@ -25,6 +25,7 @@ from __future__ import annotations
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.cluster.topology import Cluster
 from pilosa_tpu.core.holder import Holder
@@ -136,95 +137,119 @@ class DistributedExecutor(Executor):
         partials: List[Any] = []
         failed: set = set()
         attempts = 0
-        while remaining:
-            attempts += 1
-            if attempts > len(cluster.nodes) + 1:
-                raise RemoteError("shards could not be placed on any live node")
-            if deadline.expired():
-                raise RemoteError(
-                    f"query deadline ({self.query_deadline}s) exceeded with "
-                    f"shards unplaced on nodes {sorted(remaining)}"
-                )
-            if attempts > 1:
-                # breathe between re-map rounds: a replica refusing
-                # connections during a restart needs milliseconds, not an
-                # instant second hammering (bounded by the deadline)
-                delay = min(policy.backoff(attempts - 1), deadline.remaining())
-                if delay > 0:
-                    policy.sleep(delay)
-            # one concurrent request per node (executor.go:2522 mapper
-            # goroutines): a slow node no longer serializes the others.
-            # RemoteErrors come back as values so failover re-mapping
-            # inspects every node's outcome; other exceptions propagate.
-            items = list(remaining.items())
-
-            def attempt(t):
-                node_id, node_shards = t
-                try:
-                    # each RPC is bounded by the query deadline's REMAINING
-                    # time, so a hung (connected-but-silent) peer cannot
-                    # stall the fan-out past the deadline
-                    return self._node_partial(
-                        idx,
-                        c,
-                        node_id,
-                        node_shards,
-                        write=write,
-                        timeout=max(0.05, deadline.remaining()),
-                        # the peer's admission controller sheds this leg
-                        # (429, retryable) when OUR remaining budget can
-                        # no longer be met in its queue
-                        deadline=max(0.05, deadline.remaining()),
+        # flight recorder: one exec.fanout span covers the whole fan-out
+        # (all re-map rounds); each per-node request runs inside its own
+        # rpc.leg child, ENTERED ON THE POOL THREAD so the internode
+        # client sees it as the current span — that is what propagates
+        # the trace headers to the peer and hosts the rpc.retries /
+        # breaker tags (the pool thread has no inherited contextvars)
+        fspan = tracing.start_span("exec.fanout")
+        fspan.set_tag("fanout.call", c.name)
+        fspan.set_tag("fanout.shards", len(all_shards))
+        if write:
+            fspan.set_tag("fanout.write", True)
+        with fspan:
+            while remaining:
+                attempts += 1
+                if attempts > len(cluster.nodes) + 1:
+                    raise RemoteError("shards could not be placed on any live node")
+                if deadline.expired():
+                    raise RemoteError(
+                        f"query deadline ({self.query_deadline}s) exceeded with "
+                        f"shards unplaced on nodes {sorted(remaining)}"
                     )
-                except RemoteError as e:
-                    return e
+                if attempts > 1:
+                    # breathe between re-map rounds: a replica refusing
+                    # connections during a restart needs milliseconds, not an
+                    # instant second hammering (bounded by the deadline)
+                    delay = min(policy.backoff(attempts - 1), deadline.remaining())
+                    if delay > 0:
+                        policy.sleep(delay)
+                # one concurrent request per node (executor.go:2522 mapper
+                # goroutines): a slow node no longer serializes the others.
+                # RemoteErrors come back as values so failover re-mapping
+                # inspects every node's outcome; other exceptions propagate.
+                items = list(remaining.items())
 
-            if len(items) == 1:
-                outcomes = [attempt(items[0])]
-            else:
-                outcomes = list(self._fanout_pool().map(attempt, items))
-            retry: Dict[str, List[int]] = {}
-            for (node_id, node_shards), res in zip(items, outcomes):
-                if not isinstance(res, RemoteError):
-                    partials.append(res)
-                    continue
-                failed.add(node_id)
-                if write:
-                    # replicas already targeted; drift repairs via
-                    # anti-entropy — but the debt must be VISIBLE: record
-                    # each dropped (index, shard, replica) for /status and
-                    # bump the drop counter (ISSUE satellite #2). Ledger
-                    # entries only exist at replica_n>1: with no second
-                    # copy there is nothing for AE to repair FROM, so an
-                    # entry could never drain (the error surfaces through
-                    # the call's own result/logs instead).
-                    if cluster.replica_n > 1:
-                        for s in node_shards:
-                            self.holder.record_pending_repair(
-                                idx.name, s, node_id
+                def attempt(t):
+                    node_id, node_shards = t
+                    with tracing.start_span("rpc.leg", parent=fspan) as leg:
+                        leg.set_tag("peer", node_id)
+                        leg.set_tag(
+                            "leg.local", node_id == self.local_id
+                        )
+                        leg.set_tag("leg.shards", len(node_shards))
+                        try:
+                            # each RPC is bounded by the query deadline's
+                            # REMAINING time, so a hung (connected-but-
+                            # silent) peer cannot stall the fan-out past
+                            # the deadline
+                            return self._node_partial(
+                                idx,
+                                c,
+                                node_id,
+                                node_shards,
+                                write=write,
+                                timeout=max(0.05, deadline.remaining()),
+                                # the peer's admission controller sheds
+                                # this leg (429, retryable) when OUR
+                                # remaining budget can no longer be met
+                                # in its queue
+                                deadline=max(0.05, deadline.remaining()),
                             )
-                        self.stats.count(
-                            "write_replica_dropped", len(node_shards)
+                        except RemoteError as e:
+                            leg.set_tag("leg.error", str(e)[:200])
+                            return e
+
+                if len(items) == 1:
+                    outcomes = [attempt(items[0])]
+                else:
+                    outcomes = list(self._fanout_pool().map(attempt, items))
+                retry: Dict[str, List[int]] = {}
+                for (node_id, node_shards), res in zip(items, outcomes):
+                    if not isinstance(res, RemoteError):
+                        partials.append(res)
+                        continue
+                    failed.add(node_id)
+                    if write:
+                        # replicas already targeted; drift repairs via
+                        # anti-entropy — but the debt must be VISIBLE: record
+                        # each dropped (index, shard, replica) for /status and
+                        # bump the drop counter (ISSUE satellite #2). Ledger
+                        # entries only exist at replica_n>1: with no second
+                        # copy there is nothing for AE to repair FROM, so an
+                        # entry could never drain (the error surfaces through
+                        # the call's own result/logs instead).
+                        if cluster.replica_n > 1:
+                            for s in node_shards:
+                                self.holder.record_pending_repair(
+                                    idx.name, s, node_id
+                                )
+                            self.stats.count(
+                                "write_replica_dropped", len(node_shards)
+                            )
+                        continue
+                    # re-map this node's shards to the next live replica,
+                    # preferring replicas whose breaker is closed
+                    for s in node_shards:
+                        owners = [
+                            n
+                            for n in cluster.shard_nodes(idx.name, s)
+                            if n.id not in failed and n.state != "DOWN"
+                        ]
+                        if not owners:
+                            raise RemoteError(
+                                f"shard {s} unavailable: all replicas down"
+                            )
+                        owners.sort(
+                            key=lambda n: n.id != self.local_id
+                            and self._breaker_open(n.uri)
                         )
-                    continue
-                # re-map this node's shards to the next live replica,
-                # preferring replicas whose breaker is closed
-                for s in node_shards:
-                    owners = [
-                        n
-                        for n in cluster.shard_nodes(idx.name, s)
-                        if n.id not in failed and n.state != "DOWN"
-                    ]
-                    if not owners:
-                        raise RemoteError(
-                            f"shard {s} unavailable: all replicas down"
-                        )
-                    owners.sort(
-                        key=lambda n: n.id != self.local_id
-                        and self._breaker_open(n.uri)
-                    )
-                    retry.setdefault(owners[0].id, []).append(s)
-            remaining = retry
+                        retry.setdefault(owners[0].id, []).append(s)
+                remaining = retry
+            fspan.set_tag("fanout.rounds", attempts)
+            if failed:
+                fspan.set_tag("fanout.failed_peers", sorted(failed))
         return partials
 
     def _node_partial(

@@ -95,10 +95,13 @@ class ColumnAttrSet:
 @dataclass
 class QueryResponse:
     """Execute() response: per-call results plus optional column attr sets
-    (reference: QueryResponse, executor.go:113-205)."""
+    (reference: QueryResponse, executor.go:113-205). `profile` carries the
+    assembled cross-node trace tree when the query ran with the
+    `profile=true` option (server/api.py attaches it)."""
 
     results: List[Any]
     column_attr_sets: Optional[List[ColumnAttrSet]] = None
+    profile: Optional[dict] = None
 
 
 @dataclass

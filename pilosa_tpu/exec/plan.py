@@ -23,6 +23,7 @@ sums them in exact Python ints — one device->host read per query.
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -31,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.ops import bsi as obsi
 from pilosa_tpu.ops.bitmap import shift_bits
@@ -269,6 +271,72 @@ def _eval_jit(plan: PNode, out_mode: str, operands: Tuple, scalars: Tuple):
     return res
 
 
+def _flush_stage_span() -> None:
+    """Flush this thread's staging account (hbm/residency uploads, device
+    cache build waits, prefetch credit) into an exec.stage span anchored
+    just before the dispatch that consumes the staged operands. Always
+    drains the accumulator — staging by an unsampled query must not leak
+    into the next sampled one on the same thread."""
+    nbytes, seconds, hits = tracing.take_stage_account()
+    if tracing.active_span() is None:
+        return
+    if nbytes == 0 and seconds < 1e-6 and hits == 0:
+        return
+    tracing.record_span(
+        "exec.stage",
+        seconds,
+        tags={"stage.bytes": nbytes, "stage.prefetch_hits": hits},
+    )
+
+
+def _pre_dispatch() -> float:
+    """Shared dispatch preamble: count the eval, flush staging
+    attribution, and start the lock-wait clock. Returns the timestamp to
+    hand _DispatchProbe once the mutex is acquired."""
+    STATS["evals"] += 1
+    _flush_stage_span()
+    return _time.perf_counter()
+
+
+class _DispatchProbe:
+    """Attribution for ONE compiled dispatch. Construct immediately
+    after acquiring _DISPATCH_MU (with the pre-lock timestamp from
+    _pre_dispatch), call evaled() between the jitted call and the host
+    read, finish() in the dispatch `finally`. Tags: lock wait vs device
+    eval vs blocking device->host read; eval/read are omitted when the
+    eval raised before evaled()."""
+
+    __slots__ = ("_span", "_t_lock", "_t0", "_t1")
+
+    def __init__(self, t_lock: float):
+        self._span = tracing.start_span("exec.dispatch")
+        self._t_lock = t_lock
+        self._t0 = _time.perf_counter()
+        self._t1: Optional[float] = None
+
+    def tag(self, key: str, value) -> None:
+        self._span.set_tag(key, value)
+
+    def evaled(self) -> None:
+        self._t1 = _time.perf_counter()
+
+    def finish(self) -> None:
+        end = _time.perf_counter()
+        sp = self._span
+        sp.set_tag(
+            "dispatch.lock_wait_ms",
+            round((self._t0 - self._t_lock) * 1000.0, 3),
+        )
+        if self._t1 is not None:
+            sp.set_tag(
+                "dispatch.eval_ms", round((self._t1 - self._t0) * 1000.0, 3)
+            )
+            sp.set_tag(
+                "dispatch.read_ms", round((end - self._t1) * 1000.0, 3)
+            )
+        sp.finish()
+
+
 class StackedPlan:
     """A lowered plan plus its operand stacks, ready to evaluate.
 
@@ -316,51 +384,63 @@ class StackedPlan:
     def count(self) -> int:
         """Total count: ONE jitted dispatch + one [S] host read, summed in
         exact Python ints (replaces the per-shard int() sync loop)."""
-        STATS["evals"] += 1
+        t_lock = _pre_dispatch()
         with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
             try:
                 counts = _eval_jit(
                     self.root, "count", tuple(self.operands), self._scalar_args()
                 )
+                probe.evaled()
                 host = np.asarray(counts[: self.n_shards], dtype=np.uint64)
             finally:
+                probe.finish()
                 self.release_extents()
         return int(host.sum())
 
     def shard_counts(self) -> np.ndarray:
-        STATS["evals"] += 1
+        t_lock = _pre_dispatch()
         with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
             try:
                 counts = _eval_jit(
                     self.root, "count", tuple(self.operands), self._scalar_args()
                 )
+                probe.evaled()
                 return np.asarray(counts)[: self.n_shards]
             finally:
+                probe.finish()
                 self.release_extents()
 
     def rows(self) -> jax.Array:
         """Materialized [S, W] result stack (padded shards trimmed)."""
-        STATS["evals"] += 1
+        t_lock = _pre_dispatch()
         with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
             try:
                 out = _eval_jit(
                     self.root, "row", tuple(self.operands), self._scalar_args()
                 )
+                probe.evaled()
                 return out[: self.n_shards].block_until_ready()
             finally:
+                probe.finish()
                 self.release_extents()
 
     def rows_full(self) -> jax.Array:
         """Materialized result stack INCLUDING mesh-padded shards (all-zero
         rows), for composing with other padded [S, W] stacks on device."""
-        STATS["evals"] += 1
+        t_lock = _pre_dispatch()
         with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
             try:
                 out = _eval_jit(
                     self.root, "row", tuple(self.operands), self._scalar_args()
                 )
+                probe.evaled()
                 return out.block_until_ready()
             finally:
+                probe.finish()
                 self.release_extents()
 
 
@@ -388,8 +468,10 @@ class MultiCountPlan:
             self.extents.release()
 
     def counts(self) -> List[int]:
-        STATS["evals"] += 1
+        t_lock = _pre_dispatch()
         with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
+            probe.tag("dispatch.roots", len(self.roots))
             try:
                 out = _eval_multi_jit(
                     tuple(self.roots),
@@ -397,7 +479,9 @@ class MultiCountPlan:
                     tuple(self.operands),
                     tuple(jnp.uint32(s) for s in self.scalars),
                 )
+                probe.evaled()
                 h = np.asarray(out, dtype=np.uint64)[:, : self.n_shards]
             finally:
+                probe.finish()
                 self.release_extents()
         return [int(x) for x in h.sum(axis=1)]

@@ -34,6 +34,7 @@ from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Tuple
 
 from pilosa_tpu.core.devcache import DEVICE_CACHE
+from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.locks import TrackedLock
 
 _DEFAULT_EXTENT_ROWS = 256
@@ -160,19 +161,28 @@ class ExtentTable:
 
 def _note_upload(nbytes: int, key: Tuple, built: bool) -> None:
     """Book one extent acquisition: uploads count restage bytes; hits on
-    prefetcher-staged extents count prefetch hits."""
+    prefetcher-staged extents count prefetch hits. Query-thread work also
+    feeds the per-thread flight-recorder staging account (flushed into an
+    exec.stage span by the dispatch that consumes the operands)."""
     if built:
         _bump("restage_bytes", nbytes)
         if _in_prefetch():
             _bump("prefetch_staged")
             with _stats_mu:
                 _prefetched_keys.add(key)
+        else:
+            tracing.note_stage(nbytes=nbytes)
         return
     if not _in_prefetch():
         with _stats_mu:
             if key in _prefetched_keys:
                 _prefetched_keys.discard(key)
                 _counters["prefetch_hits"] += 1
+                credit = True
+            else:
+                credit = False
+        if credit:
+            tracing.note_stage(prefetch_hits=1)
 
 
 def _stage(
@@ -197,6 +207,31 @@ def _stage(
     its dirty slices after a write burst. `shards` (the shard ids by
     position) is registered with the device cache as each entry's
     coverage, which is what invalidate_owner_shard matches against."""
+    import time
+
+    t_stage0 = time.perf_counter()
+    try:
+        return _stage_inner(
+            key_base, n_shards, build_slice, shard_axis, table,
+            versions=versions, shards=shards,
+        )
+    finally:
+        # staging wall time feeds the flight recorder's per-thread
+        # account (prefetch-worker staging is its own concern, not a
+        # query's milliseconds)
+        if not _in_prefetch():
+            tracing.note_stage(seconds=time.perf_counter() - t_stage0)
+
+
+def _stage_inner(
+    key_base: Tuple,
+    n_shards: int,
+    build_slice: Callable[[int, int], object],
+    shard_axis: int,
+    table: Optional[ExtentTable],
+    versions: Optional[Tuple[int, ...]] = None,
+    shards: Optional[Tuple[int, ...]] = None,
+):
     import jax
 
     from pilosa_tpu.parallel import mesh as pmesh

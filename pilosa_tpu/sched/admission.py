@@ -33,6 +33,7 @@ from typing import Callable, Deque, Dict, Optional
 
 from pilosa_tpu.sched.cost import QueryCost, ZERO_COST
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+from pilosa_tpu.utils.stats import Histogram
 
 # Request headers understood by the query routes. Priority selects the
 # class; deadline carries the REMAINING seconds of the sender's budget
@@ -73,12 +74,19 @@ class ShedError(Exception):
 
     Deliberately NOT an ApiError/ExecError subclass — those map to
     4xx/200-with-error payloads on various routes; shedding must surface
-    as a real 429 so server/faults.py classifies it retryable."""
+    as a real 429 so server/faults.py classifies it retryable.
 
-    def __init__(self, msg: str, retry_after: float = 1.0):
+    `trace_id` makes a shed query diagnosable from the client side: the
+    api layer stamps the query's trace id (incoming header or the id the
+    root span would have carried) so the 429 body/header names the exact
+    flight record to look for."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0,
+                 trace_id: str = ""):
         super().__init__(msg)
         self.retry_after = retry_after
         self.status = 429
+        self.trace_id = trace_id
 
 
 class Ticket:
@@ -191,9 +199,15 @@ class AdmissionController:
         # EWMA of per-query service seconds (grant -> release), feeding
         # the early-shed deadline feasibility estimate (per lane: legs
         # run shard subsets, so their service time differs from whole
-        # coordinator queries)
+        # coordinator queries). The EWMA tracks the MEAN — a bimodal mix
+        # (cheap Counts + occasional fat scans) averages to something no
+        # actual query takes — so each lane also keeps a log-bucket
+        # histogram and feasibility uses max(ewma, p95): the principled
+        # tail estimate the flight-recorder histograms provide.
         self._svc_ewma = 0.0
         self._leg_svc_ewma = 0.0
+        self._svc_hist = Histogram()
+        self._leg_svc_hist = Histogram()
         # SEPARATE lane for internal fan-out legs (remote=True): a
         # coordinator holds its own node's slot while it blocks on its
         # legs, and each leg must be admitted on the peer — if legs
@@ -466,6 +480,7 @@ class AdmissionController:
                     if self._leg_svc_ewma <= 0.0
                     else 0.8 * self._leg_svc_ewma + 0.2 * dt
                 )
+                self._leg_svc_hist.observe(dt)
                 self._pump_legs_locked()
                 # freed leg bytes may unblock byte-gated PUBLIC heads
                 self._pump_locked()
@@ -485,6 +500,7 @@ class AdmissionController:
                 if self._svc_ewma <= 0.0
                 else 0.8 * self._svc_ewma + 0.2 * dt
             )
+            self._svc_hist.observe(dt)
             self._pump_locked()
             gauges = self._gauge_values_locked()
             self._cv.notify_all()
@@ -703,29 +719,43 @@ class AdmissionController:
         if granted_any:
             self._cv.notify_all()
 
+    def _svc_estimate_locked(self, ewma: float, hist: Histogram) -> float:
+        """Per-query service estimate for feasibility: the EWMA mean,
+        lifted by the histogram's p95 when the tail runs heavier than
+        the mean (a bimodal cheap/fat mix must not promise the cheap
+        queries' latency to a deadline that will land behind a fat one)."""
+        if hist.count == 0:
+            return ewma
+        return max(ewma, hist.quantile(0.95))
+
     def _deadline_feasible_locked(self, deadline_at: float) -> bool:
         """Can a query joining the back of the queue RIGHT NOW plausibly
         start before `deadline_at`? Uses the learned per-query service
-        EWMA: `ahead` queries drain over max_concurrent lanes, so the
-        expected wait is ~rounds x svc. Conservative on purpose — with
-        no history (ewma 0) every deadline is feasible, and a feasible
-        verdict only means "queue and see" (the in-queue expiry check
-        still sheds a miss); an infeasible verdict sheds immediately so
-        the sender re-maps while it still has deadline budget."""
-        if self._svc_ewma <= 0.0:
+        estimate (EWMA floor-lifted by the service histogram's p95):
+        `ahead` queries drain over max_concurrent lanes, so the expected
+        wait is ~rounds x svc. Conservative on purpose — with no history
+        every deadline is feasible, and a feasible verdict only means
+        "queue and see" (the in-queue expiry check still sheds a miss);
+        an infeasible verdict sheds immediately so the sender re-maps
+        while it still has deadline budget."""
+        svc = self._svc_estimate_locked(self._svc_ewma, self._svc_hist)
+        if svc <= 0.0:
             return True
         ahead = self._queued_total_locked() + self._inflight
         rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
-        return self._clock() + rounds * self._svc_ewma <= deadline_at
+        return self._clock() + rounds * svc <= deadline_at
 
     def _leg_feasible_locked(self, deadline_at: float) -> bool:
         """Leg-lane counterpart of _deadline_feasible_locked, against the
-        leg service EWMA (legs run shard subsets — different timings)."""
-        if self._leg_svc_ewma <= 0.0:
+        leg service estimate (legs run shard subsets — different timings)."""
+        svc = self._svc_estimate_locked(
+            self._leg_svc_ewma, self._leg_svc_hist
+        )
+        if svc <= 0.0:
             return True
         ahead = len(self._leg_waiters) + self._inflight_leg
         rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
-        return self._clock() + rounds * self._leg_svc_ewma <= deadline_at
+        return self._clock() + rounds * svc <= deadline_at
 
     def _gauge_values_locked(self) -> tuple:
         # gauges cover BOTH lanes (like pending()): a node shedding legs

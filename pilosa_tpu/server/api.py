@@ -148,6 +148,7 @@ class API:
         column_attrs: bool = False,
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
+        profile: bool = False,
     ):
         """Execute PQL, with admission control (pilosa_tpu/sched/), a
         trace span, per-query stats and slow-query logging; returns the
@@ -156,14 +157,20 @@ class API:
         api.go:1157).
 
         Admission happens BEFORE the span/stat machinery: a shed query
-        (ShedError -> HTTP 429 + Retry-After) never counts as executed.
-        The priority class comes from the X-Pilosa-Priority header
-        (internal fan-out legs default to the `internal` class) and the
-        remaining deadline from X-Pilosa-Deadline, stamped by the
-        distributed executor so remote nodes shed early instead of
-        timing out late."""
+        (ShedError -> HTTP 429 + Retry-After) never counts as executed —
+        but it DOES carry the trace id the query would have flown under,
+        so a 429 is diagnosable from the client side. The priority class
+        comes from the X-Pilosa-Priority header (internal fan-out legs
+        default to the `internal` class) and the remaining deadline from
+        X-Pilosa-Deadline, stamped by the distributed executor so remote
+        nodes shed early instead of timing out late.
+
+        `profile=True` (the `profile` query option) forces the trace to
+        be sampled and attaches the assembled cross-node trace tree to
+        the response (`QueryResponse.profile`)."""
         import time as _time
 
+        from pilosa_tpu.sched.admission import ShedError
         from pilosa_tpu.utils import tracing
 
         self._validate("query")
@@ -189,19 +196,32 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
         )
-        ticket = self._admit(index, query, shards, remote, headers, opt)
+        # trace context is resolved BEFORE admission: a shed query never
+        # executes, but its 429 must still name the flight record it
+        # would have flown under (satellite: diagnosable sheds)
+        incoming_trace = headers.get(tracing.TRACE_HEADER) if headers else None
+        trace_id = incoming_trace or tracing.new_trace_id()
+        try:
+            ticket = self._admit(index, query, shards, remote, headers, opt)
+        except ShedError as e:
+            if not e.trace_id:
+                e.trace_id = trace_id
+            raise
         # everything from here on runs under the ticket's try/finally —
         # even a failure building the span must release the slot, or the
         # node would bleed concurrency capacity until restart
         try:
             span = (
                 self.server.tracer.start_span_from_headers(
-                    "api.query", headers
+                    "api.query", headers, force=profile
                 )
-                if headers
-                else self.server.tracer.start_span("api.query")
+                if incoming_trace
+                else self.server.tracer.start_span(
+                    "api.query", trace_id=trace_id, force=profile
+                )
             )
             t0 = _time.perf_counter()
+            resp = None
             with span:
                 span.set_tag("index", index)
                 span.set_tag("remote", remote)
@@ -210,6 +230,18 @@ class API:
                     span.set_tag(
                         "sched.wait_ms", round(ticket.waited * 1000.0, 3)
                     )
+                    # admission wait as a first-class stage: it completed
+                    # before this span opened, so assembly clamps it and
+                    # keeps the raw window. Fast-path grants (waited 0)
+                    # record nothing — a zero-length span per query would
+                    # evict real stages from the ring, and the root's
+                    # sched.wait_ms tag already carries the value
+                    if ticket.waited > 0:
+                        tracing.record_span(
+                            "sched.admit",
+                            ticket.waited,
+                            tags={"sched.class": ticket.cls},
+                        )
                 try:
                     # per-query profiling hook: a real cProfile context
                     # only while a /debug/pprof window is open (one
@@ -224,25 +256,68 @@ class API:
                             # adaptive-batching hint before serialization
                             ticket.done_batching()
                         if batched is not None:
-                            return batched
-                        return self.server.executor.execute_response(
-                            index, parsed if parsed is not None else query,
-                            shards=shards, opt=opt,
-                        )
+                            resp = batched
+                        else:
+                            resp = self.server.executor.execute_response(
+                                index, parsed if parsed is not None else query,
+                                shards=shards, opt=opt,
+                            )
                 finally:
                     dt = _time.perf_counter() - t0
+                    span.set_tag("query_ms", round(dt * 1000.0, 3))
                     stats = self.server.stats.with_tags(f"index:{index}")
                     stats.count("query_n")
                     stats.timing("query_ms", dt)
                     lqt = self.server.long_query_time
                     if lqt > 0 and dt > lqt:
-                        self.server.logger(
-                            f"slow query ({dt:.3f}s > {lqt:.3f}s) on "
-                            f"{index!r}: {pql_text[:200]}"
-                        )
+                        self._log_slow_query(index, pql_text, dt, lqt, span)
+            # the root span is finished and recorded here; the remote
+            # legs' spans were ingested during execution, so the ring now
+            # holds the whole trace
+            if profile and resp is not None:
+                resp.profile = self._assemble_trace(span.trace_id or trace_id)
+            return resp
         finally:
             if ticket is not None:
                 ticket.release()
+
+    def _assemble_trace(self, trace_id: str) -> Optional[dict]:
+        """Assembled cross-node trace tree for `trace_id` from this
+        node's ring (best-effort: a swapped-in tracer without spans_for
+        simply yields no profile)."""
+        from pilosa_tpu.utils import tracing
+
+        spans_for = getattr(self.server.tracer, "spans_for", None)
+        if spans_for is None or not trace_id:
+            return None
+        return tracing.assemble(spans_for(trace_id), trace_id)
+
+    def _log_slow_query(
+        self, index: str, pql_text: str, dt: float, lqt: float, span
+    ) -> None:
+        """Slow-query flight record: one line with the trace id and the
+        top stages by self-time — where the milliseconds actually went —
+        instead of the bare PQL echo (reference: LongQueryTime,
+        api.go:1157)."""
+        from pilosa_tpu.utils import tracing
+
+        trace_id = getattr(span, "trace_id", "")
+        stages = ""
+        spans_for = getattr(self.server.tracer, "spans_for", None)
+        if trace_id and spans_for is not None:
+            tops = tracing.top_stages(spans_for(trace_id), trace_id, 5)
+            if tops:
+                stages = "; top stages by self-time: " + ", ".join(
+                    f"{t['name']}"
+                    + (f"({t['peer']})" if t.get("peer") else "")
+                    + (f"@{t['node']}" if t["node"] else "")
+                    + f"={t['selfMs']:.1f}ms"
+                    for t in tops
+                )
+        self.server.logger(
+            f"slow query ({dt:.3f}s > {lqt:.3f}s) on {index!r} "
+            f"trace={trace_id or '-'}: {pql_text[:200]}{stages}"
+        )
 
     def _admit(self, index, query, shards, remote, headers, opt):
         """Admission gate: estimate the query's device cost and block

@@ -44,7 +44,9 @@ class ClientError(Exception):
     `status` (HTTP code or None for connection-level failures),
     `retryable` (may a retry / another replica fix this?), and the peer
     `uri` — so logs and the executor can tell "node down" from "bad
-    request" (ISSUE satellite #1)."""
+    request" (ISSUE satellite #1). `trace_id` (when the peer sent an
+    X-Pilosa-Trace-Id with the error, e.g. a 429 load shed) names the
+    flight record to pull for diagnosis."""
 
     def __init__(
         self,
@@ -53,6 +55,7 @@ class ClientError(Exception):
         retryable: bool = False,
         uri: str = "",
         retry_after: Optional[float] = None,
+        trace_id: str = "",
     ):
         super().__init__(msg)
         self.status = status
@@ -61,6 +64,7 @@ class ClientError(Exception):
         # peer-suggested backoff (the Retry-After on a 429 load shed);
         # the retry loop honors it instead of the policy's base backoff
         self.retry_after = retry_after
+        self.trace_id = trace_id
 
 
 class BreakerOpenError(ClientError):
@@ -125,23 +129,29 @@ class InternalClient:
             detail = e.read().decode("utf-8", "replace")[:500]
             retry_after = None
             raw_ra = None
+            trace_id = ""
             if e.headers:
                 # prefer the precise vendor header (sub-second sheds);
                 # the standard Retry-After is integer delta-seconds
                 raw_ra = e.headers.get("X-Pilosa-Retry-After") or e.headers.get(
                     "Retry-After"
                 )
+                # a shed/error response names its flight record so the
+                # client side can diagnose WHICH query was rejected
+                trace_id = e.headers.get(tracing.TRACE_HEADER) or ""
             if raw_ra:
                 try:
                     retry_after = float(raw_ra)
                 except ValueError:
                     retry_after = None
             err = ClientError(
-                f"{method} {url} -> {e.code}: {detail}",
+                f"{method} {url} -> {e.code}: {detail}"
+                + (f" [trace {trace_id}]" if trace_id else ""),
                 status=e.code,
                 retryable=faults.retryable_status(e.code),
                 uri=uri,
                 retry_after=retry_after,
+                trace_id=trace_id,
             )
         elif isinstance(e, (ssl.SSLCertVerificationError, ssl.CertificateError)) or (
             isinstance(e, urllib.error.URLError)
@@ -182,8 +192,12 @@ class InternalClient:
         if query:
             url += "?" + urllib.parse.urlencode(query)
         # propagate trace context to the peer (reference: http/client.go
-        # wraps every request with tracing.InjectHTTPHeaders)
-        span = tracing.current_span()
+        # wraps every request with tracing.InjectHTTPHeaders). SAMPLED
+        # spans only: an unsampled query must not make peers record and
+        # piggyback spans nobody will assemble (active_span() is None for
+        # unsampled/absent spans, so single-peer and pooled fan-outs
+        # propagate identically)
+        span = tracing.active_span()
         policy = self.retry_policy
         breakers = self._breakers()
         injector = self.fault_injector or faults.global_injector()
@@ -195,6 +209,11 @@ class InternalClient:
             if check_breaker and breakers is not None and not breakers.allow(uri):
                 if self.stats is not None:
                     self.stats.count("internode.breaker_fastfail", 1)
+                if span is not None:
+                    # flight record: this leg never dialed — the peer's
+                    # circuit was open (the breaker outcome tag pairs
+                    # with rpc.retries on the same leg span)
+                    span.set_tag("rpc.breaker_open", True)
                 raise BreakerOpenError(method, uri, path)
             req = urllib.request.Request(url, data=body, method=method)
             if body is not None:
@@ -327,6 +346,11 @@ class InternalClient:
             timeout=timeout,
             headers_fn=hdrs,
         )
+        # cross-node trace assembly: the peer piggybacks the spans it
+        # completed for this trace on the response; fold them into the
+        # active trace's ring so the coordinator can assemble ONE tree
+        if resp.get("spans"):
+            tracing.ingest_spans(resp["spans"])
         if resp.get("error"):
             # remote payload error: the peer is alive and executed the
             # request — failover to a replica cannot fix a bad query

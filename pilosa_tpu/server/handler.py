@@ -172,19 +172,24 @@ class Handler(BaseHTTPRequestHandler):
                     # Retry-After must be RFC 9110 delta-seconds (an
                     # integer) or standard client stacks ignore it; the
                     # precise value rides a vendor header for the
-                    # internode client's sub-second backoff.
+                    # internode client's sub-second backoff. The trace id
+                    # the query would have flown under rides both the
+                    # body and the standard trace header so a shed query
+                    # is diagnosable from the client side.
                     import math
 
-                    self._reply(
-                        {"error": str(e)},
-                        code=429,
-                        extra_headers={
-                            "Retry-After": str(
-                                max(1, math.ceil(e.retry_after))
-                            ),
-                            "X-Pilosa-Retry-After": f"{e.retry_after:g}",
-                        },
-                    )
+                    trace_id = getattr(e, "trace_id", "")
+                    hdrs = {
+                        "Retry-After": str(max(1, math.ceil(e.retry_after))),
+                        "X-Pilosa-Retry-After": f"{e.retry_after:g}",
+                    }
+                    body = {"error": str(e)}
+                    if trace_id:
+                        from pilosa_tpu.utils import tracing as _tracing
+
+                        hdrs[_tracing.TRACE_HEADER] = trace_id
+                        body["traceId"] = trace_id
+                    self._reply(body, code=429, extra_headers=hdrs)
                 except DisabledError as e:
                     self._error(str(e), 503)
                 except (ExecError, ApiError, ParseError, ValueError, KeyError) as e:
@@ -283,6 +288,19 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/debug/traces")
     def get_debug_traces(self):
+        """Flat span ring by default; `?trace=<id>` assembles that
+        trace's spans (local + ingested remote) into ONE tree with
+        clamped windows and per-span self-times — the flight record."""
+        trace_id = self.query.get("trace")
+        if trace_id:
+            from pilosa_tpu.utils import tracing as _tracing
+
+            self._reply(
+                _tracing.assemble(
+                    self.node.tracer.spans_for(trace_id), trace_id
+                )
+            )
+            return
         self._reply(self.node.tracer.to_json())
 
     @route("GET", "/debug/pprof")
@@ -372,10 +390,13 @@ class Handler(BaseHTTPRequestHandler):
             column_attrs=flag("columnAttrs", opts),
             exclude_row_attrs=flag("excludeRowAttrs", opts),
             exclude_columns=flag("excludeColumns", opts),
+            profile=flag("profile", opts),
         )
         out = {"results": [wire.result_to_public_json(r) for r in resp.results]}
         if resp.column_attr_sets is not None:
             out["columnAttrs"] = [s.to_json() for s in resp.column_attr_sets]
+        if resp.profile is not None:
+            out["profile"] = resp.profile
         self._reply(out)
 
     @route("POST", "/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
@@ -453,7 +474,10 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("POST", "/internal/index/(?P<index>[^/]+)/query")
     def post_internal_query(self, index: str):
+        from pilosa_tpu.utils import tracing as _tracing
+
         d = self._json_body()
+        trace_id = self.headers.get(_tracing.TRACE_HEADER)
         try:
             results = self.api.query(
                 index,
@@ -465,7 +489,16 @@ class Handler(BaseHTTPRequestHandler):
         except (ExecError, ApiError) as e:
             self._reply({"error": str(e)})
             return
-        self._reply({"results": [wire.encode_result(r) for r in results]})
+        out = {"results": [wire.encode_result(r) for r in results]}
+        if trace_id:
+            # cross-node trace assembly: piggyback the spans this node
+            # completed for the sender's trace so the coordinator can
+            # assemble ONE tree (the sender dedupes by span id; cap the
+            # payload so a hot trace cannot bloat every leg response)
+            spans = self.node.tracer.spans_for(trace_id)
+            if spans:
+                out["spans"] = spans[-128:]
+        self._reply(out)
 
     @route("POST", "/internal/cluster/message")
     def post_cluster_message(self):

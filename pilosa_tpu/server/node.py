@@ -76,6 +76,9 @@ class NodeServer:
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
         import_concurrency: int = 8,  # parallel replica-import RPCs per call
+        tracing_enabled: bool = True,  # sample root spans at all
+        trace_sample_rate: float = 1.0,  # fraction of root queries traced
+        trace_ring: int = 1024,  # spans kept in the per-node ring
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -192,7 +195,17 @@ class NodeServer:
         self.metric_poll_interval = metric_poll_interval
         from pilosa_tpu.utils import tracing as tracingmod
 
-        self.tracer = tracingmod.global_tracer()
+        # per-NODE tracer ring (not the process global): in-process
+        # multi-node harnesses must exercise REAL cross-node propagation
+        # and piggyback assembly, which a shared ring would fake. With
+        # tracing disabled, root spans never sample — but an incoming
+        # trace header (the sender sampled) and profile=true still record,
+        # so the flight recorder works on demand even at sample-rate 0.
+        self.tracer = tracingmod.Tracer(
+            keep=trace_ring,
+            sample_rate=trace_sample_rate if tracing_enabled else 0.0,
+            node=node_id,
+        )
         # on-demand query profiling window (GET /debug/pprof?seconds=N)
         from pilosa_tpu.server.profiling import QueryProfiler
 

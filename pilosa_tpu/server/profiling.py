@@ -40,14 +40,24 @@ class _QueryProfile:
     def __init__(self, profiler: "QueryProfiler"):
         self._profiler = profiler
         self._prof = cProfile.Profile()
+        self._trace_id = ""
 
     def __enter__(self):
+        # link /debug/pprof and the flight recorder both ways: the
+        # query's span gets the window marker, and the window report
+        # lists the trace ids it profiled
+        from pilosa_tpu.utils import tracing
+
+        span = tracing.active_span()
+        if span is not None:
+            span.set_tag("pprof.window", True)
+            self._trace_id = span.trace_id
         self._prof.enable()
         return self
 
     def __exit__(self, *exc: object) -> None:
         self._prof.disable()
-        self._profiler._collect(self._prof)
+        self._profiler._collect(self._prof, self._trace_id)
 
 
 class QueryProfiler:
@@ -56,6 +66,7 @@ class QueryProfiler:
         self._active = False
         self._profiles: list = []
         self._queries = 0
+        self._trace_ids: list = []
         self._clock = clock
         # set when the node is shutting down so a blocked capture returns
         self._wake = threading.Event()
@@ -68,11 +79,13 @@ class QueryProfiler:
             return nullcontext()
         return _QueryProfile(self)
 
-    def _collect(self, prof: cProfile.Profile) -> None:
+    def _collect(self, prof: cProfile.Profile, trace_id: str = "") -> None:
         with self._mu:
             if self._active:
                 self._profiles.append(prof)
                 self._queries += 1
+                if trace_id and len(self._trace_ids) < 64:
+                    self._trace_ids.append(trace_id)
 
     def capture(self, seconds: float) -> str:
         """Open a window, block for `seconds`, return aggregated pstats
@@ -85,6 +98,7 @@ class QueryProfiler:
                 )
             self._profiles = []
             self._queries = 0
+            self._trace_ids = []
             self._wake.clear()
             self._active = True
         try:
@@ -99,10 +113,16 @@ class QueryProfiler:
                 self._active = False
                 profiles, self._profiles = self._profiles, []
                 queries = self._queries
+                trace_ids, self._trace_ids = self._trace_ids, []
         header = (
             f"pilosa-tpu cProfile capture: {seconds:g}s window, "
             f"{queries} profiled quer{'y' if queries == 1 else 'ies'}\n"
         )
+        if trace_ids:
+            # link to the flight recorder: each id resolves at
+            # /debug/traces?trace=<id> (dedup preserves first-seen order)
+            uniq = list(dict.fromkeys(trace_ids))
+            header += "traces: " + " ".join(uniq) + "\n"
         if not profiles:
             return header + "(no queries executed during the window)\n"
         out = io.StringIO()

@@ -18,14 +18,13 @@ while still feeding the registry so the scrape endpoints keep working.
 
 from __future__ import annotations
 
+import bisect
 import socket
 import time
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from pilosa_tpu.utils.locks import TrackedLock
-
-_HIST_KEEP = 512  # ring buffer per histogram/timing series
 
 # ---------------------------------------------------------------------------
 # Metric-name registry. Every stat name the package emits MUST be declared
@@ -106,6 +105,91 @@ def _key(name: str, tags: Tuple[str, ...]) -> Tuple[str, Tuple[str, ...]]:
     return (name, tuple(sorted(tags)))
 
 
+# ---------------------------------------------------------------------------
+# Histograms. Fixed log-spaced buckets (1 / 2.5 / 5 per decade) replace the
+# old 512-sample ring: bounded memory per series, exact counts/sums forever
+# (a ring forgets everything older than 512 samples — its "p50" was a
+# recency artifact, not a distribution), and a real Prometheus
+# `_bucket`/`_sum`/`_count` exposition whose quantiles any backend can
+# aggregate. The bounds cover sub-ms timings through minutes-long scans
+# and double as sane buckets for sizes (batch size, bytes are observed in
+# the same family).
+# ---------------------------------------------------------------------------
+
+HIST_BOUNDS: Tuple[float, ...] = tuple(
+    m * (10.0 ** e) for e in range(-3, 5) for m in (1.0, 2.5, 5.0)
+)
+
+
+class Histogram:
+    """Fixed log-bucket histogram: counts per bucket plus exact count /
+    sum / min / max. Quantiles interpolate linearly inside the owning
+    bucket and clamp to the observed [min, max], so a constant stream
+    reports that constant, not a bucket edge."""
+
+    __slots__ = ("buckets", "count", "total", "vmin", "vmax")
+
+    def __init__(self) -> None:
+        self.buckets = [0] * (len(HIST_BOUNDS) + 1)  # +1: the +Inf bucket
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.buckets[bisect.bisect_left(HIST_BOUNDS, value)] += 1
+        self.count += 1
+        self.total += value
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]); 0.0 when empty."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        for i, n in enumerate(self.buckets):
+            if n == 0:
+                continue
+            if cum + n >= rank:
+                lo = HIST_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = HIST_BOUNDS[i] if i < len(HIST_BOUNDS) else self.vmax
+                frac = (rank - cum) / n
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return max(self.vmin, min(self.vmax, est))
+            cum += n
+        return self.vmax
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] incl. the +Inf bucket —
+        exactly the Prometheus `_bucket{le=...}` series."""
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for bound, n in zip(HIST_BOUNDS, self.buckets):
+            cum += n
+            out.append((bound, cum))
+        out.append((float("inf"), cum + self.buckets[-1]))
+        return out
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "mean": self.total / self.count,
+            "min": self.vmin,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+            "max": self.vmax,
+        }
+
+
 class Registry:
     """Tagged counters / gauges / histograms / sets, shared by all views."""
 
@@ -113,7 +197,7 @@ class Registry:
         self._mu = TrackedLock("stats.registry_mu")
         self._counters: Dict[Tuple[str, Tuple[str, ...]], float] = defaultdict(float)
         self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
-        self._hists: Dict[Tuple[str, Tuple[str, ...]], List[float]] = defaultdict(list)
+        self._hists: Dict[Tuple[str, Tuple[str, ...]], Histogram] = {}
         self._sets: Dict[Tuple[str, Tuple[str, ...]], set] = defaultdict(set)
 
     def count(self, name, value, tags):
@@ -126,19 +210,29 @@ class Registry:
 
     def observe(self, name, value, tags):
         with self._mu:
-            h = self._hists[_key(name, tags)]
-            h.append(value)
-            if len(h) > _HIST_KEEP:
-                del h[: len(h) - _HIST_KEEP]
+            k = _key(name, tags)
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram()
+            h.observe(value)
 
     def add_to_set(self, name, value, tags):
         with self._mu:
             self._sets[_key(name, tags)].add(value)
 
+    def quantile(self, name: str, q: float, tags: Iterable[str] = ()) -> float:
+        """Estimated quantile of one histogram series (0.0 when the
+        series has never been observed) — the principled tail estimate
+        consumers like the admission controller read."""
+        with self._mu:
+            h = self._hists.get(_key(name, tuple(tags)))
+            return h.quantile(q) if h is not None else 0.0
+
     # -- views -------------------------------------------------------------
 
     def snapshot(self) -> dict:
-        """expvar-style JSON object (served at /debug/vars)."""
+        """expvar-style JSON object (served at /debug/vars). Histogram
+        series render as {count, sum, mean, min, p50, p95, p99, max}."""
 
         def fmt(k):
             name, tags = k
@@ -150,22 +244,22 @@ class Registry:
                 out[fmt(k)] = v
             for k, v in sorted(self._gauges.items()):
                 out[fmt(k)] = v
-            for k, vals in sorted(self._hists.items()):
-                if vals:
-                    s = sorted(vals)
-                    out[fmt(k)] = {
-                        "count": len(s),
-                        "min": s[0],
-                        "p50": s[len(s) // 2],
-                        "max": s[-1],
-                        "mean": sum(s) / len(s),
-                    }
+            for k, h in sorted(self._hists.items()):
+                if h.count:
+                    out[fmt(k)] = h.snapshot()
             for k, members in sorted(self._sets.items()):
                 out[fmt(k)] = len(members)
             return out
 
     def prometheus_text(self, prefix: str = "pilosa_tpu_") -> str:
-        """Prometheus exposition format (served at /metrics)."""
+        """Prometheus exposition format (served at /metrics).
+
+        Families are grouped so each metric name carries exactly ONE
+        `# TYPE` line before all of its series (the spec forbids
+        repeating it per tagged series — tools/prom_lint.py enforces
+        this on the rendered text). Histogram series export real
+        `_bucket{le=...}`/`_sum`/`_count` triplets with cumulative,
+        monotone bucket counts."""
 
         def sanitize(name):
             return prefix + "".join(c if c.isalnum() else "_" for c in name)
@@ -174,37 +268,58 @@ class Registry:
             # label-value escaping per the exposition format spec
             return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
-        def labels(tags):
-            if not tags:
-                return ""
+        def labels(tags, extra: str = ""):
             pairs = []
             for t in tags:
                 k, _, v = t.partition(":")
                 pairs.append(f'{k or "tag"}="{esc(v or k)}"')
+            if extra:
+                pairs.append(extra)
+            if not pairs:
+                return ""
             return "{" + ",".join(pairs) + "}"
 
-        lines = []
+        def fmt_le(bound: float) -> str:
+            if bound == float("inf"):
+                return "+Inf"
+            return f"{bound:g}"
+
+        # family name -> (type, [series lines]); insertion-ordered so the
+        # output stays stable for tests and diffing
+        families: Dict[str, Tuple[str, List[str]]] = {}
+
+        def family(name: str, mtype: str) -> List[str]:
+            m = sanitize(name)
+            got = families.get(m)
+            if got is None:
+                got = families[m] = (mtype, [])
+            return got[1]
+
         with self._mu:
             for (name, tags), v in sorted(self._counters.items()):
                 m = sanitize(name)
-                lines.append(f"# TYPE {m} counter")
-                lines.append(f"{m}{labels(tags)} {v}")
+                family(name, "counter").append(f"{m}{labels(tags)} {v}")
             for (name, tags), v in sorted(self._gauges.items()):
                 m = sanitize(name)
-                lines.append(f"# TYPE {m} gauge")
-                lines.append(f"{m}{labels(tags)} {v}")
-            for (name, tags), vals in sorted(self._hists.items()):
-                if not vals:
+                family(name, "gauge").append(f"{m}{labels(tags)} {v}")
+            for (name, tags), h in sorted(self._hists.items()):
+                if not h.count:
                     continue
                 m = sanitize(name)
-                lines.append(f"# TYPE {m} summary")
-                lines.append(f"{m}_count{labels(tags)} {len(vals)}")
-                lines.append(f"{m}_sum{labels(tags)} {sum(vals)}")
+                lines = family(name, "histogram")
+                for bound, cum in h.cumulative():
+                    le = f'le="{fmt_le(bound)}"'
+                    lines.append(f"{m}_bucket{labels(tags, le)} {cum}")
+                lines.append(f"{m}_sum{labels(tags)} {h.total}")
+                lines.append(f"{m}_count{labels(tags)} {h.count}")
             for (name, tags), members in sorted(self._sets.items()):
                 m = sanitize(name)
-                lines.append(f"# TYPE {m} gauge")
-                lines.append(f"{m}{labels(tags)} {len(members)}")
-        return "\n".join(lines) + "\n"
+                family(name, "gauge").append(f"{m}{labels(tags)} {len(members)}")
+        out: List[str] = []
+        for m, (mtype, lines) in families.items():
+            out.append(f"# TYPE {m} {mtype}")
+            out.extend(lines)
+        return "\n".join(out) + "\n"
 
 
 class StatsClient:

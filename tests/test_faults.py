@@ -469,6 +469,51 @@ def test_write_replica_drop_is_visible():
         assert c[0].holder.pending_repair_count() < before
 
 
+def test_trace_spans_cluster_with_retry_counts_under_fault():
+    """Flight recorder satellite: one Count fan-out on a 3-node cluster
+    produces a SINGLE trace id spanning coordinator + both remotes with
+    parentage intact (remote api.query spans hang off the coordinator's
+    rpc.leg spans), and under an injected transient fault the affected
+    leg span carries its retry count."""
+    with ClusterHarness(3, replica_n=2, in_memory=True, **FAST) as c:
+        api = c[0].api
+        _seed_data(api)
+        inj = faults.FaultInjector(seed=11)
+        # one transient 500 on node1: the leg retries within its budget
+        # (FAST allows 2 attempts) and succeeds without failover
+        inj.add_rule("http500", uri=c[1].node.uri, times=1)
+        c[0].client.fault_injector = inj
+        resp = c[0].api.query_response("ft", "Count(Row(f=0))", profile=True)
+        assert resp.results == [12]
+        prof = resp.profile
+        assert prof is not None and prof["roots"]
+        tid = prof["traceId"]
+        spans = c[0].tracer.spans_for(tid)
+        # ONE trace id covers all three nodes (remote spans piggybacked
+        # back on the internal responses and ingested by the coordinator)
+        assert {s["node"] for s in spans} >= {"node0", "node1", "node2"}
+        by_id = {s["spanId"]: s for s in spans}
+        remote_queries = [
+            s for s in spans
+            if s["name"] == "api.query" and s["node"] != "node0"
+        ]
+        assert remote_queries, "remote nodes recorded no query spans"
+        for s in remote_queries:
+            parent = by_id.get(s["parentId"])
+            assert parent is not None, "remote span parent missing"
+            assert parent["name"] == "rpc.leg"
+            assert parent["node"] == "node0"
+        # the remotes' own ring also holds the same trace (their local
+        # /debug/traces view of the shared trace id)
+        assert c[1].tracer.spans_for(tid) or c[2].tracer.spans_for(tid)
+        # the injected 500 shows up as a retry count on its leg
+        legs = [s for s in spans if s["name"] == "rpc.leg"]
+        assert any(s["tags"].get("rpc.retries", 0) >= 1 for s in legs), (
+            "injected fault must surface as rpc.retries on a leg span"
+        )
+        assert inj.count("http500") == 1
+
+
 def test_query_deadline_bounds_fan_out():
     with ClusterHarness(2, in_memory=True, **FAST) as c:
         api = c[0].api

@@ -325,6 +325,65 @@ class TestApiInvariantsSeeded:
             if f.code == "API001" and "breaker." in f.message
         ]
 
+    def _with_span_registry(self, src: str):
+        """Seeded module + the real tracing.py (for its SPAN_NAMES)."""
+        tracing_path = os.path.join(
+            REPO, "pilosa_tpu", "utils", "tracing.py"
+        )
+        tracing_mod = analysis.load_source_module(
+            tracing_path, rel="pilosa_tpu/utils/tracing.py"
+        )
+        return analysis.run_passes(
+            [analysis.ApiInvariantsPass()],
+            [tracing_mod, seeded_module("pilosa_tpu/_seeded.py", src)],
+        )
+
+    def test_undeclared_span_start(self):
+        fs = self._with_span_registry(
+            """
+            class C:
+                def f(self):
+                    with self.tracer.start_span("mystery.stage"):
+                        pass
+            """
+        )
+        assert any(
+            f.code == "API006" and "mystery.stage" in f.message for f in fs
+        )
+
+    def test_undeclared_synthetic_span(self):
+        fs = self._with_span_registry(
+            """
+            from pilosa_tpu.utils import tracing
+
+            def f():
+                tracing.record_span("rogue.synthetic", 0.1)
+            """
+        )
+        assert any(
+            f.code == "API006" and "rogue.synthetic" in f.message
+            for f in fs
+        )
+
+    def test_declared_span_ok_and_stale_entry_flagged(self):
+        fs = self._with_span_registry(
+            """
+            class C:
+                def f(self):
+                    with self.tracer.start_span("api.query"):
+                        pass
+            """
+        )
+        assert not [
+            f
+            for f in fs
+            if f.code == "API006" and "api.query" in f.message
+        ]
+        # nothing in the seeded set starts exec.dispatch -> stale entry
+        assert any(
+            f.code == "API007" and "exec.dispatch" in f.message for f in fs
+        )
+
     def test_config_flag_doc_invariants(self, tmp_path):
         config_src = textwrap.dedent(
             """

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Tier-1-adjacent metrics smoke: boot a real node, drive traffic over
+HTTP, scrape /metrics, and lint the Prometheus exposition
+(tools/prom_lint.py — TYPE-once, histogram bucket monotonicity, every
+rendered family declared in STAT_NAMES). Exits non-zero on any finding.
+
+Run by .github/workflows/ci.yml alongside tools/check.py; runnable
+locally with `JAX_PLATFORMS=cpu python tools/metrics_smoke.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from pilosa_tpu.utils.cpuonly import force_cpu  # noqa: E402
+
+force_cpu(2)
+
+from pilosa_tpu.server.node import NodeServer  # noqa: E402
+from tools.prom_lint import lint_against_registry  # noqa: E402
+
+
+def main() -> int:
+    srv = NodeServer(None, "smoke0", metric_poll_interval=0.0).start()
+    try:
+        uri = srv.node.uri
+        srv.api.create_index("smoke")
+        srv.api.create_field("smoke", "f", {"type": "set"})
+        # traffic that exercises counters, gauges, and the query_ms /
+        # ingest timing histograms — over real HTTP, like production
+        body = json.dumps({"query": "Set(1, f=1) Set(2, f=1)"}).encode()
+        req = urllib.request.Request(
+            f"{uri}/index/smoke/query", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=30).read()
+        for _ in range(3):
+            req = urllib.request.Request(
+                f"{uri}/index/smoke/query",
+                data=json.dumps({"query": "Count(Row(f=1))"}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert resp["results"] == [2], resp
+        with urllib.request.urlopen(f"{uri}/metrics", timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        srv.stop()
+    errors = lint_against_registry(text)
+    for e in errors:
+        print(f"metrics-smoke: {e}")
+    # the smoke must actually have produced the histogram the dashboards
+    # and the admission tail estimate depend on
+    if "pilosa_tpu_query_ms_bucket" not in text:
+        errors.append("query_ms histogram missing from /metrics")
+        print("metrics-smoke: query_ms histogram missing from /metrics")
+    if not errors:
+        print(
+            "metrics-smoke: OK "
+            f"({sum(1 for ln in text.splitlines() if ln and not ln.startswith('#'))} samples linted)"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

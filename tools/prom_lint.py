@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Prometheus text-exposition linter for the /metrics endpoint.
+
+Checks the rendered text (not the renderer), so a regression anywhere in
+the registry -> exposition path is caught:
+
+* every sample line parses and belongs to a family with exactly ONE
+  `# TYPE` declaration, placed before the family's first sample;
+* histogram families carry `_bucket`/`_sum`/`_count` series whose
+  bucket counts are cumulative and monotone over ascending `le` bounds,
+  end in an `+Inf` bucket, and whose `+Inf` count equals `_count`;
+* every family maps back to a name declared in `utils/stats.py`
+  STAT_NAMES (or a STAT_PREFIXES dynamic family) — a rendered metric
+  nothing declared is exactly the silent dashboard rot the registry
+  exists to prevent.
+
+`lint(text)` returns a list of error strings (empty = clean); the CLI
+reads a file or stdin and exits non-zero on findings. Used by
+tools/metrics_smoke.py in CI and by the tier-1 flight-recorder tests.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)\s*$"
+)
+_LE_RE = re.compile(r'(?:^|,)le="(?P<le>[^"]+)"')
+
+_VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _family_of(sample_name: str, histogram_families: set) -> str:
+    """Strip the _bucket/_sum/_count suffix when the base is a declared
+    histogram family."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in histogram_families:
+                return base
+    return sample_name
+
+
+def _strip_le(labels: Optional[str]) -> str:
+    if not labels:
+        return ""
+    return ",".join(
+        p for p in labels.split(",") if not p.startswith("le=")
+    )
+
+
+def lint(
+    text: str,
+    declared: Optional[set] = None,
+    declared_prefixes: Optional[set] = None,
+    prefix: str = "pilosa_tpu_",
+) -> List[str]:
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    first_sample_seen: set = set()
+    histogram_families = set()
+    # histogram family -> {series labels (sans le): [(le, count)]}
+    buckets: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
+    counts: Dict[Tuple[str, str], float] = {}
+    sums: set = set()
+
+    for ln, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                errors.append(f"line {ln}: malformed TYPE line: {line!r}")
+                continue
+            _, _, name, mtype = parts
+            if mtype not in _VALID_TYPES:
+                errors.append(f"line {ln}: unknown metric type {mtype!r}")
+            if name in types:
+                errors.append(
+                    f"line {ln}: duplicate TYPE declaration for {name!r}"
+                )
+            if name in first_sample_seen:
+                errors.append(
+                    f"line {ln}: TYPE for {name!r} appears after its "
+                    "first sample"
+                )
+            types[name] = mtype
+            if mtype == "histogram":
+                histogram_families.add(name)
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {ln}: unparseable sample line: {line!r}")
+            continue
+        name = m.group("name")
+        labels = m.group("labels")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            errors.append(f"line {ln}: non-numeric value in: {line!r}")
+            continue
+        family = _family_of(name, histogram_families)
+        first_sample_seen.add(family)
+        if family not in types:
+            errors.append(
+                f"line {ln}: sample {name!r} has no preceding TYPE "
+                "declaration"
+            )
+            continue
+        if types[family] == "histogram":
+            series = _strip_le(labels)
+            if name.endswith("_bucket"):
+                le_m = _LE_RE.search(labels or "")
+                if le_m is None:
+                    errors.append(
+                        f"line {ln}: histogram bucket without le label"
+                    )
+                    continue
+                raw_le = le_m.group("le")
+                le = float("inf") if raw_le == "+Inf" else float(raw_le)
+                buckets.setdefault((family, series), []).append((le, value))
+            elif name.endswith("_count"):
+                counts[(family, series)] = value
+            elif name.endswith("_sum"):
+                sums.add((family, series))
+            else:
+                errors.append(
+                    f"line {ln}: bare sample {name!r} inside histogram "
+                    f"family {family!r}"
+                )
+
+    for (family, series), entries in buckets.items():
+        label = f"{family}{{{series}}}" if series else family
+        les = [le for le, _ in entries]
+        if les != sorted(les):
+            errors.append(f"{label}: bucket le bounds not ascending")
+        vals = [v for _, v in entries]
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            errors.append(f"{label}: bucket counts not monotone (not cumulative)")
+        if not les or les[-1] != float("inf"):
+            errors.append(f"{label}: missing +Inf bucket")
+        else:
+            total = counts.get((family, series))
+            if total is None:
+                errors.append(f"{label}: histogram without _count series")
+            elif vals[-1] != total:
+                errors.append(
+                    f"{label}: +Inf bucket {vals[-1]} != _count {total}"
+                )
+        if (family, series) not in sums:
+            errors.append(f"{label}: histogram without _sum series")
+
+    if declared is not None:
+        def sanitize(n: str) -> str:
+            return prefix + "".join(c if c.isalnum() else "_" for c in n)
+
+        allowed = {sanitize(n) for n in declared}
+        allowed_prefixes = tuple(
+            sanitize(p) for p in (declared_prefixes or ())
+        )
+        for family in types:
+            if family in allowed or family.startswith(allowed_prefixes):
+                continue
+            errors.append(
+                f"{family}: rendered but not declared in STAT_NAMES / "
+                "STAT_PREFIXES"
+            )
+    return errors
+
+
+def lint_against_registry(text: str) -> List[str]:
+    """lint() against the package's own declared metric names."""
+    from pilosa_tpu.utils.stats import STAT_NAMES, STAT_PREFIXES
+
+    return lint(
+        text, declared=set(STAT_NAMES), declared_prefixes=set(STAT_PREFIXES)
+    )
+
+
+def main(argv: List[str]) -> int:
+    data = (
+        open(argv[0], encoding="utf-8").read()
+        if argv
+        else sys.stdin.read()
+    )
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    errors = lint_against_registry(data)
+    for e in errors:
+        print(f"prom-lint: {e}")
+    if not errors:
+        print("prom-lint: clean")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
