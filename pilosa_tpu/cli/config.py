@@ -67,6 +67,17 @@ class HbmConfig:
 
 
 @dataclass
+class ResizeConfig:
+    # live elastic resize (streaming resharding under traffic;
+    # docs/configuration.md "Elastic resize"): moving fragments stream as
+    # snapshot + live write capture while the old topology keeps serving;
+    # writes are never globally frozen
+    transfer_concurrency: int = 4  # parallel fragment fetches per node
+    cutover_timeout: float = 30.0  # catch-up barrier wall bound, seconds
+    resume_policy: str = "resume"  # resume | abort on a failed stream leg
+
+
+@dataclass
 class AntiEntropyConfig:
     interval: float = 0.0  # seconds; 0 disables the loop
 
@@ -120,6 +131,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
+    resize: ResizeConfig = field(default_factory=ResizeConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -194,6 +206,7 @@ class Config:
             ("cluster", self.cluster),
             ("sched", self.sched),
             ("hbm", self.hbm),
+            ("resize", self.resize),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
             ("tracing", self.tracing),

@@ -138,6 +138,22 @@ def _build_parser() -> argparse.ArgumentParser:
         "batches ship to their owner nodes on a pool this wide)",
     )
     sp.add_argument(
+        "--resize-transfer-concurrency", type=int,
+        help="parallel fragment transfer legs per node during a "
+        "streaming resize",
+    )
+    sp.add_argument(
+        "--resize-cutover-timeout", type=float,
+        help="wall-clock bound on a resize step's delta catch-up barrier, "
+        "seconds",
+    )
+    sp.add_argument(
+        "--resize-resume-policy", choices=["resume", "abort"],
+        help="on a failed resize transfer leg: 'resume' retries once from "
+        "the per-fragment transfer ledger, 'abort' rolls the job back "
+        "immediately",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -216,6 +232,9 @@ _FLAG_KNOBS = {
     "hbm_extent_rows": ("hbm", "extent_rows"),
     "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
     "hbm_pin_timeout": ("hbm", "pin_timeout"),
+    "resize_transfer_concurrency": ("resize", "transfer_concurrency"),
+    "resize_cutover_timeout": ("resize", "cutover_timeout"),
+    "resize_resume_policy": ("resize", "resume_policy"),
     "anti_entropy_interval": ("anti_entropy", "interval"),
     "metric_service": ("metric", "service"),
     "metric_host": ("metric", "host"),
@@ -352,6 +371,9 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,
         import_concurrency=cfg.import_concurrency,
+        resize_transfer_concurrency=cfg.resize.transfer_concurrency,
+        resize_cutover_timeout=cfg.resize.cutover_timeout,
+        resize_resume_policy=cfg.resize.resume_policy,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

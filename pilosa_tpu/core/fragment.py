@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
 from itertools import repeat
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -46,6 +47,29 @@ DEFAULT_MAX_OP_N = 10_000
 BSI_EXISTS_BIT = 0
 BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
+
+# Live-transfer write capture (streaming resize): a capture that grows past
+# this many positions is dropped and marked LOST — the destination refetches
+# the full snapshot instead of this node buffering an unbounded delta for a
+# transfer whose driver may have died.
+CAPTURE_MAX_POSITIONS = 1 << 22  # ~32 MB of uint64 positions
+
+
+class TransferCaptureLost(Exception):
+    """The write capture backing an in-flight fragment transfer is gone
+    (overflowed, replaced wholesale, or never started): the destination
+    must restart from a fresh full snapshot (HTTP 410 on the delta
+    endpoint), not treat the delta stream as complete."""
+
+
+class TransferCutover(Exception):
+    """This fragment is inside its resize-cutover write barrier: the
+    coordinator quiesced it so the final capture drain is provably
+    complete before the topology install. Writes are rejected with a
+    retryable error (HTTP 503 + Retry-After) for the barrier's bounded
+    window — the internode retry plane re-maps and lands them on the
+    post-cutover owner."""
+
 
 # Lazy host snapshot tier: fragments open by indexing the snapshot headers
 # only, materializing RowBits from seek-reads on first access — holder
@@ -250,6 +274,21 @@ class Fragment:
         # optional owner hook fired after any mutation (the View registers
         # one to drop its cross-shard stacks covering this fragment)
         self.on_mutate = None
+        # Live-transfer write captures (streaming resize): while transfers
+        # are in flight, every mutation funnel appends its records to each
+        # armed capture (the same (op, positions) shape the WAL frames) so
+        # destinations can replay exactly the writes that landed after
+        # their snapshots. NAMED per transfer tag: at replica_n > 1 two
+        # destinations stream the same source fragment concurrently, and
+        # each must see the full delta — a shared buffer would let one
+        # drain steal records the other never gets.
+        self._captures: Dict[str, List[Tuple[int, np.ndarray]]] = {}
+        self._capture_ns: Dict[str, int] = {}
+        self._captures_lost: set = set()
+        # resize-cutover write barrier: monotonic deadline; 0 = open. The
+        # deadline (not a bool) makes the barrier self-expiring, so a lost
+        # resize-release can never block a fragment's writes forever.
+        self._write_block_until = 0.0
         self._open = False
 
     # ------------------------------------------------------------------
@@ -580,6 +619,7 @@ class Fragment:
         one append per import call: set+clear land as one write+flush
         instead of interleaving two syscall round-trips with the apply."""
         with self._mu:
+            self._check_write_block_locked()
             self._sync_locked()
             records = []
             if to_set is not None and len(to_set):
@@ -588,6 +628,8 @@ class Fragment:
                 records.append((walmod.OP_CLEAR, to_clear))
             if records and self._wal is not None:
                 self._wal.append_many(records)
+            for op, positions in records:
+                self._capture_record(op, positions)
             n_set, n_clear = self._apply_positions(
                 to_set if to_set is not None else np.empty(0, np.uint64),
                 to_clear if to_clear is not None else np.empty(0, np.uint64),
@@ -622,7 +664,9 @@ class Fragment:
         if not n:
             return 0
         with self._mu:
+            self._check_write_block_locked()
             self._wal_append(walmod.OP_SET, positions)
+            self._capture_record(walmod.OP_SET, positions)
             self._pending.append(positions)
             self._pending_n += n
             self._op_n += n
@@ -861,12 +905,15 @@ class Fragment:
                 f"import_row_words: want shape ({SHARD_WIDTH // 32},), got {words.shape}"
             )
         with self._mu:
+            self._check_write_block_locked()
             self._sync_locked()
-            if self._wal is not None:
+            if self._wal is not None or self._captures:
                 payload = np.empty(1 + words.nbytes // 8, np.uint64)
                 payload[0] = row_id
                 payload[1:] = words.view(np.uint64)
-                self._wal.append(walmod.OP_ROW_WORDS, payload)
+                if self._wal is not None:
+                    self._wal.append(walmod.OP_ROW_WORDS, payload)
+                self._capture_record(walmod.OP_ROW_WORDS, payload)
             added = self._apply_row_words(row_id, words)
             self._op_n += added
             if self._op_n > self.max_op_n:
@@ -952,6 +999,7 @@ class Fragment:
             idx = len(cols) - 1 - last_idx
             to_set = []
             to_clear = []
+            updates = {}
             for i in idx:
                 col, row = int(cols[i]), int(row_ids[i])
                 existing = self._mutex_map.get(col)
@@ -960,11 +1008,16 @@ class Fragment:
                 if existing is not None:
                     to_clear.append(existing * SHARD_WIDTH + col)
                 to_set.append(row * SHARD_WIDTH + col)
-                self._mutex_map[col] = row
+                updates[col] = row
             n, _ = self.import_positions(
                 np.array(to_set, np.uint64) if to_set else None,
                 np.array(to_clear, np.uint64) if to_clear else None,
             )
+            # map update only after the bits landed: import_positions can
+            # raise TransferCutover (resize write barrier) and the caller
+            # retries the whole batch — a pre-updated map would make the
+            # retry a no-op (existing == row) and silently drop the write
+            self._mutex_map.update(updates)
             return n
 
     # ------------------------------------------------------------------
@@ -1236,6 +1289,159 @@ class Fragment:
             walmod.write_snapshot_stream(buf, self.shard, SHARD_WIDTH, self._rows)
             return buf.getvalue()
 
+    # -- live-transfer write capture (streaming resize) ----------------
+
+    def begin_streaming(self, tag: str = "default") -> bytes:
+        """Phase 1 of a live fragment transfer: serialize the full row
+        store AND, atomically under the same lock hold, arm the `tag`
+        capture for every subsequent mutation — so the snapshot plus the
+        captured delta is exactly this fragment's state at any later
+        drain point. The fragment keeps serving reads and accepting
+        writes throughout. Captures are independent per tag (one per
+        destination transfer leg); re-beginning a tag replaces that
+        tag's capture only (idempotent refetch)."""
+        import io
+
+        with self._mu:
+            self._sync_locked()
+            buf = io.BytesIO()
+            walmod.write_snapshot_stream(buf, self.shard, SHARD_WIDTH, self._rows)
+            self._captures[tag] = []
+            self._capture_ns[tag] = 0
+            self._captures_lost.discard(tag)
+            return buf.getvalue()
+
+    def drain_capture(self, tag: str = "default") -> bytes:
+        """Phase 2: pop one tag's captured write records as one WAL-framed
+        byte stream (the read barrier — concurrent writers to THIS
+        fragment block only for the pop). The capture stays armed, so
+        repeated drains stream catch-up rounds until the delta runs dry.
+        Raises TransferCaptureLost when there is nothing to resume from."""
+        with self._mu:
+            records = self._captures.get(tag)
+            if records is None:
+                raise TransferCaptureLost(
+                    f"{self.index}/{self.field}/{self.view}/{self.shard}: "
+                    + ("write capture overflowed"
+                       if tag in self._captures_lost
+                       else "no active write capture")
+                )
+            self._captures[tag] = []
+            self._capture_ns[tag] = 0
+            return walmod.encode_records(records)
+
+    def end_capture(self, tag: Optional[str] = None) -> None:
+        """Stop capturing for `tag` (cutover complete, or transfer
+        abandoned); None ends every capture. Once the last capture is
+        gone the cutover write barrier (if any) lifts with it — no
+        transfer can still depend on a frozen delta."""
+        with self._mu:
+            if tag is None:
+                self._captures.clear()
+                self._capture_ns.clear()
+                self._captures_lost.clear()
+            else:
+                self._captures.pop(tag, None)
+                self._capture_ns.pop(tag, None)
+                self._captures_lost.discard(tag)
+            if not self._captures:
+                self._write_block_until = 0.0
+
+    def block_writes(self, ttl: float) -> None:
+        """Arm the cutover write barrier for `ttl` seconds: every mutation
+        funnel raises TransferCutover until the barrier lifts (release,
+        end of captures, or deadline expiry). Reads keep serving."""
+        with self._mu:
+            self._write_block_until = time.monotonic() + max(ttl, 0.0)
+
+    def unblock_writes(self) -> None:
+        with self._mu:
+            self._write_block_until = 0.0
+
+    def _check_write_block_locked(self) -> None:
+        # called under self._mu at the top of every mutation funnel
+        if not self._write_block_until:
+            return
+        if time.monotonic() >= self._write_block_until:
+            self._write_block_until = 0.0  # lost release; self-heal
+            return
+        raise TransferCutover(
+            f"{self.index}/{self.field}/{self.view}/{self.shard}: "
+            "resize cutover in progress, retry"
+        )
+
+    def _capture_record(self, op: int, positions: np.ndarray) -> None:
+        # called under self._mu by every mutation funnel
+        if not self._captures:
+            return
+        for tag in list(self._captures):
+            self._captures[tag].append((op, positions))
+            n = self._capture_ns[tag] + len(positions)
+            if n > CAPTURE_MAX_POSITIONS:
+                # unbounded buffering is worse than a refetch: drop this
+                # tag's capture and make its next drain signal "restart
+                # from a fresh snapshot"
+                del self._captures[tag]
+                del self._capture_ns[tag]
+                self._captures_lost.add(tag)
+            else:
+                self._capture_ns[tag] = n
+
+    def apply_transfer_records(self, data: bytes) -> int:
+        """Destination-side delta replay: apply a drain_capture() byte
+        stream through the normal exact write funnels (WAL-framed and
+        device-invalidated like any other write). The whole stream is
+        decoded BEFORE the first record applies: decode_records is strict,
+        and materializing up front is what actually honors its torn-wire
+        contract — a ValueError mid-iteration after a partial apply would
+        leave this fragment holding an un-resumable prefix. Returns
+        positions applied."""
+        records = list(walmod.decode_records(data))
+        n = 0
+        for op, positions in records:
+            if op == walmod.OP_ROW_WORDS:
+                words = np.ascontiguousarray(positions[1:]).view(np.uint32)
+                self.import_row_words(int(positions[0]), words)
+                # count set BITS, not payload words: `n` feeds
+                # resize.delta_positions and the job's deltas counter,
+                # documented as write positions — a whole-row union
+                # record would otherwise add 1 + words_per_row
+                # regardless of how many bits the row carries
+                n += int(np.unpackbits(words.view(np.uint8)).sum())
+            else:
+                if op == walmod.OP_SET:
+                    self.import_positions(positions, None)
+                else:
+                    self.import_positions(None, positions)
+                n += len(positions)
+        return n
+
+    def merge_from_bytes(self, data: bytes) -> int:
+        """Union a snapshot stream INTO this fragment instead of replacing
+        it — the post-commit resize sweep uses this when the destination
+        fragment already exists (post-cutover writes created it), where
+        from_bytes' wholesale replace would erase those acknowledged
+        writes. Rides import_row_words, so every merged row is WAL-framed
+        and device-invalidated like any other write. Returns bits newly
+        set."""
+        import io
+
+        shard, n_bits, rows = walmod.read_snapshot_stream(io.BytesIO(data))
+        if shard != self.shard:
+            raise ValueError(
+                f"fragment stream is for shard {shard}, not {self.shard}"
+            )
+        if n_bits != SHARD_WIDTH:
+            raise ValueError(
+                f"fragment stream shard width {n_bits} != local {SHARD_WIDTH}"
+            )
+        added = 0
+        for row_id, rb in rows.items():
+            words = np.array(rb.to_words(), dtype=np.uint32)
+            if words.any():
+                added += self.import_row_words(row_id, words)
+        return added
+
     def from_bytes(self, data: bytes) -> None:
         """Replace this fragment's contents from to_bytes() output
         (reference: fragment.go:2527 ReadFrom)."""
@@ -1256,6 +1462,13 @@ class Fragment:
             # else, so they must not merge into the new rows
             self._pending = []
             self._pending_n = 0
+            if self._captures:
+                # a wholesale replace invalidates every in-flight
+                # transfer's snapshot+delta contract: force peers to
+                # refetch
+                self._captures_lost.update(self._captures)
+                self._captures.clear()
+                self._capture_ns.clear()
             self._rows = rows
             DEVICE_CACHE.invalidate_owner(self._token)
             DEVICE_CACHE.invalidate_owner(self._stack_token)

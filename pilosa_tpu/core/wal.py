@@ -214,18 +214,9 @@ class WalWriter:
         with the apply. Each record keeps its own CRC, so replay-side
         torn-tail handling is unchanged (the batch just tears as a unit
         or between records)."""
-        bufs = []
-        for op, positions in records:
-            payload = np.asarray(positions, dtype=np.uint64).tobytes()
-            bufs.append(
-                _REC_HDR.pack(
-                    WAL_MAGIC, op, len(positions), zlib.crc32(payload)
-                )
-            )
-            bufs.append(payload)
-        if not bufs:
+        data = encode_records(records)
+        if not data:
             return
-        data = b"".join(bufs)
         with self._pin() as f:
             f.write(data)
             f.flush()
@@ -243,6 +234,48 @@ class WalWriter:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+
+
+def encode_records(records) -> bytes:
+    """Frame a batch of (op, positions) records with the WAL record codec
+    into one byte string. This is also the WIRE format live-resize delta
+    shipping uses (core/fragment.py drain_capture -> apply_transfer_records):
+    both ends share the on-disk log's CRC framing, so there is exactly one
+    record codec to keep correct."""
+    bufs = []
+    for op, positions in records:
+        payload = np.asarray(positions, dtype=np.uint64).tobytes()
+        bufs.append(
+            _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))
+        )
+        bufs.append(payload)
+    return b"".join(bufs)
+
+
+def decode_records(data: bytes) -> Iterator[Tuple[int, np.ndarray]]:
+    """Inverse of encode_records. STRICT, unlike on-disk replay: a torn
+    network transfer must fail the transfer leg loudly (the client retries
+    it), never silently apply a prefix of the delta — on disk a torn tail
+    is the expected kill-9 artifact, on the wire it is data loss."""
+    pos = 0
+    n_total = len(data)
+    while pos < n_total:
+        if pos + _REC_HDR.size > n_total:
+            raise ValueError("truncated delta stream: partial record header")
+        magic, op, n, crc = _REC_HDR.unpack_from(data, pos)
+        pos += _REC_HDR.size
+        if magic != WAL_MAGIC:
+            raise ValueError(
+                f"bad delta record magic at offset {pos - _REC_HDR.size}"
+            )
+        end = pos + n * 8
+        if end > n_total:
+            raise ValueError("truncated delta stream: partial payload")
+        payload = data[pos:end]
+        if zlib.crc32(payload) != crc:
+            raise ValueError(f"delta record CRC mismatch at offset {pos}")
+        yield op, np.frombuffer(payload, dtype=np.uint64)
+        pos = end
 
 
 def replay_wal(path: str) -> Iterator[Tuple[int, np.ndarray]]:

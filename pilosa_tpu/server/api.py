@@ -1122,6 +1122,25 @@ class API:
             # post-resize GC (holder.go:1126 CleanHolder): drop fragments
             # the current topology no longer assigns to this node
             self.server.clean_holder()
+        elif t == "resize-quiesce":
+            # cutover write barrier: sources stop accepting writes to
+            # fragments with armed captures for this job (503 retryable),
+            # so the coordinator's final drain provably runs dry before
+            # the topology install. Required-ack: a ClientError on this
+            # send aborts the job pre-commit.
+            self.server.quiesce_job_captures(
+                msg.get("job", ""), float(msg.get("ttl", 30.0))
+            )
+        elif t == "resize-release":
+            # streaming-resize normal completion: end this job's write
+            # captures and drop the transfer ledger (fragments stay — the
+            # cutover committed them)
+            self.server.release_job_captures(msg.get("job"))
+        elif t == "resize-cleanup":
+            # streaming-resize abort: delete fragments this job's
+            # transfers created here and release captures — pre-resize
+            # topology, debt, and device residency are fully restored
+            self.server.resize_cleanup(msg.get("job", ""), aborting=True)
         else:
             raise ApiError(f"unknown cluster message type {t!r}")
         return {"ok": True}

@@ -179,6 +179,7 @@ class InternalClient:
         headers: Optional[Dict[str, str]] = None,
         headers_fn=None,
         check_breaker: bool = True,
+        max_attempts: Optional[int] = None,
     ) -> bytes:
         """One logical RPC: up to `retry_policy.max_attempts` attempts
         within a `timeout` (default `self.timeout`) TOTAL budget, backoff
@@ -187,7 +188,11 @@ class InternalClient:
         a shunned peer so it can recover). `headers_fn(remaining)` is
         re-evaluated per attempt with the budget's remaining seconds, so
         budget-derived headers (X-Pilosa-Deadline) shrink across retries
-        instead of overstating the sender's patience."""
+        instead of overstating the sender's patience. `max_attempts`
+        overrides the policy's attempt cap for NON-idempotent verbs
+        (e.g. the resize delta drain pops server-side state: a retried
+        request cannot recover a response lost on the wire, so its
+        caller handles recovery instead)."""
         url = uri.rstrip("/") + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -278,7 +283,10 @@ class InternalClient:
                     # (non-retryables without a status — e.g. cert
                     # verification — prove nothing about liveness)
                     breakers.record_neutral(uri)
-            if not err.retryable or attempts >= policy.max_attempts:
+            attempts_cap = (
+                max_attempts if max_attempts is not None else policy.max_attempts
+            )
+            if not err.retryable or attempts >= attempts_cap:
                 raise err
             delay = policy.backoff(attempts)
             if err.retry_after is not None:
@@ -442,6 +450,75 @@ class InternalClient:
             timeout=timeout,
         ) or {}
 
+    def resize_stream(
+        self,
+        uri: str,
+        job: str,
+        nodes: List[dict],
+        old_nodes: Optional[List[dict]] = None,
+        replica_n: Optional[int] = None,
+        old_replica_n: Optional[int] = None,
+        schema: Optional[List[dict]] = None,
+        timeout: float = 600.0,
+        post_commit: bool = False,
+    ) -> dict:
+        """Order one node through its STREAMING resize step (phase 1 +
+        catch-up rounds of every fragment the new placement assigns it;
+        the node keeps serving against the old topology throughout).
+        Idempotent-resumable: the destination's per-job transfer ledger
+        skips snapshots that already landed, so the retry plane (5xx are
+        retryable) and the coordinator's resume policy can both re-issue
+        this safely. post_commit=True is the coordinator's final sweep:
+        fetch-only-new, no captures, merge into existing fragments."""
+        body: Dict[str, Any] = {"job": job, "nodes": nodes}
+        if old_nodes is not None:
+            body["oldNodes"] = old_nodes
+        if replica_n is not None:
+            body["replicaN"] = replica_n
+        if old_replica_n is not None:
+            body["oldReplicaN"] = old_replica_n
+        if schema is not None:
+            body["schema"] = schema
+        if post_commit:
+            body["postCommit"] = True
+        return self._json(
+            "POST", uri, "/internal/resize/stream",
+            json.dumps(body).encode(), timeout=timeout,
+        ) or {}
+
+    def resize_catchup(self, uri: str, job: str, timeout: float = 120.0) -> dict:
+        """One post-cutover drain round on a destination node (replays
+        writes that raced the topology install on the old owners)."""
+        return self._json(
+            "POST", uri, "/internal/resize/catchup",
+            json.dumps({"job": job}).encode(), timeout=timeout,
+        ) or {}
+
+    def fragment_delta(
+        self, uri: str, index: str, field: str, view: str, shard: int, job: str
+    ) -> bytes:
+        """Drain one transfer leg's captured writes (WAL-framed bytes).
+        SINGLE-attempt on purpose: the drain pops the source's capture,
+        so a retry after a lost response would silently skip the popped
+        records — the caller treats a transport failure as ambiguous
+        and refetches the full snapshot (NodeServer._drain_or_refetch).
+        410 (capture lost) likewise routes to a refetch. The one
+        exception is a 429 admission shed, raised provably BEFORE the
+        pop: the caller retries that in place instead of refetching."""
+        return self._do(
+            "GET",
+            uri,
+            "/internal/fragment/delta",
+            query={
+                "index": index,
+                "field": field,
+                "view": view,
+                "shard": shard,
+                "job": job,
+            },
+            max_attempts=1,
+        )
+
     def join_cluster(self, coordinator_uri: str, node: dict) -> dict:
         """Ask the coordinator to admit a node (reference: gossip nodeJoin,
         cluster.go:1796; here an explicit HTTP join per the static-mesh
@@ -590,14 +667,23 @@ class InternalClient:
     # -- fragment streaming for resize (http/client.go:742) ----------------
 
     def retrieve_fragment(
-        self, uri: str, index: str, field: str, view: str, shard: int
+        self,
+        uri: str,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        capture: Optional[str] = None,
     ) -> bytes:
-        return self._do(
-            "GET",
-            uri,
-            "/internal/fragment/data",
-            query={"index": index, "field": field, "view": view, "shard": shard},
-        )
+        """Full-fragment snapshot. `capture=<job id>` makes the source arm
+        a live write capture atomically with the snapshot (streaming
+        resize phase 1); drain it with fragment_delta."""
+        query: Dict[str, Any] = {
+            "index": index, "field": field, "view": view, "shard": shard,
+        }
+        if capture:
+            query["capture"] = capture
+        return self._do("GET", uri, "/internal/fragment/data", query=query)
 
     # -- translate replication (http/translator.go:44) ---------------------
 

@@ -337,7 +337,19 @@ class FaultInjector:
     of an unlimited refuse; `heal()` lifts it). Install per-client via
     `client.fault_injector = inj` or process-wide via
     `faults.install_injector(inj)` (tests MUST uninstall — conftest
-    fails any test that leaks the global)."""
+    fails any test that leaks the global).
+
+    Streaming-resize chaos: every transfer leg and the cutover ride
+    InternalClient._do, so path-prefix rules target them directly —
+    "/internal/fragment/data" (snapshot fetch + capture arm),
+    "/internal/fragment/delta" (catch-up drains),
+    "/internal/resize/stream" / "/internal/resize/catchup" (the
+    coordinator's per-node instructions), and
+    "/internal/cluster/message" (the cutover's required-ack status
+    broadcast). `NodeServer.resize_phase_hook` complements this with
+    deterministic coordinator-side FSM injection points (kill or abort
+    at an exact phase label); tests/test_cluster.py wires both into the
+    kill-source / kill-destination / kill-coordinator matrix."""
 
     def __init__(self, seed: int = 0, sleep: Callable[[float], None] = time.sleep):
         self._mu = TrackedLock("faults.injector_mu")

@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pilosa_tpu.core.fragment import TransferCutover
 from pilosa_tpu.exec.executor import ExecError, NotFoundError
 from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.sched.admission import ShedError
@@ -139,6 +140,80 @@ class Handler(BaseHTTPRequestHandler):
                 f"path parameter {name!r} must be an integer, got {raw!r}"
             ) from None
 
+    def _json_body_dict(self) -> dict:
+        """Validated JSON object body -> 400 naming the problem (the
+        resize control surface takes structured bodies; `[]` or a bare
+        string must be a client error, never an AttributeError 500)."""
+        try:
+            d = self._json_body()
+        except ValueError:
+            raise BadParam("request body must be valid JSON") from None
+        if d is None:
+            return {}
+        if not isinstance(d, dict):
+            raise BadParam(
+                f"request body must be a JSON object, got {type(d).__name__}"
+            )
+        return d
+
+    def _body_str(self, d: dict, name: str) -> str:
+        raw = d.get(name)
+        if not isinstance(raw, str) or not raw:
+            raise BadParam(
+                f"body field {name!r} must be a non-empty string, got {raw!r}"
+            )
+        return raw
+
+    def _body_int(self, d: dict, name: str) -> Optional[int]:
+        raw = d.get(name)
+        if raw is None:
+            return None
+        if isinstance(raw, bool) or not isinstance(raw, int):
+            raise BadParam(
+                f"body field {name!r} must be an integer, got {raw!r}"
+            )
+        return raw
+
+    def _body_nodes(self, d: dict, name: str, required: bool = True):
+        """Validated membership list -> topology Nodes; 400 names the
+        field and element on malformed input."""
+        from pilosa_tpu.cluster.topology import Node as TNode
+
+        raw = d.get(name)
+        if raw is None:
+            if required:
+                raise BadParam(f"missing required body field {name!r}")
+            return None
+        if not isinstance(raw, list):
+            raise BadParam(
+                f"body field {name!r} must be a list of node objects, "
+                f"got {type(raw).__name__}"
+            )
+        nodes = []
+        for i, n in enumerate(raw):
+            if not isinstance(n, dict) or not isinstance(n.get("id"), str) or not n["id"]:
+                raise BadParam(
+                    f"body field {name!r}[{i}] must be a node object "
+                    "with a non-empty string 'id'"
+                )
+            nodes.append(TNode.from_json(n))
+        return nodes
+
+    def _admit_transfer(self):
+        """Resize transfer serving rides the `batch` admission class:
+        streaming a reshard is bulk work that must never starve
+        interactive queries (WFQ weight 1 vs 8), but it still occupies a
+        real slot so concurrent transfer legs cannot monopolize the node
+        either. Returns the ticket to release (None when admission is
+        disabled); saturation sheds 429, which the internode retry plane
+        absorbs with backoff."""
+        sched = self.node.scheduler
+        if sched is None:
+            return None
+        from pilosa_tpu.sched.admission import CLASS_BATCH
+
+        return sched.admit(cls=CLASS_BATCH)
+
     def _int_list_param(self, name: str) -> List[int]:
         raw = self.query.get(name, "")
         try:
@@ -192,6 +267,17 @@ class Handler(BaseHTTPRequestHandler):
                     self._reply(body, code=429, extra_headers=hdrs)
                 except DisabledError as e:
                     self._error(str(e), 503)
+                except TransferCutover as e:
+                    # resize-cutover write barrier: 503 is retryable for
+                    # the internode plane, and Retry-After covers direct
+                    # clients — the barrier window is sub-second in the
+                    # normal case (quiesce -> final drain -> install)
+                    self.node.stats.count("resize.cutover_rejects", 1)
+                    self._reply(
+                        {"error": str(e)},
+                        code=503,
+                        extra_headers={"Retry-After": "1"},
+                    )
                 except (ExecError, ApiError, ParseError, ValueError, KeyError) as e:
                     self._error(str(e), 400)
                 except BrokenPipeError:
@@ -508,11 +594,14 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("POST", "/cluster/join")
     def post_cluster_join(self):
-        self._reply(self.api.cluster_join(self._json_body()))
+        d = self._json_body_dict()
+        self._body_str(d, "id")
+        self._body_str(d, "uri")
+        self._reply(self.api.cluster_join(d))
 
     @route("POST", "/cluster/resize/remove-node")
     def post_remove_node(self):
-        self._reply(self.api.remove_node(self._json_body().get("id", "")))
+        self._reply(self.api.remove_node(self._body_str(self._json_body_dict(), "id")))
 
     @route("POST", "/cluster/resize/abort")
     def post_resize_abort(self):
@@ -585,24 +674,58 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("POST", "/internal/resize")
     def post_internal_resize(self):
-        """One node's step of a coordinator-driven resize: apply schema if
-        supplied (joining nodes), then reshard to the new membership
-        (cluster.go:1297 followResizeInstruction, checkpoint-based)."""
-        d = self._json_body()
-        from pilosa_tpu.cluster.topology import Node as TNode
-
+        """One node's step of a CHECKPOINT resize (the manual/bootstrap
+        fallback): apply schema if supplied (joining nodes), then reshard
+        to the new membership (cluster.go:1297 followResizeInstruction).
+        The coordinator's job FSM uses /internal/resize/stream instead."""
+        d = self._json_body_dict()
+        nodes = self._body_nodes(d, "nodes")
+        old_nodes = self._body_nodes(d, "oldNodes", required=False)
+        replica_n = self._body_int(d, "replicaN")
         if d.get("schema"):
             self.api.apply_schema(d["schema"])
         fetched = self.node.resize_to(
-            [TNode.from_json(n) for n in d["nodes"]],
-            replica_n=d.get("replicaN"),
-            old_nodes=(
-                [TNode.from_json(n) for n in d["oldNodes"]]
-                if d.get("oldNodes")
-                else None
-            ),
+            nodes, replica_n=replica_n, old_nodes=old_nodes,
+            old_replica_n=self._body_int(d, "oldReplicaN"),
         )
         self._reply({"fetched": fetched})
+
+    @route("POST", "/internal/resize/stream")
+    def post_internal_resize_stream(self):
+        """One node's STREAMING resize step: fetch every fragment the new
+        placement assigns here (snapshot + live write capture on the
+        source) and drain catch-up rounds — without touching the
+        installed topology, so this node serves reads AND writes against
+        the old placement throughout. Malformed bodies -> 400 JSON naming
+        the field (import/export coercion convention)."""
+        d = self._json_body_dict()
+        job = self._body_str(d, "job")
+        nodes = self._body_nodes(d, "nodes")
+        old_nodes = self._body_nodes(d, "oldNodes", required=False)
+        replica_n = self._body_int(d, "replicaN")
+        old_replica_n = self._body_int(d, "oldReplicaN")
+        post_commit = d.get("postCommit", False)
+        if not isinstance(post_commit, bool):
+            raise BadParam(
+                f"body field 'postCommit' must be a boolean, got {post_commit!r}"
+            )
+        if d.get("schema"):
+            self.api.apply_schema(d["schema"])
+        self._reply(
+            self.node.resize_stream(
+                job, nodes, replica_n=replica_n, old_nodes=old_nodes,
+                old_replica_n=old_replica_n, post_commit=post_commit,
+            )
+        )
+
+    @route("POST", "/internal/resize/catchup")
+    def post_internal_resize_catchup(self):
+        """Cutover drain round: with the sources quiesced this empties
+        every capture for this node's transferred fragments before the
+        coordinator installs the new topology."""
+        d = self._json_body_dict()
+        job = self._body_str(d, "job")
+        self._reply({"applied": self.node.resize_catchup(job)})
 
     @route("POST", "/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import")
     def post_internal_import(self, index: str, field: str):
@@ -712,11 +835,60 @@ class Handler(BaseHTTPRequestHandler):
 
     @route("GET", "/internal/fragment/data")
     def get_fragment_data(self):
-        frag = self._fragment()
-        if frag is None:
-            self._error("fragment not found", 404)
-            return
-        self._reply(None, raw=frag.to_bytes(), content_type="application/octet-stream")
+        """Full-fragment snapshot. With `?capture=<job>` (streaming
+        resize phase 1) the snapshot and a live write capture arm
+        atomically, and the serving rides the batch admission lane so a
+        rebalance cannot starve interactive queries."""
+        capture = self.query.get("capture")
+        ticket = self._admit_transfer() if capture else None
+        try:
+            frag = self._fragment()
+            if frag is None:
+                self._error("fragment not found", 404)
+                return
+            if capture:
+                key = (
+                    self.query["index"],
+                    self.query["field"],
+                    self.query.get("view", "standard"),
+                    self._int_param("shard"),
+                )
+                blob = self.node.begin_fragment_capture(capture, key, frag)
+            else:
+                blob = frag.to_bytes()
+            self._reply(None, raw=blob, content_type="application/octet-stream")
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    @route("GET", "/internal/fragment/delta")
+    def get_fragment_delta(self):
+        """Drain one transfer leg's captured writes (WAL-framed bytes;
+        streaming resize phase 2). 410 Gone when the capture is lost
+        (lease expiry, overflow, source restart) — the destination must
+        refetch the full snapshot."""
+        from pilosa_tpu.core.fragment import TransferCaptureLost
+
+        job = self._str_param("job")
+        key = (
+            self._str_param("index"),
+            self._str_param("field"),
+            self.query.get("view", "standard"),
+            self._int_param("shard"),
+        )
+        ticket = self._admit_transfer()
+        try:
+            try:
+                data = self.node.drain_fragment_capture(job, key)
+            except TransferCaptureLost as e:
+                self._error(str(e), 410)
+                return
+            self._reply(
+                None, raw=data, content_type="application/octet-stream"
+            )
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     @route("POST", "/internal/translate/keys")
     def post_translate_keys(self):

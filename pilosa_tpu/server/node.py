@@ -9,8 +9,14 @@ TPU-native membership: the mesh is STATIC configuration (a list of node
 ids/URIs), the JAX-distributed-runtime model, instead of SWIM gossip —
 liveness is detected by HTTP /status probes (the reference also
 belt-and-suspenders probes over HTTP, cluster.go:1724-1752). Elasticity is
-checkpoint-based resharding driven by `resize_to` rather than live
-streaming under a coordinator FSM (SURVEY.md hard-part #5)."""
+STREAMING resharding under live traffic (the reference's resizeJob +
+ResizeInstruction flow, cluster.go:1141-1561): each moving fragment ships
+as a full snapshot plus a live write capture replayed at read barriers
+(core/fragment.py begin_streaming/drain_capture), and ownership cuts over
+atomically in the coordinator's job FSM via a required-ack topology
+install — writes are never globally frozen, only a per-fragment drain
+window. The older checkpoint path (`resize_to` under a RESIZING freeze)
+remains as the manual/bootstrap fallback."""
 
 from __future__ import annotations
 
@@ -38,6 +44,18 @@ from pilosa_tpu.server.client import ClientError, InternalClient
 
 class _ResizeAborted(Exception):
     pass
+
+
+# Source-side write captures self-expire after this many seconds without a
+# drain: a coordinator (or destination) that died mid-transfer must not
+# leave sources buffering deltas forever. Each capture-plane request
+# refreshes its own lease and sweeps expired ones.
+CAPTURE_LEASE = 600.0
+
+# Catch-up rounds per stream step: the loop exits early when a round
+# drains zero positions; this only bounds pathological write storms (the
+# cutover-timeout knob bounds the wall clock of the same loop).
+_MAX_CATCHUP_ROUNDS = 8
 
 
 class NodeServer:
@@ -76,6 +94,9 @@ class NodeServer:
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
         import_concurrency: int = 8,  # parallel replica-import RPCs per call
+        resize_transfer_concurrency: int = 4,  # parallel fragment fetches
+        resize_cutover_timeout: float = 30.0,  # catch-up barrier bound, s
+        resize_resume_policy: str = "resume",  # resume|abort on failed leg
         tracing_enabled: bool = True,  # sample root spans at all
         trace_sample_rate: float = 1.0,  # fraction of root queries traced
         trace_ring: int = 1024,  # spans kept in the per-node ring
@@ -183,6 +204,27 @@ class NodeServer:
         self.import_concurrency = max(1, int(import_concurrency))
         self._import_pool = None
         self._import_pool_mu = TrackedLock("node.import_pool_mu")
+        # streaming-resize plane: source-side write captures (keyed by
+        # (job, index, field, view, shard), leased) and the destination-
+        # side per-job transfer ledger used for crash resume and abort
+        # cleanup — see "streaming resize" section below
+        if resize_resume_policy not in ("resume", "abort"):
+            raise ValueError(
+                f"resize_resume_policy must be 'resume' or 'abort', "
+                f"got {resize_resume_policy!r}"
+            )
+        self.resize_transfer_concurrency = max(
+            1, int(resize_transfer_concurrency)
+        )
+        self.resize_cutover_timeout = float(resize_cutover_timeout)
+        self.resize_resume_policy = resize_resume_policy
+        self._transfer_mu = TrackedLock("node.transfer_mu")
+        self._transfer_captures: Dict[tuple, dict] = {}
+        self._resize_ledger: Dict[str, dict] = {}
+        # test hook: called with each resize-job phase label on the job
+        # thread — the deterministic chaos matrix uses it to kill/abort
+        # at exact FSM points instead of racing wall-clock sleeps
+        self.resize_phase_hook = None
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.probe_interval = probe_interval
@@ -1130,25 +1172,35 @@ class NodeServer:
 
     # -- resize (checkpoint-based resharding; cluster.go:1447 analog) ------
 
-    def resize_to(
+    def _resize_source_legs(
         self,
         new_nodes: List[Node],
         replica_n: Optional[int] = None,
         old_nodes: Optional[List[Node]] = None,
-    ) -> int:
-        """Checkpoint-based resize: diff fragment placement old->new,
-        stream fragments this node must acquire, then install the new
-        topology locally. Each node runs this against the same `new_nodes`
-        list (the bootstrap/ops layer coordinates the order); a JOINING node
-        passes `old_nodes` (the membership it is joining) since its own
-        cluster view is just itself. Returns fragments fetched."""
+        old_replica_n: Optional[int] = None,
+    ):
+        """(old_cluster, new_cluster, legs): the fragment transfers THIS
+        node must run for the old->new placement diff — legs are
+        ((index, field, view, shard), ResizeSource) pairs. ONE copy of
+        the placement-critical walk, shared by the legacy checkpoint path
+        (resize_to) and the streaming path (resize_stream). The old
+        cluster is built with `old_replica_n` (the coordinator passes the
+        PRE-resize replication so a resize that also changes replica_n
+        does not mis-compute who already holds what; the replica_n
+        fallback keeps the legacy manual-call shape). Old nodes marked
+        DOWN (the coordinator's probe pass rides in on `old_nodes`) are
+        skipped during inventory so a corpse costs nothing."""
         from pilosa_tpu.cluster.topology import Frag
 
         old = self.cluster
         if old_nodes is not None:
+            if old_replica_n is None:
+                old_replica_n = (
+                    replica_n if replica_n is not None else old.replica_n
+                )
             old = Cluster(
                 nodes=old_nodes,
-                replica_n=replica_n if replica_n is not None else old.replica_n,
+                replica_n=old_replica_n,
                 partition_n=old.partition_n,
                 hasher=old.hasher,
             )
@@ -1159,7 +1211,7 @@ class NodeServer:
             hasher=old.hasher,
             state=STATE_NORMAL,
         )
-        fetched = 0
+        legs = []
         for idx in self.holder.indexes():
             # cluster-wide fragment inventory: union of every old-cluster
             # node's local fragments (a joining node has none of its own)
@@ -1172,35 +1224,65 @@ class NodeServer:
                                 (f.name, vname, s) for s in v.fragments
                             )
                     continue
+                if n.state == "DOWN":
+                    continue
                 try:
                     inventory.update(
                         self.client.fragment_inventory(n.uri, idx.name)
                     )
                 except ClientError:
                     continue
-            frags = [Frag(fl, vw, sh) for fl, vw, sh in sorted(inventory)]
-            if not frags:
+            if not inventory:
                 continue
             # make every inventoried shard visible to future query fan-out
             for fl, vw, sh in inventory:
                 f = idx.field(fl)
                 if f is not None:
                     f.add_remote_available([sh])
+            frags = [Frag(fl, vw, sh) for fl, vw, sh in sorted(inventory)]
             sources = old.frag_sources(new, idx.name, frags)
             for src in sources.get(self.node.id, []):
-                f = idx.field(src.field)
-                if f is None:
+                if idx.field(src.field) is None:
                     continue
-                try:
-                    blob = self.client.retrieve_fragment(
-                        src.node.uri, idx.name, src.field, src.view, src.shard
-                    )
-                except ClientError as e:
-                    self.logger(f"resize fetch {src.index}/{src.field}: {e}")
-                    continue
-                v = f._view_create(src.view)
-                v.fragment(src.shard).from_bytes(blob)
-                fetched += 1
+                legs.append(
+                    ((idx.name, src.field, src.view, src.shard), src)
+                )
+        return old, new, legs
+
+    def resize_to(
+        self,
+        new_nodes: List[Node],
+        replica_n: Optional[int] = None,
+        old_nodes: Optional[List[Node]] = None,
+        old_replica_n: Optional[int] = None,
+    ) -> int:
+        """Checkpoint-based resize (the manual/bootstrap fallback): diff
+        fragment placement old->new, fetch fragments this node must
+        acquire, then install the new topology locally. Each node runs
+        this against the same `new_nodes` list (the bootstrap/ops layer
+        coordinates the order); a JOINING node passes `old_nodes` (the
+        membership it is joining) since its own cluster view is just
+        itself. Returns fragments fetched."""
+        _, new, legs = self._resize_source_legs(
+            new_nodes, replica_n, old_nodes, old_replica_n
+        )
+        fetched = 0
+        for (iname, fname, vname, shard), src in legs:
+            try:
+                blob = self.client.retrieve_fragment(
+                    src.node.uri, iname, fname, vname, shard
+                )
+            except ClientError as e:
+                self.logger(f"resize fetch {iname}/{fname}: {e}")
+                continue
+            idx = self.holder.index(iname)
+            f = idx.field(fname) if idx is not None else None
+            if f is None:
+                # concurrent DDL deleted the field since the inventory
+                # walk — the fragment has no post-resize owner to miss
+                continue
+            f._view_create(vname).fragment(shard).from_bytes(blob)
+            fetched += 1
         self.set_topology(new_nodes, replica_n=new.replica_n)
         return fetched
 
@@ -1224,6 +1306,407 @@ class NodeServer:
         if removed:
             self.logger(f"holder cleaner removed {removed} fragments")
         return removed
+
+    # -- streaming resize: source-side write captures ----------------------
+    # A moving fragment ships in two phases (cluster.go:1297
+    # followResizeInstruction, made live): (1) the destination GETs
+    # /internal/fragment/data?capture=<job>, which snapshots the fragment
+    # AND arms a write capture atomically; (2) it drains the capture
+    # (/internal/fragment/delta) in catch-up rounds until dry, and once
+    # more after the topology cutover. Captures are leased: a dead
+    # driver's capture self-expires instead of buffering forever.
+
+    def begin_fragment_capture(self, tag: str, key: tuple, frag) -> bytes:
+        """Snapshot + arm the write capture for one fragment transfer
+        leg; `key` is (index, field, view, shard) and `tag` is the
+        destination's opaque transfer tag (`<job>:<dest node id>` — each
+        destination gets its OWN capture, so two replicas streaming the
+        same source fragment never steal each other's records). Returns
+        the snapshot blob."""
+        blob = frag.begin_streaming(tag)
+        now = time.monotonic()
+        with self._transfer_mu:
+            self._sweep_captures_locked(now)
+            self._transfer_captures[(tag,) + tuple(key)] = {
+                "frag": frag,
+                "expires": now + CAPTURE_LEASE,
+            }
+        return blob
+
+    def drain_fragment_capture(self, tag: str, key: tuple) -> bytes:
+        """Pop one transfer leg's captured writes (WAL-framed bytes).
+        Raises TransferCaptureLost (-> HTTP 410) when the capture is gone
+        — expired lease, overflow, or a source restart — telling the
+        destination to refetch the full snapshot."""
+        from pilosa_tpu.core.fragment import TransferCaptureLost
+
+        now = time.monotonic()
+        with self._transfer_mu:
+            self._sweep_captures_locked(now)
+            ent = self._transfer_captures.get((tag,) + tuple(key))
+            if ent is not None:
+                ent["expires"] = now + CAPTURE_LEASE
+        if ent is None:
+            raise TransferCaptureLost(f"no active capture for {key} ({tag})")
+        return ent["frag"].drain_capture(tag)
+
+    def _sweep_captures_locked(self, now: float) -> None:
+        for key, ent in list(self._transfer_captures.items()):
+            if now >= ent["expires"]:
+                del self._transfer_captures[key]
+                ent["frag"].end_capture(key[0])
+
+    def _transfer_tag(self, job: str) -> str:
+        """This node's capture tag for one job's transfer legs."""
+        return f"{job}:{self.node.id}"
+
+    def quiesce_job_captures(self, job: str, ttl: float) -> int:
+        """Arm the per-fragment cutover write barrier on every fragment
+        with an armed capture for `job` (`resize-quiesce` broadcast, sent
+        required-ack by the coordinator right before the final drain):
+        writes to moving fragments 503 retryably for the barrier window,
+        so the drain that follows provably empties every capture BEFORE
+        the topology installs — the stale-replay inversion (an old
+        captured record replayed over a newer post-cutover write) is
+        structurally impossible. The barrier lifts on resize-release /
+        resize-cleanup (end_capture) or self-expires at `ttl`."""
+        with self._transfer_mu:
+            frags = [
+                ent["frag"]
+                for k, ent in self._transfer_captures.items()
+                if k[0] == job or k[0].startswith(job + ":")
+            ]
+        for f in frags:
+            f.block_writes(ttl)
+        return len(frags)
+
+    def release_job_captures(self, job: Optional[str] = None) -> int:
+        """End this job's captures (all jobs when None) and drop the
+        destination-side ledger — the normal-completion teardown (the
+        coordinator broadcasts `resize-release` after the final drain).
+        Matches both the bare job id and every per-destination
+        `<job>:<dest>` tag. Fetched fragments are KEPT: the cutover
+        committed them."""
+        with self._transfer_mu:
+            keys = [
+                k
+                for k in self._transfer_captures
+                if job is None or k[0] == job or k[0].startswith(job + ":")
+            ]
+            ents = [(k, self._transfer_captures.pop(k)) for k in keys]
+            if job is None:
+                self._resize_ledger.clear()
+            else:
+                self._resize_ledger.pop(job, None)
+        for k, ent in ents:
+            ent["frag"].end_capture(k[0])
+        return len(ents)
+
+    def resize_cleanup(self, job: str, aborting: bool = False) -> int:
+        """Abort-path teardown (`resize-cleanup` broadcast) and
+        stale-ledger sweep: delete the fragments this job's transfers
+        CREATED here (restoring disk and device-cache residency to the
+        pre-resize state), then release captures and the ledger.
+        Fragments that already existed before the job are untouched —
+        their contents only ever gained replayed writes through the
+        normal exact funnels. `aborting` deletes created fragments
+        unconditionally: a rolled-back job's fetches must leave no trace
+        even when the restored topology happens to claim the shard — in
+        particular a joiner reset to a solo cluster owns EVERY shard, so
+        the stale-ledger ownership guard below would keep all of them."""
+        with self._transfer_mu:
+            ledger = self._resize_ledger.get(job)
+            created = list(ledger["created"]) if ledger else []
+        removed = 0
+        for iname, fname, vname, shard in created:
+            if not aborting and self.cluster.owns_shard(self.node.id, iname, shard):
+                # the CURRENT topology assigns this shard here: the
+                # ledger is stale because a resize-release got lost after
+                # a COMMITTED job, not because this job rolled back —
+                # deleting would drop live, owned data.
+                continue
+            idx = self.holder.index(iname)
+            f = idx.field(fname) if idx is not None else None
+            v = f.views.get(vname) if f is not None else None
+            if v is not None and v.delete_fragment(shard):
+                removed += 1
+        self.release_job_captures(job)
+        if removed:
+            self.logger(f"resize cleanup ({job}): removed {removed} fragments")
+        return removed
+
+    # -- streaming resize: destination-side transfer steps -----------------
+
+    def resize_stream(
+        self,
+        job: str,
+        new_nodes: List[Node],
+        replica_n: Optional[int] = None,
+        old_nodes: Optional[List[Node]] = None,
+        old_replica_n: Optional[int] = None,
+        post_commit: bool = False,
+    ) -> dict:
+        """One node's phase-1 step of a STREAMING resize: fetch every
+        fragment the new placement assigns to this node (full snapshot +
+        armed write capture on the source), then drain delta rounds until
+        the source runs dry — all WITHOUT touching the installed topology,
+        so this node keeps serving reads and writes against the OLD
+        placement the whole time. Crash-resumable: fragments already in
+        this job's ledger skip the refetch and just catch up (a lost
+        source capture forces that leg back to a full snapshot). Returns
+        {"fetched", "deltas", "shards"} — `shards` feeds the
+        coordinator's post-cutover repair-debt pass."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._transfer_mu:
+            stale = [j for j in self._resize_ledger if j != job]
+            ledger = self._resize_ledger.get(job)
+            if ledger is None:
+                ledger = self._resize_ledger[job] = {
+                    "fetched": {},  # (index, field, view, shard) -> src uri
+                    "created": set(),  # keys whose fragment we created
+                }
+        for j in stale:
+            # a superseded job's leftovers (its coordinator died before
+            # broadcasting cleanup) must not shadow this one
+            self.resize_cleanup(j)
+        _, _, legs = self._resize_source_legs(
+            new_nodes, replica_n, old_nodes, old_replica_n
+        )
+        if post_commit:
+            # the final sweep only hunts fragments CREATED after this
+            # node's first inventory walk. Legs already in the ledger were
+            # drained dry under the cutover write barrier — complete by
+            # construction — and re-draining them now would 410 (captures
+            # released) into a snapshot refetch that clobbers post-cutover
+            # writes.
+            with self._transfer_mu:
+                done = set(ledger["fetched"])
+            legs = [(k, s) for k, s in legs if k not in done]
+        fetched = 0
+        deltas = 0
+        if legs:
+            workers = min(self.resize_transfer_concurrency, len(legs))
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="resize-xfer"
+            ) as pool:
+                results = list(
+                    pool.map(
+                        lambda leg: self._transfer_leg(
+                            job, ledger, *leg, post_commit=post_commit
+                        ),
+                        legs,
+                    )
+                )
+            fetched = sum(f for f, _ in results)
+            deltas += sum(d for _, d in results)
+        if not post_commit:
+            # catch-up rounds: drain every source until a round comes back
+            # empty (bounded by rounds and by the cutover-timeout wall clock)
+            deadline = time.monotonic() + max(self.resize_cutover_timeout, 0.5)
+            for _ in range(_MAX_CATCHUP_ROUNDS):
+                applied = self._catchup_round(job)
+                self.stats.count("resize.catchup_rounds", 1)
+                deltas += applied
+                if applied == 0 or time.monotonic() >= deadline:
+                    break
+        shards: Dict[str, List[int]] = {}
+        with self._transfer_mu:
+            for iname, _f, _v, shard in ledger["fetched"]:
+                if shard not in shards.setdefault(iname, []):
+                    shards[iname].append(shard)
+        return {"fetched": fetched, "deltas": deltas, "shards": shards}
+
+    def _transfer_leg(
+        self, job: str, ledger: dict, key: tuple, src, post_commit: bool = False
+    ) -> tuple:
+        """Stream one fragment from its source (or just catch it up when
+        the ledger says the snapshot already landed in a prior attempt).
+        Post-commit (the coordinator's final sweep), the leg is a late
+        arrival the first inventory walk missed: fetch it WITHOUT arming a
+        capture (the install already routed its writes to this node) and
+        MERGE into any existing contents — a wholesale replace would erase
+        post-cutover writes already acknowledged here.
+        Returns (fetched 0|1, delta_positions)."""
+        iname, fname, vname, shard = key
+        span = self.tracer.start_span("resize.transfer")
+        with span:
+            span.set_tag("index", iname)
+            span.set_tag("field", fname)
+            span.set_tag("shard", shard)
+            span.set_tag("peer", src.node.uri)
+            if post_commit:
+                blob_len = self._fetch_leg(
+                    job, ledger, key, src.node.uri,
+                    capture=False, merge_existing=True,
+                )
+            else:
+                with self._transfer_mu:
+                    resumed = key in ledger["fetched"]
+                if resumed:
+                    applied = self._drain_or_refetch(
+                        job, ledger, key, src.node.uri
+                    )
+                    span.set_tag("resize.resumed", True)
+                    return 0, applied
+                blob_len = self._fetch_leg(job, ledger, key, src.node.uri)
+            if blob_len is None:
+                span.set_tag("resize.skipped", True)
+                return 0, 0
+            span.set_tag("resize.bytes", blob_len)
+            return 1, 0
+
+    def _fetch_leg(
+        self,
+        job: str,
+        ledger: dict,
+        key: tuple,
+        src_uri: str,
+        capture: bool = True,
+        merge_existing: bool = False,
+    ) -> Optional[int]:
+        """Fetch one leg's full snapshot (arming the source's write
+        capture atomically unless `capture=False`) and record it in the
+        job ledger. Returns the blob size, or None when the leg is moot
+        (its field was deleted since the inventory walk) or could not be
+        merged — skipped, never an AttributeError 500."""
+        iname, fname, vname, shard = key
+        idx = self.holder.index(iname)
+        f = idx.field(fname) if idx is not None else None
+        if f is None:
+            # concurrent DDL: the field is gone, so there is nothing to
+            # own post-cutover — skip the leg instead of failing the job
+            self.logger(f"resize fetch {iname}/{fname}: field gone, skipping")
+            return None
+        blob = self.client.retrieve_fragment(
+            src_uri, iname, fname, vname, shard,
+            capture=self._transfer_tag(job) if capture else None,
+        )
+        v = f._view_create(vname)
+        existing = v.fragment_if_exists(shard)
+        created = existing is None
+        if merge_existing and not created:
+            try:
+                existing.merge_from_bytes(blob)
+            except ValueError as e:
+                # mutex fragments cannot word-merge; the newer local
+                # contents stand and the repair-debt backstop reconciles
+                self.logger(f"resize sweep merge {key}: {e}")
+                return None
+        else:
+            v.fragment(shard).from_bytes(blob)
+        with self._transfer_mu:
+            ledger["fetched"][key] = src_uri
+            if created:
+                ledger["created"].add(key)
+        self.stats.count("resize.fragments_streamed", 1)
+        self.stats.count("resize.bytes_streamed", len(blob))
+        return len(blob)
+
+    def _drain_or_refetch(self, job: str, ledger: dict, key: tuple, src_uri: str) -> int:
+        """Drain one leg's capture. ANY drain failure recovers by
+        refetching the full snapshot and draining the fresh capture once:
+        the source-side pop is destructive and the drain RPC deliberately
+        single-attempt, so a failed drain is ambiguous (a lost response
+        may have taken popped records with it) or lost outright (410) —
+        and the snapshot is always a superset of whatever the delta would
+        have carried. ValueError covers a torn/corrupt wire delta: the
+        strict decode applied NOTHING, and the popped records live only in
+        the garbled bytes, so only a fresh snapshot can recover them. The
+        refetch itself rides the normal retry plane; if it fails too, the
+        error propagates to the caller's resume/abort policy.
+
+        EXCEPTION: a 429 admission shed is NOT ambiguous — the handler
+        sheds before `drain_fragment_capture` runs, so no records were
+        popped and the drain is safe to retry. Escalating a shed to a
+        full snapshot refetch would amplify the very load that caused it
+        (and inside the cutover barrier would turn a near-empty delta
+        pop into a whole-fragment transfer)."""
+        err: Exception
+        for _ in range(4):
+            try:
+                return self._drain_leg(job, key, src_uri)
+            except ClientError as e:
+                err = e
+                if e.status == 429:
+                    time.sleep(min(e.retry_after or 0.05, 1.0))
+                    continue
+                break
+            except ValueError as e:
+                err = e
+                break
+        self.logger(f"resize drain {key}: {err}; refetching snapshot")
+        if self._fetch_leg(job, ledger, key, src_uri) is None:
+            return 0
+        try:
+            return self._drain_leg(job, key, src_uri)
+        except (ClientError, ValueError) as e:
+            # the refetched snapshot already carries everything up to its
+            # arm point; whatever landed since stays in the fresh capture
+            # for the next catch-up round (or the repair-debt backstop)
+            self.logger(f"resize drain {key} after refetch: {e}")
+            return 0
+
+    def _drain_leg(self, job: str, key: tuple, src_uri: str) -> int:
+        iname, fname, vname, shard = key
+        data = self.client.fragment_delta(
+            src_uri, iname, fname, vname, shard, self._transfer_tag(job)
+        )
+        if not data:
+            return 0
+        idx = self.holder.index(iname)
+        f = idx.field(fname) if idx is not None else None
+        v = f.views.get(vname) if f is not None else None
+        frag = v.fragment(shard) if v is not None else None
+        if frag is None:
+            return 0
+        applied = frag.apply_transfer_records(data)
+        if applied:
+            self.stats.count("resize.delta_positions", applied)
+        return applied
+
+    def _catchup_round(self, job: str) -> int:
+        """One drain round over every transfer leg in this job's ledger
+        (lost captures recover via snapshot refetch), legs drained in
+        parallel on the same `resize_transfer_concurrency` bound as the
+        stream phase — the cutover's write-barrier window is one of these
+        rounds, so a sequential drain would scale that window with
+        legs x RTT instead of legs/concurrency. Per-leg work is
+        independent (distinct destination fragments, per-leg captures;
+        ledger access under _transfer_mu), exactly as in the concurrent
+        stream phase. Returns total positions applied; raises ClientError
+        when a source is unreachable (the caller decides resume vs
+        abort)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._transfer_mu:
+            ledger = self._resize_ledger.get(job)
+            legs = list(ledger["fetched"].items()) if ledger else []
+        if not legs:
+            return 0
+        workers = min(self.resize_transfer_concurrency, len(legs))
+        if workers <= 1:
+            return sum(
+                self._drain_or_refetch(job, ledger, key, src_uri)
+                for key, src_uri in legs
+            )
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="resize-drain"
+        ) as pool:
+            return sum(
+                pool.map(
+                    lambda leg: self._drain_or_refetch(job, ledger, *leg),
+                    legs,
+                )
+            )
+
+    def resize_catchup(self, job: str) -> int:
+        """The cutover's final drain (the coordinator orders one on every
+        destination after quiescing the sources, BEFORE the topology
+        install): with the write barrier armed, this round provably
+        empties every capture, so nothing is left to replay over writes
+        the new topology will route."""
+        return self._catchup_round(job)
 
     # -- coordinator-driven resize jobs (cluster.go:1141-1561) -------------
 
@@ -1249,7 +1732,11 @@ class NodeServer:
                 "id": f"{self.node.id}-{int(time.time() * 1000)}",
                 "action": action,
                 "state": "RUNNING",
+                "phase": "starting",
+                "committed": False,
                 "nodes": [n.to_json() for n in new_nodes],
+                "transfers": {},
+                "moved": [],
                 "error": None,
             }
             self.resize_job = job
@@ -1265,94 +1752,156 @@ class NodeServer:
 
     def abort_resize(self) -> dict:
         """Abort path (reference: api.go:1250 ResizeAbort). The running job
-        notices between per-node steps and rolls back the old topology."""
-        self._resize_abort.set()
+        notices at its next phase boundary and rolls back the old
+        topology. Once the cutover install has been ACKNOWLEDGED (the job
+        is "committed"), abort is a no-op: the cluster already agreed on
+        the new topology, and un-installing it could race the NORMAL
+        broadcast into a split placement view — the job rolls forward to
+        DONE instead."""
+        with self._resize_mu:
+            job = self.resize_job
+            if (
+                job is not None
+                and job["state"] == "RUNNING"
+                and not job.get("committed")
+            ):
+                self._resize_abort.set()
         return self.resize_job or {"state": "NONE"}
 
     def _run_resize(self, job: dict, new_nodes: List[Node], replica_n) -> None:
+        """Streaming resize job FSM. Phases:
+
+        probe -> stream (per-node snapshot+capture transfer legs, catch-up
+        rounds, old topology still serving everything) -> cutover
+        (quiesce sources behind the per-fragment write barrier, final
+        drain to provably-empty captures, then required-ack install of
+        the new topology — the ATOMIC commit point) -> sweep (fetch-only
+        fragments created after the first inventory walks) -> gc. Writes
+        are never globally frozen — only a bounded per-fragment barrier
+        window at cutover — and queries admit through the whole job. Any
+        failure or abort BEFORE the cutover ack rolls back to the old
+        topology with every transferred fragment deleted and every
+        capture released — no half-owned shards. After the cutover ack
+        the job only rolls FORWARD: residual drift is recorded as repair
+        debt and drained by anti-entropy."""
         old_members = list(self.cluster.nodes)
         old_replica = self.cluster.replica_n
         old_ids = {n.id for n in old_members}
         new_ids = {n.id for n in new_nodes}
         joiners = [n for n in new_nodes if n.id not in old_ids]
         removed = [n for n in old_members if n.id not in new_ids]
-        schema = self.api.schema()
+        job_id = job["id"]
+
+        def phase(name: str) -> None:
+            job["phase"] = name
+            hook = self.resize_phase_hook
+            if hook is not None:
+                hook(name)
+            if self._resize_abort.is_set():
+                raise _ResizeAborted()
 
         def rollback() -> None:
             # restore the old membership on the old members; any joiner
             # that already installed the new topology is reset to a
             # standalone single-node cluster (it never became a member).
-            # Delivery is best-effort-with-verification and retries hard —
-            # a member that misses BOTH the restore and this rollback stays
-            # frozen in RESIZING until an operator re-sends the status (the
-            # reference's broadcast has the same residual gap); the failure
-            # is logged loudly by _send_status.
+            # Then every participant tears down its transfer state: the
+            # resize-cleanup broadcast deletes destination-side fetched
+            # fragments and releases source-side captures, so the stream
+            # phase leaves NO trace — topology, repair debt, and device
+            # residency all read as pre-resize. Delivery is best-effort
+            # with retries; a node that misses cleanup self-heals via the
+            # capture lease and the next job's stale-ledger sweep.
+            self.stats.count("resize.aborts", 1)
             self._send_status(
                 old_members, old_members, old_replica, STATE_NORMAL, retries=10
             )
             for n in joiners:
                 solo = Node(id=n.id, uri=n.uri, is_coordinator=True)
                 self._send_status([solo], [solo], 1, STATE_NORMAL)
+            self._broadcast_transfer_msg(
+                list(new_nodes) + old_members,
+                {"type": "resize-cleanup", "job": job_id},
+            )
 
         try:
-            # refresh liveness first so dead members are excluded from the
-            # required-ack sets (the reference confirms down via /status
-            # probes before honoring it, cluster.go:1724)
+            # refresh liveness first so dead members are excluded from
+            # inventory walks and source picks (the reference confirms
+            # down via /status probes before honoring it, cluster.go:1724)
+            phase("probe")
             self.probe_peers()
-            # freeze writes cluster-wide while fragments move; every KEPT
-            # live member must acknowledge the freeze or the job aborts
-            # (r2 advisor). Nodes being removed or already DOWN can't be
-            # required to ack — a dead node must stay removable.
-            removed_ids = {n.id for n in removed}
-
-            def live_kept(nodes):
-                return [
-                    n
-                    for n in nodes
-                    if n.id not in removed_ids and n.state != "DOWN"
-                ]
-
-            self._send_status(
-                live_kept(old_members),
-                old_members,
-                old_replica,
-                STATE_RESIZING,
-                require=True,
-            )
-            rest = [n for n in old_members if n not in live_kept(old_members)]
-            if rest:
-                self._send_status(rest, old_members, old_replica, STATE_RESIZING)
+            # joiners are not members yet, so probe_peers never reaches
+            # them: probe directly (probe=True also heals an open breaker
+            # left by an earlier failed attempt) and abort fast when a
+            # joiner is dead instead of discovering it mid-stream
+            for n in joiners:
+                self.client.status(n.uri, timeout=2.0, probe=True)
+            # the old membership WITH fresh liveness marks rides along to
+            # every destination, so their inventory/fetch skips corpses
+            old_json = [m.to_json() for m in old_members]
             # existing members first (they fetch from current owners while
             # everyone still holds their old fragments), joiners last
-            order = [n for n in new_nodes if n.id in old_ids] + [
-                n for n in new_nodes if n.id not in old_ids
-            ]
+            order = [n for n in new_nodes if n.id in old_ids] + joiners
+            phase("stream")
             for n in order:
-                if self._resize_abort.is_set():
-                    raise _ResizeAborted()
-                joining = n.id not in old_ids
-                if n.id == self.node.id:
-                    self.resize_to(new_nodes, replica_n=replica_n)
-                else:
-                    self.client.resize_node(
-                        n.uri,
-                        [m.to_json() for m in new_nodes],
-                        old_nodes=(
-                            [m.to_json() for m in old_members] if joining else None
-                        ),
-                        replica_n=replica_n,
-                        schema=schema if joining else None,
-                    )
+                phase(f"stream:{n.id}")
+                self._stream_step(
+                    job, n, new_nodes, old_json, replica_n, old_replica,
+                    joining=n.id not in old_ids,
+                )
             new_replica = replica_n if replica_n is not None else old_replica
-            # every surviving member must acknowledge the NORMAL restore
-            # (a member stuck in RESIZING would refuse writes forever)
-            self._send_status(
-                new_nodes, new_nodes, new_replica, STATE_NORMAL, require=True
-            )
-            # removed nodes get the final status too (best-effort): they
-            # unfreeze and learn they are no longer members
-            if removed:
-                self._send_status(removed, new_nodes, new_replica, STATE_NORMAL)
+            phase("cutover")
+            t0 = time.perf_counter()
+            span = self.tracer.start_span("resize.cutover")
+            with span:
+                span.set_tag("job", job_id)
+                # late DDL: re-push the schema to joiners so fields created
+                # while they streamed exist before they start serving
+                for n in joiners:
+                    try:
+                        self.client.post_schema(n.uri, self.api.schema())
+                    except ClientError as e:
+                        self.logger(f"schema refresh to joiner {n.id}: {e}")
+                # quiesce the sources: arm the per-fragment cutover write
+                # barrier on every armed capture, REQUIRED-ack — a source
+                # that keeps accepting writes would keep growing captures
+                # whose post-install replay could clobber newer writes
+                # routed through the new topology (last-write-wins
+                # inversion). A failure here aborts pre-commit: clean
+                # rollback, and resize-cleanup lifts any barrier already
+                # armed. The deadline-based barrier self-expires, so even
+                # a lost release cannot freeze a fragment forever.
+                quiesce_ttl = max(self.resize_cutover_timeout, 5.0) * 2
+                for n in old_members:
+                    if n.state == "DOWN":
+                        continue
+                    if n.id == self.node.id:
+                        self.quiesce_job_captures(job_id, quiesce_ttl)
+                    else:
+                        self.client.send_message(
+                            n.uri,
+                            {
+                                "type": "resize-quiesce",
+                                "job": job_id,
+                                "ttl": quiesce_ttl,
+                            },
+                        )
+                # final drain to dry: with writes barred, one round per
+                # destination pops everything its sources captured — after
+                # this the captures are provably empty and stay empty, so
+                # the install below cuts over with nothing left to replay
+                for n in new_nodes:
+                    if n.id == self.node.id:
+                        self.resize_catchup(job_id)
+                    else:
+                        self.client.resize_catchup(n.uri, job_id)
+                # THE commit point: every new member must acknowledge the
+                # new topology or the job aborts and rolls back — a
+                # partial install would split the cluster's placement view
+                self._send_status(
+                    new_nodes, new_nodes, new_replica, STATE_NORMAL,
+                    require=True,
+                )
+            self.stats.timing("resize.cutover_ms", time.perf_counter() - t0)
         except _ResizeAborted:
             rollback()
             job["state"] = "ABORTED"
@@ -1362,14 +1911,63 @@ class NodeServer:
             rollback()
             job["state"] = "ABORTED"
             job["error"] = str(e)
-            self.logger(f"resize job {job['id']} aborted: {e}")
+            self.logger(f"resize job {job_id} aborted: {e}")
             return
+        # ---- committed. From here the job only rolls FORWARD: an abort
+        # request is a no-op (honoring it would have to un-acknowledge an
+        # installed topology) and per-node failures degrade to logged
+        # repair debt, never to a rollback racing the NORMAL broadcast.
+        job["committed"] = True
+        job["phase"] = "drain"
+        if self.resize_phase_hook is not None:
+            self.resize_phase_hook("committed")
+        # removed nodes get the final status too (best-effort): they learn
+        # they are no longer members and reset to standalone
+        if removed:
+            self._send_status(removed, new_nodes, new_replica, STATE_NORMAL)
+        # final sweep: re-issue every node's stream step in POST-COMMIT
+        # mode, which only hunts fragments a write CREATED after that
+        # node's first inventory walk — without the sweep, such a
+        # fragment's only old-placement copy would be GC'd below with its
+        # new owner never having fetched it. Sources still hold everything
+        # (GC has not run). Ledger legs are deliberately NOT re-touched:
+        # they drained dry under the cutover write barrier, and a
+        # post-install re-drain or refetch could replay stale state over
+        # writes the new topology already acknowledged. Best-effort
+        # post-commit: failures degrade to logged repair debt, never a
+        # rollback.
+        for n in new_nodes:
+            try:
+                self._stream_step(
+                    job, n, new_nodes, old_json, replica_n, old_replica,
+                    joining=n.id not in old_ids, post_commit=True,
+                )
+            except (_ResizeAborted, ClientError) as e:
+                self.logger(
+                    f"post-cutover sweep on {n.id}: {e} "
+                    "(anti-entropy will repair)"
+                )
+        # repair-debt backstop: every moved fragment gets a pending-repair
+        # entry for its new owner, so the anti-entropy plane re-verifies
+        # block checksums even if an in-flight write slipped both drains.
+        # Only meaningful with replicas to reconcile against (same rule as
+        # the import fan-out's dropped-replica ledger).
+        if new_replica > 1:
+            for iname, shard, dest in job.get("moved", []):
+                self.holder.record_pending_repair(iname, int(shard), dest)
+        # drop captures and ledgers everywhere (sources include removed
+        # nodes — they streamed their fragments out)
+        self._broadcast_transfer_msg(
+            list(new_nodes) + old_members,
+            {"type": "resize-release", "job": job_id},
+        )
         # post-resize GC: members drop fragments the new topology no longer
         # assigns to them (holder.go:1126 CleanHolder). Runs AFTER the
         # cluster committed to the new topology — sources keep their data
         # until every node has fetched its set, and a GC failure must never
         # roll the resize back. DONE is reported only once GC finished, so
         # observers of DONE see the cleaned state.
+        job["phase"] = "gc"
         for n in new_nodes:
             try:
                 if n.id == self.node.id:
@@ -1379,6 +1977,111 @@ class NodeServer:
             except Exception as e:  # noqa: BLE001 - GC is best-effort
                 self.logger(f"clean-holder on {n.id}: {e}")
         job["state"] = "DONE"
+        if job.get("moved") and new_replica > 1:
+            # drain the just-recorded transfer repair debt NOW instead of
+            # leaving it standing in /status until the next anti-entropy
+            # tick (the interval defaults to manual). Runs after DONE so
+            # pollers never wait on it; the AE ticker + debt nudges
+            # remain the backstop if this pass cannot reach a peer.
+            try:
+                self.try_sync_holder(wait_nudge=True)
+            except Exception as e:  # noqa: BLE001 - drain is best-effort
+                self.logger(f"post-resize repair drain: {e}")
+
+    def _stream_step(
+        self,
+        job: dict,
+        n: Node,
+        new_nodes: List[Node],
+        old_json: List[dict],
+        replica_n,
+        old_replica_n,
+        joining: bool,
+        post_commit: bool = False,
+    ) -> None:
+        """Order one node through its stream phase, honoring the
+        resume-vs-abort policy: under "resume" a failed step gets one
+        retry after a liveness refresh — the destination's transfer
+        ledger skips already-landed snapshots, so the retry only moves
+        what the first attempt missed. Under "abort" the first failure
+        aborts the job."""
+        attempts = 2 if self.resize_resume_policy == "resume" else 1
+        last: Optional[ClientError] = None
+        for attempt in range(attempts):
+            try:
+                if n.id == self.node.id:
+                    res = self.resize_stream(
+                        job["id"],
+                        new_nodes,
+                        replica_n=replica_n,
+                        old_nodes=[Node.from_json(m) for m in old_json],
+                        old_replica_n=old_replica_n,
+                        post_commit=post_commit,
+                    )
+                else:
+                    res = self.client.resize_stream(
+                        n.uri,
+                        job["id"],
+                        [m.to_json() for m in new_nodes],
+                        old_nodes=old_json,
+                        replica_n=replica_n,
+                        old_replica_n=old_replica_n,
+                        schema=self.api.schema() if joining else None,
+                        post_commit=post_commit,
+                    )
+                # accumulate across sweeps: the post-install drain re-runs
+                # this step with every leg resumed (fetched=0), and an
+                # overwrite would erase the first sweep's counts from the
+                # operator-facing job record
+                ent = job.setdefault("transfers", {}).setdefault(
+                    n.id, {"fetched": 0, "deltas": 0}
+                )
+                ent["fetched"] += int(res.get("fetched", 0))
+                ent["deltas"] += int(res.get("deltas", 0))
+                moved = job.setdefault("moved", [])
+                for iname, shards in (res.get("shards") or {}).items():
+                    for s in shards:
+                        ent = [iname, int(s), n.id]
+                        if ent not in moved:  # sweep re-reports the same legs
+                            moved.append(ent)
+                return
+            except ClientError as e:
+                last = e
+                self.logger(
+                    f"resize stream step on {n.id} failed "
+                    f"(attempt {attempt + 1}/{attempts}): {e}"
+                )
+                if attempt + 1 < attempts:
+                    self.probe_peers()
+                    try:
+                        # direct probe: closes the node's breaker if it is
+                        # actually healthy (probe_peers only covers
+                        # members, and the failed step may have opened it)
+                        self.client.status(n.uri, timeout=2.0, probe=True)
+                    except ClientError:
+                        pass
+                    if self._resize_abort.is_set():
+                        raise _ResizeAborted()
+        raise last
+
+    def _broadcast_transfer_msg(self, nodes: List[Node], msg: dict) -> None:
+        """Best-effort delivery of a transfer-plane teardown message to a
+        node set (self handled locally); duplicates are deduped by id."""
+        seen: set = set()
+        for n in nodes:
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            if n.id == self.node.id:
+                try:
+                    self.api.receive_message(dict(msg))
+                except Exception as e:  # noqa: BLE001 - teardown best-effort
+                    self.logger(f"{msg.get('type')} locally: {e}")
+                continue
+            try:
+                self.client.send_message(n.uri, msg, timeout=10.0)
+            except ClientError as e:
+                self.logger(f"{msg.get('type')} to {n.id}: {e}")
 
     def _send_status(
         self,

@@ -83,6 +83,16 @@ STAT_NAMES = frozenset(
         "hbm.pinned_bytes",
         "hbm.restage_bytes",
         "hbm.prefetch_hits",
+        # live elastic resize (server/node.py streaming resharding):
+        # per-fragment transfer legs, delta catch-up volume, cutover
+        # latency and aborted jobs
+        "resize.fragments_streamed",
+        "resize.bytes_streamed",
+        "resize.delta_positions",
+        "resize.catchup_rounds",
+        "resize.cutover_ms",
+        "resize.cutover_rejects",
+        "resize.aborts",
     }
 )
 

@@ -73,6 +73,12 @@ SPAN_NAMES = frozenset(
         # one per-peer fan-out leg, with retry/breaker outcome tags
         # (exec/distributed.py; server/client.py tags rpc.retries)
         "rpc.leg",
+        # streaming resize (server/node.py): one fragment transfer leg
+        # (snapshot fetch or ledger-resumed catch-up) on the destination
+        "resize.transfer",
+        # the coordinator's atomic topology cutover: schema refresh to
+        # joiners + the required-ack install broadcast
+        "resize.cutover",
     }
 )
 

@@ -51,6 +51,12 @@ def main() -> int:
             )
             resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
             assert resp["results"] == [2], resp
+        # the resize-job record must scrape as well-formed JSON on a live
+        # node (operators poll it during elastic resizes; an idle node
+        # reports NONE)
+        with urllib.request.urlopen(f"{uri}/cluster/resize/job", timeout=10) as r:
+            job = json.loads(r.read())
+        assert job.get("state") == "NONE", f"unexpected resize job: {job}"
         with urllib.request.urlopen(f"{uri}/metrics", timeout=10) as r:
             text = r.read().decode()
     finally:
