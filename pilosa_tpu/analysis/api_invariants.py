@@ -68,6 +68,7 @@ _SECTION_CLASSES = {
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",
     "TracingConfig": "tracing",
+    "TelemetryConfig": "telemetry",
     "TLSConfig": "tls",
 }
 
@@ -75,12 +76,21 @@ _SECTION_CLASSES = {
 def _stats_receiver(call: ast.Call) -> bool:
     """True when the call target reads like a StatsClient emission:
     `stats.count(...)`, `self.stats.timing(...)`,
-    `self.server.stats.count(...)`."""
+    `self.server.stats.count(...)`, or the inline labeled-family form
+    `self.stats.with_tags("index:a").gauge(...)` (the child client is
+    ephemeral — the emission still must name a declared stat)."""
     fn = call.func
     if not isinstance(fn, ast.Attribute) or fn.attr not in _EMIT_METHODS:
         return False
-    recv = dotted_name(fn.value)
-    return recv is not None and recv.split(".")[-1] == "stats"
+    recv = fn.value
+    if (
+        isinstance(recv, ast.Call)
+        and isinstance(recv.func, ast.Attribute)
+        and recv.func.attr == "with_tags"
+    ):
+        recv = recv.func.value
+    name = dotted_name(recv)
+    return name is not None and name.split(".")[-1] == "stats"
 
 
 class ApiInvariantsPass(Pass):
@@ -212,6 +222,89 @@ class ApiInvariantsPass(Pass):
                     ),
                 )
             )
+        self._check_labels(stats_mod, names, prefixes, findings)
+
+    @staticmethod
+    def _declared_labels(
+        stats_mod: Module,
+    ) -> Tuple[Dict[str, Tuple[str, ...]], int]:
+        """Parse the STAT_LABELS literal: family name -> label-key tuple
+        (tools/prom_lint.py loads the runtime dict; the gate checks the
+        declaration itself stays coherent)."""
+        labels: Dict[str, Tuple[str, ...]] = {}
+        line = 1
+        for stmt in stats_mod.tree.body:
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+                if isinstance(stmt, ast.AnnAssign) and stmt.value is not None
+                else []
+            )
+            if not (
+                len(targets) == 1
+                and isinstance(targets[0], ast.Name)
+                and targets[0].id == "STAT_LABELS"
+                and isinstance(stmt.value, ast.Dict)
+            ):
+                continue
+            line = stmt.lineno
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ):
+                    continue
+                keys = tuple(
+                    e.value
+                    for e in ast.walk(v)
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+                labels[k.value] = keys
+        return labels, line
+
+    def _check_labels(
+        self,
+        stats_mod: Module,
+        names: Set[str],
+        prefixes: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        """API008: every labeled family in STAT_LABELS must name a
+        DECLARED stat with a non-empty label-key set — a typo'd family
+        name would make prom_lint enforce labels on a series nobody
+        emits while the real family renders unchecked."""
+        labels, line = self._declared_labels(stats_mod)
+        for family, keys in sorted(labels.items()):
+            declared = family in names or any(
+                family.startswith(p) for p in prefixes
+            )
+            if not declared:
+                findings.append(
+                    Finding(
+                        code="API008",
+                        path=stats_mod.rel,
+                        line=line,
+                        message=(
+                            f"STAT_LABELS entry {family!r} is not a "
+                            "declared stat (STAT_NAMES/STAT_PREFIXES) — "
+                            "labeled-family rule would never match"
+                        ),
+                    )
+                )
+            if not keys:
+                findings.append(
+                    Finding(
+                        code="API008",
+                        path=stats_mod.rel,
+                        line=line,
+                        message=(
+                            f"STAT_LABELS entry {family!r} declares no "
+                            "label keys — an empty label set means the "
+                            "family is unlabeled; remove the entry"
+                        ),
+                    )
+                )
 
     # -- span-name registry ------------------------------------------------
 

@@ -103,6 +103,17 @@ class TracingConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    # cluster telemetry plane (server/telemetry.py;
+    # docs/observability.md "Cluster telemetry"): the always-on
+    # utilization timeline sampler behind /debug/timeline — each tick
+    # also refreshes the devcache/HBM gauges so statsd backends see
+    # them without an HTTP scrape
+    sample_interval: float = 5.0  # seconds between samples; 0 disables
+    ring: int = 720  # utilization samples kept per node (~1h at 5s)
+
+
+@dataclass
 class TLSConfig:
     # Serve the whole HTTP plane (client API + internode) over TLS when
     # certificate+key are set (reference: server/config.go:151-157 TLS
@@ -135,6 +146,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
 
     # -- sources -----------------------------------------------------------
@@ -210,6 +222,7 @@ class Config:
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
             ("tracing", self.tracing),
+            ("telemetry", self.telemetry),
             ("tls", self.tls),
         ):
             out.append(f"\n[{sect_name}]")

@@ -74,6 +74,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "(/debug/traces)",
     )
     sp.add_argument(
+        "--telemetry-sample-interval", type=float,
+        help="utilization-timeline sampler tick seconds (each tick also "
+        "refreshes the residency gauges; 0 disables the sampler)",
+    )
+    sp.add_argument(
+        "--telemetry-ring", type=int,
+        help="utilization samples kept in the per-node /debug/timeline "
+        "ring",
+    )
+    sp.add_argument(
         "--retry-max-attempts", type=int,
         help="internode RPC attempts within one deadline budget",
     )
@@ -242,6 +252,8 @@ _FLAG_KNOBS = {
     "tracing_enabled": ("tracing", "enabled"),
     "tracing_sample_rate": ("tracing", "sample_rate"),
     "tracing_ring": ("tracing", "ring"),
+    "telemetry_sample_interval": ("telemetry", "sample_interval"),
+    "telemetry_ring": ("telemetry", "ring"),
     "tls_certificate": ("tls", "certificate"),
     "tls_key": ("tls", "key"),
     "tls_skip_verify": ("tls", "skip_verify"),
@@ -380,6 +392,8 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         tracing_enabled=cfg.tracing.enabled,
         trace_sample_rate=cfg.tracing.sample_rate,
         trace_ring=cfg.tracing.ring,
+        telemetry_sample_interval=cfg.telemetry.sample_interval,
+        telemetry_ring=cfg.telemetry.ring,
         long_query_time=cfg.long_query_time,
         logger=new_logger(verbose=cfg.verbose, stream=log_stream),
         tls_cert=os.path.expanduser(cfg.tls.certificate) if cfg.tls.certificate else "",

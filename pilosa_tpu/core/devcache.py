@@ -44,7 +44,7 @@ import os
 import time
 from collections import OrderedDict
 from contextlib import contextmanager
-from typing import Callable, Dict, Hashable, Iterable, Set, Tuple
+from typing import Callable, Dict, Hashable, Iterable, Optional, Set, Tuple
 
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 
@@ -119,6 +119,14 @@ class DeviceCache:
         # whose coverage contains the dirty shard — entries with no
         # recorded coverage are dropped conservatively
         self._cover: Dict[Tuple, frozenset] = {}
+        # per-index attribution: insert sites tag each entry with the
+        # index that owns it (fragment rows, view stacks, hbm extents all
+        # know their index name); entries staged outside any index fall
+        # into the "-" bucket so index_resident_bytes() always sums to
+        # the global ledger byte-for-byte. The map lives and dies with
+        # the entry (zombie bytes keep theirs until the last unpin), so
+        # index churn cannot leak attribution state.
+        self._key_index: Dict[Tuple, str] = {}
         # eviction-deferral sessions (deferred_eviction): while a query's
         # lowering stages its operand set, evicting to make room for
         # operand K must not take operand K+1's resident extents — LRU's
@@ -152,14 +160,29 @@ class DeviceCache:
             return arr
 
     def put(
-        self, key: Tuple, arr, *, extent: bool = False, shards=None
+        self,
+        key: Tuple,
+        arr,
+        *,
+        extent: bool = False,
+        shards=None,
+        index: Optional[str] = None,
     ) -> None:
         nb = _nbytes(arr)
         with self._mu:
-            self._put_locked(key, arr, nb, extent=extent, shards=shards)
+            self._put_locked(
+                key, arr, nb, extent=extent, shards=shards, index=index
+            )
 
     def _put_locked(
-        self, key: Tuple, arr, nb: int, *, extent: bool, shards=None
+        self,
+        key: Tuple,
+        arr,
+        nb: int,
+        *,
+        extent: bool,
+        shards=None,
+        index: Optional[str] = None,
     ) -> None:
         if key in self._entries:
             # replace: the old bytes leave the ledger even if pinned (the
@@ -174,6 +197,8 @@ class DeviceCache:
             self._extent_keys.add(key)
         if shards is not None:
             self._cover[key] = frozenset(shards)
+        if index is not None:
+            self._key_index[key] = index
         self._bytes += nb
         self._evict_locked(keep=key)
 
@@ -185,6 +210,7 @@ class DeviceCache:
         extent: bool = False,
         pin: bool = False,
         shards=None,
+        index: Optional[str] = None,
     ):
         """Return the cached array for `key`, building it at most once
         process-wide even under concurrent callers (single-flight). With
@@ -228,7 +254,9 @@ class DeviceCache:
             )
         with self._mu:
             self._building.discard(key)
-            self._put_locked(key, arr, nb, extent=extent, shards=shards)
+            self._put_locked(
+                key, arr, nb, extent=extent, shards=shards, index=index
+            )
             if pin:
                 self._pin_locked(key)
             self._build_cv.notify_all()
@@ -294,6 +322,7 @@ class DeviceCache:
             self._by_owner.clear()
             self._extent_keys.clear()
             self._cover.clear()
+            self._key_index.clear()
             self._pins.clear()
             self._pin_t0.clear()
             self._zombies.clear()
@@ -344,6 +373,8 @@ class DeviceCache:
                     # last pin on an invalidated entry: the in-flight
                     # operand is done with it — bytes leave the ledger now
                     self._bytes -= zb
+                    if key not in self._entries:
+                        self._key_index.pop(key, None)
                 if n == 1:
                     # unpinned entries become evictable: settle any debt
                     # deferred while the dispatch was in flight
@@ -388,10 +419,15 @@ class DeviceCache:
         nb = self._sizes.pop(key, 0)
         if not replacing and key in self._pins:
             # invalidated while an in-flight dispatch holds it: the array
-            # lives until the last unpin, so its bytes stay accounted
+            # lives until the last unpin, so its bytes stay accounted —
+            # and stay ATTRIBUTED (the index tag is released with the
+            # zombie bytes, not here, so per-index sums keep reconciling
+            # with the ledger while the operand is in flight)
             self._zombies[key] = self._zombies.get(key, 0) + nb
         else:
             self._bytes -= nb
+            if key not in self._zombies:
+                self._key_index.pop(key, None)
         self._extent_keys.discard(key)
         self._cover.pop(key, None)
         owner_keys = self._by_owner.get(key[0])
@@ -424,6 +460,41 @@ class DeviceCache:
     @property
     def bytes_used(self) -> int:
         return self._bytes
+
+    def index_resident_bytes(self) -> Dict[str, int]:
+        """Resident device bytes grouped by owning INDEX (the per-tenant
+        attribution the telemetry plane publishes as `hbm.resident_bytes`
+        with an `index:` label). Entries inserted without an index tag
+        land in "-"; zombie bytes (invalidated-while-pinned) keep their
+        attribution until the last unpin releases them. Invariant —
+        regression-tested under eviction pressure: the sum over every
+        bucket equals `bytes_used` byte-for-byte, because both are
+        computed from the same _sizes/_zombies ledgers under one lock
+        hold."""
+        with self._mu:
+            out: Dict[str, int] = {}
+            for key, nb in self._sizes.items():
+                idx = self._key_index.get(key, "-")
+                out[idx] = out.get(idx, 0) + nb
+            for key, nb in self._zombies.items():
+                idx = self._key_index.get(key, "-")
+                out[idx] = out.get(idx, 0) + nb
+            return out
+
+    def drop_index_attribution(self, index: str) -> None:
+        """Label GC for a deleted index: re-bucket any surviving
+        attribution — zombie bytes still held by an in-flight dispatch's
+        pins — into "-". Without this, the tick after
+        drop_index_telemetry would re-create the dropped per-index gauge
+        series from the zombie entry and the label would live at 0
+        forever. The per-index sum still equals the global ledger; the
+        orphaned bytes just report as unattributed until the last unpin
+        releases them."""
+        with self._mu:
+            for key in [
+                k for k, v in self._key_index.items() if v == index
+            ]:
+                del self._key_index[key]
 
     def owner_resident_bytes(self, owner: Hashable) -> int:
         """Resident bytes cached under one owner token (the admission

@@ -474,6 +474,7 @@ class Fragment:
             return DEVICE_CACHE.get_or_build(
                 (self._token, row_id),
                 lambda: jax.device_put(self.row_words(row_id)),
+                index=self.index,
             )
 
     def rows_device(self, row_ids: Iterable[int]) -> jax.Array:
@@ -488,6 +489,7 @@ class Fragment:
                     if ids
                     else np.empty((0, SHARD_WIDTH // 32), np.uint32)
                 ),
+                index=self.index,
             )
 
     def contains(self, row_id: int, col: int) -> bool:

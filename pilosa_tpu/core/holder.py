@@ -133,6 +133,13 @@ class Holder:
                     for frag in list(v.fragments.values()):
                         yield frag
 
+    def staged_position_count(self) -> int:
+        """WAL-staged write positions not yet merged into row stores
+        (the bulk-ingest fast path defers merges to read barriers). A
+        large, growing value means readers are starved or ingest has
+        outrun the merge — /cluster/health surfaces it as staging debt."""
+        return sum(frag._pending_n for frag in self.fragments())
+
     def flush_caches(self) -> None:
         """Persist every fragment's rank cache (reference: holder.go:506
         monitorCacheFlush ticker)."""

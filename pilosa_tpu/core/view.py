@@ -62,6 +62,12 @@ class View:
         with self._mu:
             for frag in self.fragments.values():
                 frag.close()
+            # drop the view-level device stacks (row/plane stacks, TopN
+            # tally bundles — all keyed under _stack_token): a deleted
+            # index's arrays must leave the device ledger, and their
+            # per-index attribution must not resurrect the label after
+            # telemetry GC
+            DEVICE_CACHE.invalidate_owner(self._stack_token)
 
     def _fragment_path(self, shard: int) -> Optional[str]:
         if self.path is None:
@@ -171,6 +177,7 @@ class View:
         return hbm_res.stage_row_stack(
             key, len(shards), build_slice, table=extents,
             versions=self._frag_versions(frags), shards=shards,
+            index=self.index,
         )
 
     def stage_bulk(self, shards: np.ndarray, positions: np.ndarray) -> None:
@@ -242,6 +249,7 @@ class View:
         return hbm_res.stage_plane_stack(
             key, len(shards), build_slice, table=extents,
             versions=self._frag_versions(frags), shards=shards,
+            index=self.index,
         )
 
     # -- fan-down helpers (view.go:367-474) --------------------------------

@@ -2289,7 +2289,9 @@ class Executor:
             "topn_sparse", tuple(cand), tuple(s for s, _ in present)
         )
         return DEVICE_CACHE.get_or_build(
-            key, lambda: self._topn_tally_build(cand, present, w)
+            key,
+            lambda: self._topn_tally_build(cand, present, w),
+            index=view.index,
         )
 
     def _topn_tally_build(self, cand: List[int], present, w: int) -> "_TallyBundle":

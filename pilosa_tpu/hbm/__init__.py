@@ -18,6 +18,7 @@ operands while the current dispatch runs).
 from pilosa_tpu.hbm.residency import (
     ExtentTable,
     configure,
+    drop_index,
     extent_rows,
     prefetching,
     stage_row_stack,
@@ -30,6 +31,7 @@ __all__ = [
     "ExtentTable",
     "Prefetcher",
     "configure",
+    "drop_index",
     "extent_rows",
     "prefetching",
     "stage_row_stack",

@@ -56,6 +56,10 @@ _counters: Dict[str, int] = {
     "prefetch_hits": 0,  # query staging hit an extent the prefetcher warmed
     "prefetch_staged": 0,  # extents the prefetcher uploaded
 }
+# per-owner-index restage attribution ("-" collects staging not bound to
+# an index); dropped by drop_index() when the index is deleted so a
+# churning tenant set cannot leak counter entries
+_restage_by_index: Dict[str, int] = {}
 _prefetched_keys: set = set()
 
 _tls = threading.local()
@@ -87,19 +91,34 @@ def reset_stats() -> None:
     with _stats_mu:
         for k in _counters:
             _counters[k] = 0
+        _restage_by_index.clear()
         _prefetched_keys.clear()
+
+
+def drop_index(index: str) -> None:
+    """Label GC hook (NodeServer.drop_index_telemetry): forget a deleted
+    index's restage attribution so per-index counter entries cannot
+    accumulate across tenant churn. Also re-buckets the device cache's
+    residency attribution (zombie bytes pinned by an in-flight dispatch
+    would otherwise resurrect the dropped gauge series on the next
+    sampler tick)."""
+    with _stats_mu:
+        _restage_by_index.pop(index, None)
+    DEVICE_CACHE.drop_index_attribution(index)
 
 
 def stats_snapshot() -> Dict[str, int]:
     """hbm.* gauge values (NodeServer.publish_cache_gauges): residency
     comes from the shared device-cache ledger, traffic counters from this
-    module."""
+    module. `restage_by_index` splits the cumulative restage bytes by
+    owner index (values sum to `restage_bytes`)."""
     snap = DEVICE_CACHE.stats_snapshot()
     with _stats_mu:
         return {
             "resident_extents": snap["resident_extents"],
             "pinned_bytes": snap["pinned_bytes"],
             "restage_bytes": _counters["restage_bytes"],
+            "restage_by_index": dict(_restage_by_index),
             "prefetch_hits": _counters["prefetch_hits"],
             "prefetch_staged": _counters["prefetch_staged"],
             "evicted_extent_bytes": snap["evicted_extent_bytes"],
@@ -159,13 +178,20 @@ class ExtentTable:
 # ---------------------------------------------------------------------------
 
 
-def _note_upload(nbytes: int, key: Tuple, built: bool) -> None:
+def _note_upload(
+    nbytes: int, key: Tuple, built: bool, index: Optional[str] = None
+) -> None:
     """Book one extent acquisition: uploads count restage bytes; hits on
     prefetcher-staged extents count prefetch hits. Query-thread work also
     feeds the per-thread flight-recorder staging account (flushed into an
     exec.stage span by the dispatch that consumes the operands)."""
     if built:
         _bump("restage_bytes", nbytes)
+        label = index if index is not None else "-"
+        with _stats_mu:
+            _restage_by_index[label] = (
+                _restage_by_index.get(label, 0) + nbytes
+            )
         if _in_prefetch():
             _bump("prefetch_staged")
             with _stats_mu:
@@ -193,6 +219,7 @@ def _stage(
     table: Optional[ExtentTable],
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
+    index: Optional[str] = None,
 ):
     """Assemble one device operand from per-extent cache entries.
 
@@ -213,7 +240,7 @@ def _stage(
     try:
         return _stage_inner(
             key_base, n_shards, build_slice, shard_axis, table,
-            versions=versions, shards=shards,
+            versions=versions, shards=shards, index=index,
         )
     finally:
         # staging wall time feeds the flight recorder's per-thread
@@ -231,6 +258,7 @@ def _stage_inner(
     table: Optional[ExtentTable],
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
+    index: Optional[str] = None,
 ):
     import jax
 
@@ -250,9 +278,12 @@ def _stage_inner(
             return arr
 
         arr = DEVICE_CACHE.get_or_build(
-            key, build_all, extent=True, pin=True, shards=shards
+            key, build_all, extent=True, pin=True, shards=shards,
+            index=index,
         )
-        _note_upload(int(getattr(arr, "nbytes", 0)), key, bool(built))
+        _note_upload(
+            int(getattr(arr, "nbytes", 0)), key, bool(built), index=index
+        )
         if table is not None:
             table.add([key])
         else:
@@ -301,10 +332,12 @@ def _stage_inner(
                 arr = DEVICE_CACHE.get_or_build(
                     key, build, extent=True, pin=True,
                     shards=None if shards is None else shards[lo:hi],
+                    index=index,
                 )
                 held.append(key)
                 _note_upload(
-                    int(getattr(arr, "nbytes", 0)), key, bool(built)
+                    int(getattr(arr, "nbytes", 0)), key, bool(built),
+                    index=index,
                 )
             parts.append(arr)
     except BaseException:
@@ -329,11 +362,14 @@ def stage_row_stack(
     table: Optional[ExtentTable] = None,
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
+    index: Optional[str] = None,
 ):
-    """uint32[S, W] operand: extents slice axis 0 (the shard axis)."""
+    """uint32[S, W] operand: extents slice axis 0 (the shard axis).
+    `index` attributes the staged bytes to their owning index for the
+    per-tenant residency/restage telemetry."""
     return _stage(
         key_base, n_shards, build_slice, 0, table,
-        versions=versions, shards=shards,
+        versions=versions, shards=shards, index=index,
     )
 
 
@@ -344,11 +380,12 @@ def stage_plane_stack(
     table: Optional[ExtentTable] = None,
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
+    index: Optional[str] = None,
 ):
     """uint32[D, S, W] operand: extents slice axis 1; every extent carries
     all D planes for its shard range (one slice pages the whole magnitude
     ladder for those shards together — they are always used together)."""
     return _stage(
         key_base, n_shards, build_slice, 1, table,
-        versions=versions, shards=shards,
+        versions=versions, shards=shards, index=index,
     )

@@ -196,6 +196,13 @@ class AdmissionController:
         self._vglobal = 0.0
         self._inflight = 0
         self._inflight_bytes = 0
+        # per-index in-flight byte attribution (both lanes; key None =
+        # requests bound to no index, published under the "-" label):
+        # the telemetry plane needs "WHICH tenant holds the budget", not
+        # just how full it is. Drained entries stay at 0 so the sampler
+        # keeps republishing them; only index deletion (drop_index)
+        # removes a key.
+        self._inflight_bytes_index: Dict[Optional[str], int] = {}
         # EWMA of per-query service seconds (grant -> release), feeding
         # the early-shed deadline feasibility estimate (per lane: legs
         # run shard subsets, so their service time differs from whole
@@ -266,7 +273,9 @@ class AdmissionController:
         t0 = self._clock()
         deadline_at = t0 + deadline if deadline is not None else None
         if leg:
-            return self._admit_leg(cls, cost, deadline, deadline_at, t0)
+            return self._admit_leg(
+                cls, cost, deadline, deadline_at, t0, index
+            )
         shed_why: Optional[str] = None
         waited = 0.0
         with self._cv:
@@ -343,7 +352,7 @@ class AdmissionController:
                     shed_why = "deadline cannot be met in queue"
                 else:
                     waited = self._clock() - t0
-            gauges = self._gauge_values_locked()
+            gauges = self._gauge_values_locked(index)
         return self._finish_admit(
             cls, cost, shed_why, waited, batchable, index, t0, gauges
         )
@@ -355,6 +364,7 @@ class AdmissionController:
         deadline: Optional[float],
         deadline_at: Optional[float],
         t0: float,
+        index: Optional[str] = None,
     ) -> Ticket:
         """Internal fan-out legs: own concurrency lane (same cap and
         waiting bound, FIFO, deadline-aware) so legs never compete with
@@ -376,6 +386,7 @@ class AdmissionController:
                 # coordinator that is itself waiting on remote legs
                 # would recreate the cross-node hold-and-wait cycle
                 self._inflight_bytes += cost.device_bytes
+                self._bump_index_bytes_locked(index, cost.device_bytes)
             elif len(self._leg_waiters) >= self.max_queue_depth:
                 shed_why = "internal-leg queue full"
             elif deadline_at is not None and not self._leg_feasible_locked(
@@ -392,7 +403,7 @@ class AdmissionController:
                 # can never beat an earlier parked waiter to a freed
                 # slot — a steady stream would otherwise win every
                 # post-release race and starve waiters past deadline
-                entry = _Entry(cls, cost, deadline_at, t0)
+                entry = _Entry(cls, cost, deadline_at, t0, index=index)
                 self._leg_waiters.append(entry)
                 while not entry.granted and not entry.shed:
                     timeout = None
@@ -409,9 +420,9 @@ class AdmissionController:
                     shed_why = "deadline cannot be met in queue"
                 else:
                     waited = self._clock() - t0
-            gauges = self._gauge_values_locked()
+            gauges = self._gauge_values_locked(index)
         return self._finish_admit(
-            cls, cost, shed_why, waited, batchable=False, index=None,
+            cls, cost, shed_why, waited, batchable=False, index=index,
             t0=t0, gauges=gauges, leg=True,
         )
 
@@ -430,10 +441,13 @@ class AdmissionController:
         # stats I/O happens OUTSIDE the lock: with the statsd backend
         # every emission is a UDP sendto, and syscalls under sched.mu
         # would serialize ALL admission behind the metrics socket (the
-        # blocking-host-work-under-lock shape LOCK002 exists to reject)
+        # blocking-host-work-under-lock shape LOCK002 exists to reject).
+        # admit/shed/wait carry class AND index labels — per-tenant QoS
+        # attribution; "-" marks requests bound to no index (e.g. resize
+        # transfer serving) so the family's label set stays uniform.
         self._emit_gauges(gauges)
         stats = (
-            self.stats.with_tags(f"class:{cls}")
+            self.stats.with_tags(f"class:{cls}", f"index:{index or '-'}")
             if self.stats is not None
             else None
         )
@@ -466,6 +480,9 @@ class AdmissionController:
             head.granted = True
             self._inflight_leg += 1
             self._inflight_bytes += head.cost.device_bytes
+            self._bump_index_bytes_locked(
+                head.index, head.cost.device_bytes
+            )
         if touched:
             self._cv.notify_all()
 
@@ -474,6 +491,9 @@ class AdmissionController:
             with self._cv:
                 self._inflight_leg -= 1
                 self._inflight_bytes -= ticket.cost.device_bytes
+                self._bump_index_bytes_locked(
+                    ticket.index, -ticket.cost.device_bytes
+                )
                 dt = max(0.0, self._clock() - ticket.granted_at)
                 self._leg_svc_ewma = (
                     dt
@@ -484,13 +504,16 @@ class AdmissionController:
                 self._pump_legs_locked()
                 # freed leg bytes may unblock byte-gated PUBLIC heads
                 self._pump_locked()
-                gauges = self._gauge_values_locked()
+                gauges = self._gauge_values_locked(ticket.index)
                 self._cv.notify_all()
             self._emit_gauges(gauges)
             return
         with self._cv:
             self._inflight -= 1
             self._inflight_bytes -= ticket.cost.device_bytes
+            self._bump_index_bytes_locked(
+                ticket.index, -ticket.cost.device_bytes
+            )
             if ticket.batchable and not ticket._batch_done:
                 self._drop_batchable_locked(ticket.index)
             # learned service time drives the early-shed feasibility check
@@ -502,7 +525,7 @@ class AdmissionController:
             )
             self._svc_hist.observe(dt)
             self._pump_locked()
-            gauges = self._gauge_values_locked()
+            gauges = self._gauge_values_locked(ticket.index)
             self._cv.notify_all()
         self._emit_gauges(gauges)
 
@@ -587,6 +610,11 @@ class AdmissionController:
             return {
                 "inflight": self._inflight,
                 "inflightBytes": self._inflight_bytes,
+                "inflightBytesByIndex": {
+                    (k if k is not None else "-"): v
+                    for k, v in self._inflight_bytes_index.items()
+                    if v > 0
+                },
                 "inflightLegs": self._inflight_leg,
                 "waitingLegs": len(self._leg_waiters),
                 "queued": {
@@ -647,6 +675,7 @@ class AdmissionController:
     ) -> None:
         self._inflight += 1
         self._inflight_bytes += cost.device_bytes
+        self._bump_index_bytes_locked(index, cost.device_bytes)
         if batchable:
             self._inflight_batchable[index] = (
                 self._inflight_batchable.get(index, 0) + 1
@@ -757,20 +786,79 @@ class AdmissionController:
         rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
         return self._clock() + rounds * svc <= deadline_at
 
-    def _gauge_values_locked(self) -> tuple:
+    def _bump_index_bytes_locked(
+        self, index: Optional[str], delta: int
+    ) -> None:
+        """Per-index in-flight byte account (both lanes). A drained
+        index stays in the map at 0 (only drop_index removes keys): the
+        published gauge keeps landing back at 0 — via its own release's
+        emission and the sampler's periodic full-map publication —
+        instead of freezing at a stale non-zero value."""
+        if not delta:
+            return
+        cur = self._inflight_bytes_index.get(index)
+        if cur is None:
+            if delta < 0:
+                # release landing after drop_index (index deleted with
+                # this query in flight): re-inserting the key — even at
+                # 0 — would re-emit the gauge and resurrect the series
+                # the label GC just removed from the registry
+                return
+            cur = 0
+        self._inflight_bytes_index[index] = max(0, cur + delta)
+
+    def drop_index(self, index: str) -> None:
+        """Label GC hook (NodeServer.drop_index_telemetry): forget a
+        deleted index's byte-attribution entry. In-flight queries on the
+        deleted index decrement into an absent key afterwards, which the
+        max(0, ...) clamp absorbs."""
+        with self._cv:
+            self._inflight_bytes_index.pop(index, None)
+
+    def inflight_bytes_by_index(self) -> Dict[str, int]:
+        """Snapshot of per-index in-flight bytes (telemetry sampler)."""
+        with self._cv:
+            return {
+                (k if k is not None else "-"): v
+                for k, v in self._inflight_bytes_index.items()
+            }
+
+    def _gauge_values_locked(self, index: Optional[str]) -> tuple:
         # gauges cover BOTH lanes (like pending()): a node shedding legs
-        # with "internal-leg queue full" must not look idle on /metrics
+        # with "internal-leg queue full" must not look idle on /metrics.
+        # The per-index slot carries ONLY the event's index — the one
+        # whose bytes this admit/release moved — keeping the hot path
+        # O(1) under a wide tenant set (emitting the whole map was one
+        # statsd datagram PER LIVE INDEX per admission). A pump pass may
+        # move other indexes' bytes too; each of those is emitted by its
+        # own query's release, and the telemetry sampler publishes the
+        # full map every tick regardless.
+        per_index = {}
+        cur = self._inflight_bytes_index.get(index)
+        if cur is not None:
+            per_index[index if index is not None else "-"] = cur
+            # drained entries stay in the map AT 0 (pruned only by
+            # drop_index): emissions run outside the lock, so two
+            # concurrent releases can publish out of order and leave the
+            # gauge frozen at a stale nonzero — the sampler's full-map
+            # publication is the corrector, and it can only correct keys
+            # the map still holds
         return (
             self._queued_total_locked() + len(self._leg_waiters),
             self._inflight + self._inflight_leg,
             self._inflight_bytes,
+            per_index,
         )
 
     def _emit_gauges(self, vals: tuple) -> None:
         """Called WITHOUT the lock held (statsd emission is a syscall)."""
         if self.stats is None:
             return
-        queued, inflight, inflight_bytes = vals
+        queued, inflight, inflight_bytes, per_index = vals
         self.stats.gauge("sched.queue_depth", queued)
         self.stats.gauge("sched.inflight", inflight)
         self.stats.gauge("sched.inflight_bytes", inflight_bytes)
+        for idx, v in per_index.items():
+            self.stats.with_tags(f"index:{idx}").gauge(
+                "sched.index_inflight_bytes", v
+            )

@@ -451,6 +451,9 @@ class API:
             self.holder.delete_index(name)
         except KeyError:
             pass
+        # label GC: the deleted index's per-index metric series must not
+        # outlive it (a churning tenant set would leak gauge families)
+        self.server.drop_index_telemetry(name)
         if broadcast:
             self._broadcast({"type": "delete-index", "index": name})
 
@@ -966,8 +969,14 @@ class API:
             # replica writes dropped on this node's fan-outs, awaiting
             # anti-entropy repair (visible drift, ISSUE satellite #2)
             "pendingRepairs": self.holder.pending_repair_count(),
+            # WAL-staged write positions awaiting a read-barrier merge
+            # (bulk-ingest fast path); /cluster/health sums this across
+            # members as staging debt
+            "walStagedPositions": self.holder.staged_position_count(),
             # peer URI -> circuit state, so operators see shunned peers
             "breakers": breakers.snapshot() if breakers is not None else {},
+            # the structured cluster verdict lives one endpoint over
+            "health": "/cluster/health",
         }
 
     def hosts(self) -> List[dict]:
@@ -1092,6 +1101,7 @@ class API:
                 self.holder.delete_index(msg["index"])
             except KeyError:
                 pass
+            self.server.drop_index_telemetry(msg["index"])
         elif t == "create-field":
             idx = self.holder.index(msg["index"])
             if idx is not None:

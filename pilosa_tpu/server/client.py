@@ -375,6 +375,21 @@ class InternalClient:
         channel for DDL a node missed while DOWN)."""
         self._json("POST", uri, "/schema", json.dumps({"indexes": schema}).encode())
 
+    def node_stats(self, uri: str, timeout: float = 5.0) -> dict:
+        """One peer's mergeable registry export (GET /internal/stats) —
+        the federated rollup's pull path. Short default timeout: a dead
+        peer must degrade the rollup to its cached snapshot quickly, and
+        the per-peer breaker fast-fails repeat offenders."""
+        return self._json(
+            "GET", uri, "/internal/stats", timeout=timeout
+        ) or {}
+
+    def node_timeline(self, uri: str, timeout: float = 5.0) -> dict:
+        """One peer's utilization timeline ring (GET /debug/timeline)."""
+        return self._json(
+            "GET", uri, "/debug/timeline", timeout=timeout
+        ) or {}
+
     def status(
         self, uri: str, timeout: Optional[float] = None, probe: bool = False
     ) -> dict:

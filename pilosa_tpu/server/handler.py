@@ -372,6 +372,56 @@ class Handler(BaseHTTPRequestHandler):
         reg = getattr(self.node.stats, "registry", None)
         self._reply(reg.snapshot() if reg is not None else {})
 
+    @route("GET", "/debug/timeline")
+    def get_debug_timeline(self):
+        """This node's utilization timeline ring (server/telemetry.py
+        TimelineSampler): periodic snapshots of HBM residency, queue
+        depth, in-flight bytes, ingest/query rates, and resize phase.
+        `?sample=1` forces a fresh sample first (deterministic tests and
+        point-in-time reads; the background ticker appends the rest)."""
+        if self._bool_param("sample"):
+            self.node.telemetry.sampler.sample_once()
+        self._reply(self.node.telemetry.sampler.snapshot())
+
+    @route("GET", "/internal/stats")
+    def get_internal_stats(self):
+        """Mergeable registry export for the federated rollup (raw
+        histogram buckets included, so /cluster/metrics merges them
+        bucket-wise into true cluster quantiles)."""
+        self._reply(self.node.telemetry.local_stats_export())
+
+    @route("GET", "/cluster/metrics")
+    def get_cluster_metrics(self):
+        """Prometheus exposition of the CLUSTER-merged registry: every
+        member's counters/gauges summed, histograms merged bucket-wise
+        (exact — shared bounds), down peers degraded to their last
+        snapshot with `cluster.peer_stale{node=...} 1` markers."""
+        text = self.node.telemetry.cluster_metrics_text()
+        self._reply(
+            None, raw=text.encode(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    @route("GET", "/cluster/overview")
+    def get_cluster_overview(self):
+        """Per-node and per-index rollup JSON (queries, real merged
+        p50/p99, ingest bits, HBM residency, in-flight bytes) with
+        staleness markers for unreachable peers."""
+        self._reply(self.node.telemetry.cluster_overview())
+
+    @route("GET", "/cluster/timeline")
+    def get_cluster_timeline(self):
+        """Every member's /debug/timeline ring grouped by node (dead
+        peers degrade to their cached ring, stale-marked)."""
+        self._reply(self.node.telemetry.cluster_timeline())
+
+    @route("GET", "/cluster/health")
+    def get_cluster_health(self):
+        """Structured health rollup: ok | degraded | critical with the
+        reasons (peer reachability, breakers, repair debt, resize phase,
+        WAL staging depth)."""
+        self._reply(self.node.telemetry.cluster_health())
+
     @route("GET", "/debug/traces")
     def get_debug_traces(self):
         """Flat span ring by default; `?trace=<id>` assembles that

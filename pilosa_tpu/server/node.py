@@ -100,6 +100,8 @@ class NodeServer:
         tracing_enabled: bool = True,  # sample root spans at all
         trace_sample_rate: float = 1.0,  # fraction of root queries traced
         trace_ring: int = 1024,  # spans kept in the per-node ring
+        telemetry_sample_interval: float = 5.0,  # timeline tick, s; 0=off
+        telemetry_ring: int = 720,  # utilization samples kept per node
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -252,6 +254,16 @@ class NodeServer:
         from pilosa_tpu.server.profiling import QueryProfiler
 
         self.profiler = QueryProfiler()
+        # cluster telemetry plane (server/telemetry.py): the always-on
+        # utilization timeline sampler plus the /cluster/* federation
+        # (metrics rollup, overview, health, merged timeline)
+        from pilosa_tpu.server.telemetry import Telemetry
+
+        self.telemetry_sample_interval = float(telemetry_sample_interval)
+        self.telemetry = Telemetry(
+            self, telemetry_sample_interval, telemetry_ring
+        )
+        self._telemetry_thread = None
         self._httpd = None
         self._http_thread = None
         self._ae_thread = None
@@ -475,7 +487,24 @@ class NodeServer:
                 target=self._runtime_poll_loop, daemon=True
             )
             self._runtime_thread.start()
+        if self.telemetry_sample_interval > 0:
+            self._telemetry_thread = threading.Thread(
+                target=self._telemetry_loop,
+                name=f"telemetry-{self.node.id}",
+                daemon=True,
+            )
+            self._telemetry_thread.start()
         return self
+
+    def _telemetry_loop(self) -> None:
+        """Always-on utilization timeline ticker: refresh residency
+        gauges (statsd backends see them without an HTTP scrape) and
+        append one sample to the /debug/timeline ring per interval."""
+        while not self._closing.wait(self.telemetry_sample_interval):
+            try:
+                self.telemetry.sampler.sample_once()
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self._ticker_error("telemetry", e)
 
     def publish_cache_gauges(self) -> None:
         """Refresh device-cache residency gauges at scrape time (the
@@ -497,8 +526,53 @@ class NodeServer:
         hsnap = hbmmod.stats_snapshot()
         self.stats.gauge("hbm.resident_extents", hsnap["resident_extents"])
         self.stats.gauge("hbm.pinned_bytes", hsnap["pinned_bytes"])
-        self.stats.gauge("hbm.restage_bytes", hsnap["restage_bytes"])
         self.stats.gauge("hbm.prefetch_hits", hsnap["prefetch_hits"])
+        # per-index attribution (the telemetry-plane families): who owns
+        # the resident bytes, and who has been paying the restage bill.
+        # hbm.resident_bytes sums over labels to the global devcache
+        # ledger byte-for-byte ("-" = entries owned by no index);
+        # hbm.restage_bytes likewise splits the cumulative upload bytes.
+        by_index = DEVICE_CACHE.index_resident_bytes()
+        # an index whose residency drained to zero must PUBLISH the zero
+        # (a gauge frozen at its last nonzero value would break the
+        # per-index == global-ledger reconciliation); once zeroed the
+        # label leaves the working set (index deletion GCs the series)
+        stale = getattr(self, "_hbm_idx_published", set()) - set(by_index)
+        self._hbm_idx_published = set(by_index)
+        for idx, nb in by_index.items():
+            self.stats.with_tags(f"index:{idx}").gauge(
+                "hbm.resident_bytes", nb
+            )
+        for idx in stale:
+            self.stats.with_tags(f"index:{idx}").gauge(
+                "hbm.resident_bytes", 0
+            )
+        for idx, nb in hsnap["restage_by_index"].items():
+            self.stats.with_tags(f"index:{idx}").gauge(
+                "hbm.restage_bytes", nb
+            )
+        if self.scheduler is not None:
+            for idx, nb in self.scheduler.inflight_bytes_by_index().items():
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "sched.index_inflight_bytes", nb
+                )
+
+    def drop_index_telemetry(self, index: str) -> None:
+        """Label GC for a deleted index: remove every per-index metric
+        series and attribution entry so a churning tenant set cannot
+        leak gauge families (regression-tested: create/delete 100
+        indexes returns the registry's family count to baseline)."""
+        reg = getattr(self.stats, "registry", None)
+        if reg is not None:
+            reg.drop_label("index", index)
+        from pilosa_tpu import hbm as hbmmod
+
+        hbmmod.drop_index(index)
+        if self.scheduler is not None:
+            self.scheduler.drop_index(index)
+        published = getattr(self, "_hbm_idx_published", None)
+        if published is not None:
+            published.discard(index)
 
     def _ticker_error(self, ticker: str, exc: BaseException) -> None:
         """Background tickers must survive any failure, but never silently:

@@ -1,0 +1,559 @@
+"""Cluster telemetry plane: utilization timeline + federated rollup.
+
+Three pieces, one operator story ("what is the CLUSTER doing right now,
+and which index is doing it"):
+
+- TimelineSampler — a lightweight always-on per-node sampler: every
+  `[telemetry] sample-interval` seconds it refreshes the residency
+  gauges (so statsd backends see them without an HTTP scrape — they
+  used to refresh only inside /metrics handlers) and appends one
+  utilization snapshot (HBM resident/pinned bytes, queue depth,
+  in-flight bytes, ingest bits/s, query/s, resize phase) to a bounded
+  ring served at `GET /debug/timeline`. The ring is the machine-readable
+  pressure trace the mixed read/write bench and the resize soak read.
+
+- Federated rollup — `GET /cluster/metrics` and `GET /cluster/overview`
+  pull every peer's registry over the internal JSON stats endpoint
+  (`GET /internal/stats`, riding the retry/breaker/deadline plane in
+  server/client.py), merge counters and gauges by SUM and the
+  fixed-log-bucket histograms BUCKET-WISE — exact, because every node
+  shares utils/stats.py HIST_BOUNDS — so cluster p50/p99 are real
+  quantiles of the union of samples, not averages of per-node averages.
+  A down peer degrades to its last snapshot with a staleness marker
+  (`cluster.peer_stale{node=...} 1` / `"stale": true`), never a 500.
+
+- `GET /cluster/health` — a structured rollup of signals the system
+  already tracks (peer reachability, breaker states, pending-repair
+  debt, resize job phase, WAL staging depth) folded into one
+  `status: ok | degraded | critical` with human-readable reasons.
+
+The reference ships the same operator plane as per-index tagged stat
+clients plus cluster diagnostics (holder.go stats, PAPER.md L3/L4);
+here the rollup is pull-based over the existing internode client.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.stats import Registry
+
+# peer stats/timeline fetches are interactive-dashboard traffic: fail
+# fast and degrade to the cached snapshot rather than hang an operator
+_PEER_TIMEOUT = 5.0
+_PROBE_TIMEOUT = 2.0
+
+
+def _fan_out(members, fn) -> list:
+    """One fn(member) result per member, fetched concurrently. fn must
+    degrade to None itself (the error contract — ClientError OR a
+    malformed 200 body — lives with each caller's closure)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if len(members) <= 1:
+        return [fn(n) for n in members]
+    with ThreadPoolExecutor(max_workers=min(16, len(members))) as pool:
+        return list(pool.map(fn, members))
+
+
+class TimelineSampler:
+    """Bounded ring of periodic utilization snapshots for ONE node.
+
+    `sample_once` is safe to call from the ticker thread, the HTTP
+    handler (tests/ops force a fresh point), or the smoke harness; the
+    ring and rate bookkeeping sit behind their own mutex. Rates
+    (ingest bits/s, query/s) are derived from the registry's cumulative
+    counters between consecutive samples, so a scrape-less deployment
+    still gets real throughput numbers."""
+
+    def __init__(self, server, interval: float, ring: int):
+        self._server = server
+        self.interval = float(interval)
+        self._mu = TrackedLock("telemetry.sampler_mu")
+        self._ring: "deque[dict]" = deque(maxlen=max(1, int(ring)))
+        self._prev_t: Optional[float] = None
+        self._prev_ingest = 0.0
+        self._prev_queries = 0.0
+
+    def _rate(self, cur: float, prev: float, dt: float) -> float:
+        if dt <= 0:
+            return 0.0
+        return max(0.0, (cur - prev) / dt)
+
+    def sample_once(self) -> dict:
+        """Refresh the residency gauges, then record one snapshot."""
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+        from pilosa_tpu import hbm as hbmmod
+
+        srv = self._server
+        # satellite fix: gauge refresh now rides the sampler tick, so
+        # statsd backends and the timeline see devcache/HBM gauges
+        # without anyone scraping /metrics (scrapes still refresh too)
+        srv.publish_cache_gauges()
+        dsnap = DEVICE_CACHE.stats_snapshot()
+        hsnap = hbmmod.stats_snapshot()
+        sched = srv.scheduler
+        ssnap = sched.snapshot() if sched is not None else {}
+        reg = getattr(srv.stats, "registry", None)
+        ingest = reg.total_counter("ingest.bits") if reg is not None else 0.0
+        queries = reg.total_counter("query_n") if reg is not None else 0.0
+        job = srv.resize_job or {}
+        phase = (
+            job.get("phase", "") if job.get("state") == "RUNNING" else ""
+        )
+        now_mono = time.monotonic()
+        sample = {
+            "t": time.time(),
+            "hbmResidentBytes": dsnap["resident_bytes"],
+            "hbmPinnedBytes": dsnap["pinned_bytes"],
+            "hbmResidentExtents": dsnap["resident_extents"],
+            "devcacheEntries": dsnap["entries"],
+            "restageBytes": hsnap["restage_bytes"],
+            "queueDepth": sum(ssnap.get("queued", {}).values())
+            + ssnap.get("waitingLegs", 0),
+            "inflight": ssnap.get("inflight", 0)
+            + ssnap.get("inflightLegs", 0),
+            "inflightBytes": ssnap.get("inflightBytes", 0),
+            "inflightBytesByIndex": ssnap.get("inflightBytesByIndex", {}),
+            "ingestBits": ingest,
+            "queries": queries,
+            "resizePhase": phase,
+            "walStagedPositions": srv.holder.staged_position_count(),
+        }
+        with self._mu:
+            dt = (
+                now_mono - self._prev_t
+                if self._prev_t is not None
+                else 0.0
+            )
+            sample["ingestBitsPerS"] = self._rate(
+                ingest, self._prev_ingest, dt
+            )
+            sample["queriesPerS"] = self._rate(
+                queries, self._prev_queries, dt
+            )
+            self._prev_t = now_mono
+            self._prev_ingest = ingest
+            self._prev_queries = queries
+            self._ring.append(sample)
+        return sample
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "node": self._server.node.id,
+                "intervalS": self.interval,
+                "samples": list(self._ring),
+            }
+
+
+class Telemetry:
+    """Per-node telemetry plane owner: the timeline sampler plus the
+    coordinator-side federation (any node can serve /cluster/* — the
+    rollup pulls from whatever membership it currently sees)."""
+
+    def __init__(self, server, sample_interval: float, ring: int):
+        self._server = server
+        self.sampler = TimelineSampler(server, sample_interval, ring)
+        self._peer_mu = TrackedLock("telemetry.peer_mu")
+        # node id -> {"stats": export_state payload, "at": epoch seconds}
+        # — the stale-peer degradation cache: a peer that stops answering
+        # keeps contributing its last known snapshot, marked stale
+        self._peer_cache: Dict[str, dict] = {}
+        self._timeline_cache: Dict[str, dict] = {}
+
+    # -- local surface -----------------------------------------------------
+
+    def local_stats_export(self) -> dict:
+        """Payload of GET /internal/stats: this node's registry in the
+        mergeable wire shape (raw histogram buckets included)."""
+        srv = self._server
+        srv.publish_cache_gauges()
+        reg = getattr(srv.stats, "registry", None)
+        return {
+            "node": srv.node.id,
+            "collectedAt": time.time(),
+            "stats": reg.export_state() if reg is not None else None,
+        }
+
+    # -- peer collection ---------------------------------------------------
+
+    def _collect_rows(self) -> List[dict]:
+        """One row per cluster member: fresh stats where reachable, the
+        cached last snapshot (stale-marked) where not. Peer fetches run
+        concurrently; a fully dead peer with no cache contributes
+        metadata only."""
+        from pilosa_tpu.server.client import ClientError
+
+        srv = self._server
+        members = list(srv.cluster.nodes)
+        now = time.time()
+
+        def fetch(n) -> Optional[dict]:
+            if n.id == srv.node.id:
+                return self.local_stats_export()
+            try:
+                got = srv.client.node_stats(n.uri, timeout=_PEER_TIMEOUT)
+            except (ClientError, ValueError):
+                # ValueError covers a malformed 200 body (a peer behind a
+                # proxy or mid-restart): degrade to the cached snapshot,
+                # never 500 the rollup
+                return None
+            # shape guard — a proxy can answer 200 with ANY valid JSON
+            # (an array, a quoted string); only a dict whose "stats" is
+            # a mergeable dict may reach the merge or the cache
+            if not isinstance(got, dict) or not isinstance(
+                got.get("stats"), dict
+            ):
+                return None
+            return got
+
+        fetched = _fan_out(members, fetch)
+        rows: List[dict] = []
+        with self._peer_mu:
+            for n, got in zip(members, fetched):
+                if got is not None and got.get("stats") is not None:
+                    at = got.get("collectedAt", now)
+                    self._peer_cache[n.id] = {
+                        "stats": got["stats"],
+                        # ageS arithmetic needs a number; a garbled
+                        # collectedAt degrades to fetch time
+                        "at": at if isinstance(at, (int, float)) else now,
+                    }
+                    rows.append(
+                        {
+                            "id": n.id,
+                            "uri": n.uri,
+                            "topologyState": n.state,
+                            "coordinator": n.is_coordinator,
+                            "stale": False,
+                            "ageS": 0.0,
+                            "stats": got["stats"],
+                        }
+                    )
+                    continue
+                cached = self._peer_cache.get(n.id)
+                rows.append(
+                    {
+                        "id": n.id,
+                        "uri": n.uri,
+                        "topologyState": n.state,
+                        "coordinator": n.is_coordinator,
+                        "stale": True,
+                        "ageS": (
+                            round(now - cached["at"], 3)
+                            if cached is not None
+                            else None
+                        ),
+                        "stats": cached["stats"] if cached else None,
+                    }
+                )
+            # membership GC: a removed node's cached snapshot must not
+            # haunt future rollups (or leak across resizes)
+            live = {n.id for n in members}
+            for nid in [k for k in self._peer_cache if k not in live]:
+                del self._peer_cache[nid]
+            for nid in [k for k in self._timeline_cache if k not in live]:
+                del self._timeline_cache[nid]
+        return rows
+
+    def _merged(self, rows: List[dict]) -> Registry:
+        reg = Registry()
+        for row in rows:
+            if row.get("stats"):
+                reg.merge_state(row["stats"])
+        # federation meta-gauges ("cluster." prefix family): per-peer
+        # staleness markers so dashboards can see WHICH node's data is
+        # old, and how old
+        reg.gauge("cluster.peers", len(rows), ())
+        reg.gauge(
+            "cluster.peers_stale",
+            sum(1 for r in rows if r["stale"]),
+            (),
+        )
+        for row in rows:
+            tag = (f"node:{row['id']}",)
+            reg.gauge("cluster.peer_stale", 1 if row["stale"] else 0, tag)
+            if row["ageS"] is not None:
+                reg.gauge("cluster.snapshot_age_s", row["ageS"], tag)
+        return reg
+
+    # -- cluster endpoints -------------------------------------------------
+
+    def cluster_metrics_text(self) -> str:
+        """GET /cluster/metrics: Prometheus exposition of the merged
+        registry. Counter sums are exact; histogram `_bucket`/`_sum`/
+        `_count` series are the bucket-wise merge, so any Prometheus
+        quantile over them is the true cluster quantile."""
+        rows = self._collect_rows()
+        return self._merged(rows).prometheus_text()
+
+    def cluster_overview(self) -> dict:
+        """GET /cluster/overview: the merged numbers an operator reads
+        first, per node and per index, plus staleness markers."""
+        rows = self._collect_rows()
+        merged = self._merged(rows)
+        state = merged.export_state()
+
+        def g(stats: Optional[dict], name: str) -> float:
+            if not stats:
+                return 0.0
+            total = 0.0
+            for n, _t, v in stats.get("gauges", ()):
+                if n == name:
+                    total += v
+            return total
+
+        def c(stats: Optional[dict], name: str) -> float:
+            if not stats:
+                return 0.0
+            total = 0.0
+            for n, _t, v in stats.get("counters", ()):
+                if n == name:
+                    total += v
+            return total
+
+        def index_of(tags) -> Optional[str]:
+            for t in tags:
+                if t.startswith("index:"):
+                    return t.split(":", 1)[1]
+            return None
+
+        indexes: Dict[str, dict] = {}
+
+        def idx_row(name: str) -> dict:
+            return indexes.setdefault(
+                name,
+                {
+                    "queries": 0.0,
+                    "queryMsP50": 0.0,
+                    "queryMsP99": 0.0,
+                    "ingestBits": 0.0,
+                    "hbmResidentBytes": 0.0,
+                    "inflightBytes": 0.0,
+                },
+            )
+
+        for n, t, v in state.get("counters", ()):
+            idx = index_of(t)
+            if idx is None:
+                continue
+            if n == "query_n":
+                idx_row(idx)["queries"] += v
+            elif n == "ingest.bits":
+                idx_row(idx)["ingestBits"] += v
+        for n, t, v in state.get("gauges", ()):
+            idx = index_of(t)
+            if idx is None:
+                continue
+            if n == "hbm.resident_bytes":
+                idx_row(idx)["hbmResidentBytes"] += v
+            elif n == "sched.index_inflight_bytes":
+                idx_row(idx)["inflightBytes"] += v
+        for name in indexes:
+            tag = (f"index:{name}",)
+            indexes[name]["queryMsP50"] = merged.quantile(
+                "query_ms", 0.50, tag
+            )
+            indexes[name]["queryMsP99"] = merged.quantile(
+                "query_ms", 0.99, tag
+            )
+
+        srv = self._server
+        return {
+            "clusterName": srv.cluster_name,
+            "state": srv.state,
+            "replicaN": srv.cluster.replica_n,
+            "collectedAt": time.time(),
+            "nodes": [
+                {
+                    "id": r["id"],
+                    "uri": r["uri"],
+                    "topologyState": r["topologyState"],
+                    "coordinator": r["coordinator"],
+                    "stale": r["stale"],
+                    "ageS": r["ageS"],
+                    "queueDepth": g(r["stats"], "sched.queue_depth"),
+                    "inflightBytes": g(r["stats"], "sched.inflight_bytes"),
+                    "hbmResidentBytes": g(
+                        r["stats"], "devcache.resident_bytes"
+                    ),
+                    "queries": c(r["stats"], "query_n"),
+                    "ingestBits": c(r["stats"], "ingest.bits"),
+                }
+                for r in rows
+            ],
+            "indexes": indexes,
+            "totals": {
+                "queries": sum(i["queries"] for i in indexes.values()),
+                "ingestBits": sum(
+                    i["ingestBits"] for i in indexes.values()
+                ),
+                "queryMsP50": merged_quantile_all(merged, 0.50),
+                "queryMsP99": merged_quantile_all(merged, 0.99),
+            },
+        }
+
+    def cluster_timeline(self) -> dict:
+        """GET /cluster/timeline: every node's utilization ring, grouped
+        by node (timelines are per-node traces — summing them would
+        destroy exactly the skew an operator is looking for). Dead peers
+        degrade to their cached ring, stale-marked."""
+        from pilosa_tpu.server.client import ClientError
+
+        srv = self._server
+        members = list(srv.cluster.nodes)
+
+        def fetch(n) -> Optional[dict]:
+            if n.id == srv.node.id:
+                return self.sampler.snapshot()
+            try:
+                return srv.client.node_timeline(
+                    n.uri, timeout=_PEER_TIMEOUT
+                )
+            except (ClientError, ValueError):  # incl. malformed 200 body
+                return None
+
+        def checked(n) -> Optional[dict]:
+            got = fetch(n)
+            # shape guard: only a dict with a samples list is a timeline
+            if isinstance(got, dict) and isinstance(
+                got.get("samples"), list
+            ):
+                return got
+            return None
+
+        fetched = _fan_out(members, checked)
+        nodes: Dict[str, dict] = {}
+        now = time.time()
+        with self._peer_mu:
+            for n, got in zip(members, fetched):
+                if got is not None:
+                    self._timeline_cache[n.id] = {"tl": got, "at": now}
+                    nodes[n.id] = {"stale": False, **got}
+                else:
+                    cached = self._timeline_cache.get(n.id)
+                    nodes[n.id] = {
+                        "stale": True,
+                        "ageS": (
+                            round(now - cached["at"], 3)
+                            if cached
+                            else None
+                        ),
+                        **(cached["tl"] if cached else {"samples": []}),
+                    }
+        return {"collectedAt": now, "nodes": nodes}
+
+    def cluster_health(self) -> dict:
+        """GET /cluster/health: one structured verdict from signals the
+        system already tracks. `critical` means data is (likely)
+        unreachable — at least replica-n members down; `degraded` means
+        the cluster serves but something needs attention."""
+        from pilosa_tpu.server.client import ClientError
+
+        srv = self._server
+        members = list(srv.cluster.nodes)
+
+        def probe(n) -> Optional[dict]:
+            if n.id == srv.node.id:
+                return srv.api.status()
+            try:
+                st = srv.client.status(
+                    n.uri, timeout=_PROBE_TIMEOUT, probe=True
+                )
+            except (ClientError, ValueError):  # incl. malformed 200 body
+                return None
+            return st if isinstance(st, dict) else None
+
+        statuses = _fan_out(members, probe)
+        reasons: List[str] = []
+        nodes = []
+        unreachable = 0
+        pending_repairs = 0
+        wal_staged = 0
+        for n, st in zip(members, statuses):
+            ok = st is not None
+            if not ok:
+                unreachable += 1
+                reasons.append(f"node {n.id} unreachable")
+            else:
+                try:
+                    pending_repairs += int(st.get("pendingRepairs", 0))
+                    wal_staged += int(st.get("walStagedPositions", 0))
+                except (TypeError, ValueError):
+                    pass  # reachable peer, garbled field: skip the sum
+            nodes.append(
+                {
+                    "id": n.id,
+                    "uri": n.uri,
+                    "topologyState": n.state,
+                    "reachable": ok,
+                }
+            )
+        breakers = (
+            srv.client.breakers.snapshot()
+            if getattr(srv.client, "breakers", None) is not None
+            else {}
+        )
+        open_breakers = sorted(
+            uri for uri, s in breakers.items() if s != "closed"
+        )
+        for uri in open_breakers:
+            reasons.append(f"circuit breaker not closed for {uri}")
+        if pending_repairs:
+            reasons.append(
+                f"{pending_repairs} pending replica repair(s) awaiting "
+                "anti-entropy"
+            )
+        job = srv.resize_job or {}
+        resize_running = job.get("state") == "RUNNING"
+        if resize_running:
+            reasons.append(
+                f"resize job running (phase={job.get('phase', '?')})"
+            )
+        if srv.state != "NORMAL":
+            reasons.append(f"cluster state {srv.state}")
+        replica_n = max(1, srv.cluster.replica_n)
+        if unreachable >= replica_n:
+            status = "critical"
+            reasons.append(
+                f"{unreachable} member(s) unreachable >= replica-n "
+                f"{replica_n}: some shards have no live owner"
+            )
+        elif reasons:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "state": srv.state,
+            "replicaN": srv.cluster.replica_n,
+            "nodes": nodes,
+            "breakers": breakers,
+            "pendingRepairs": pending_repairs,
+            "walStagedPositions": wal_staged,
+            "resize": {
+                "state": job.get("state", "NONE"),
+                "phase": job.get("phase"),
+            }
+            if job
+            else {"state": "NONE"},
+            "reasons": reasons,
+        }
+
+
+def merged_quantile_all(reg: Registry, q: float) -> float:
+    """Cluster-wide query_ms quantile across every index label: merge
+    the per-index histogram series bucket-wise once more (exact — same
+    bounds) and read the quantile of the union."""
+    from pilosa_tpu.utils.stats import Histogram
+
+    state = reg.export_state()
+    acc = Histogram()
+    for n, _t, d in state.get("hists", ()):
+        if n == "query_ms":
+            acc.merge_dict(d)
+    return acc.quantile(q)

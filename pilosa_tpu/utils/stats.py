@@ -21,7 +21,7 @@ from __future__ import annotations
 import bisect
 import socket
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from pilosa_tpu.utils.locks import TrackedLock
@@ -59,10 +59,13 @@ STAT_NAMES = frozenset(
         "runtime.gc_objects",
         "runtime.open_files",
         # query admission control & QoS (sched/admission.py); admit/shed/
-        # wait series carry a "class:<interactive|batch|internal>" tag
+        # wait series carry "class:<interactive|batch|internal>" and
+        # "index:<name>" tags (index "-" when the request is not bound to
+        # one, e.g. resize transfer serving)
         "sched.queue_depth",
         "sched.inflight",
         "sched.inflight_bytes",
+        "sched.index_inflight_bytes",
         "sched.admit",
         "sched.shed",
         "sched.wait_ms",
@@ -78,9 +81,12 @@ STAT_NAMES = frozenset(
         "devcache.misses",
         # HBM residency manager (pilosa_tpu/hbm/): extent-granular paging,
         # pinning and prefetch gauges, refreshed at scrape time alongside
-        # the devcache gauges
+        # the devcache gauges. resident/restage bytes are attributed per
+        # owner index ("index:" label; "-" collects entries staged outside
+        # any index); the sum over labels equals the global ledger.
         "hbm.resident_extents",
         "hbm.pinned_bytes",
+        "hbm.resident_bytes",
         "hbm.restage_bytes",
         "hbm.prefetch_hits",
         # live elastic resize (server/node.py streaming resharding):
@@ -98,9 +104,39 @@ STAT_NAMES = frozenset(
 
 # Prefixes for families whose full names are built dynamically (e.g.
 # breaker state-transition counters "breaker.open"/"breaker.closed"/
-# "breaker.half_open" in server/faults.py). Dynamic emissions must start
-# with a declared prefix.
-STAT_PREFIXES = frozenset({"breaker."})
+# "breaker.half_open" in server/faults.py) or that are synthesized
+# outside the StatsClient emission path: "cluster." families are written
+# into the merged registry by the federated rollup
+# (server/telemetry.py), and "stats." covers the metrics plane's own
+# self-reporting ("stats.dropped_preboot" from the statsd transport).
+# Dynamic emissions must start with a declared prefix.
+STAT_PREFIXES = frozenset({"breaker.", "cluster.", "stats."})
+
+# Labeled metric families: family name -> the EXACT set of label keys
+# every series of that family must carry (enforced end-to-end by
+# tools/prom_lint.py against the rendered /metrics and /cluster/metrics
+# text — a family here may neither drop a label nor mix labeled and
+# unlabeled series; families NOT listed must render unlabeled). "-" is
+# the conventional placeholder value when a label is structurally
+# unknowable (e.g. admission of a request bound to no index).
+STAT_LABELS: Dict[str, Tuple[str, ...]] = {
+    "query_n": ("index",),
+    "query_ms": ("index",),
+    "ingest.bits": ("index",),
+    "ingest.batches": ("index",),
+    "ingest.apply_ms": ("index",),
+    "ingest.route_ms": ("index",),
+    "sched.admit": ("class", "index"),
+    "sched.shed": ("class", "index"),
+    "sched.wait_ms": ("class", "index"),
+    "sched.index_inflight_bytes": ("index",),
+    "hbm.resident_bytes": ("index",),
+    "hbm.restage_bytes": ("index",),
+    # federation meta-gauges (server/telemetry.py writes these into the
+    # merged registry directly; the "cluster." prefix covers the names)
+    "cluster.peer_stale": ("node",),
+    "cluster.snapshot_age_s": ("node",),
+}
 
 
 def is_declared_stat(name: str) -> bool:
@@ -199,6 +235,52 @@ class Histogram:
             "max": self.vmax,
         }
 
+    def export_dict(self) -> dict:
+        """JSON-safe full state: the raw per-bucket counts plus exact
+        count/sum/min/max — everything merge_dict needs to reconstruct
+        this histogram on another node. Because every node shares the
+        fixed HIST_BOUNDS, a bucket-wise merge of N exported histograms
+        is EXACTLY the histogram of the union of their samples."""
+        return {
+            "buckets": list(self.buckets),
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else 0.0,
+            "max": self.vmax if self.count else 0.0,
+        }
+
+    def merge_dict(self, d: dict) -> bool:
+        """Fold one exported histogram into this one (bucket-wise sums,
+        exact count/sum, min/max of extremes). Returns False — merging
+        nothing — when the export's bucket layout does not match this
+        build's HIST_BOUNDS (mixed-version cluster) or any field fails
+        to parse (half-written snapshot): a malformed payload must
+        degrade to missing data, not raise out of a /cluster/* merge.
+        Every field is coerced BEFORE the first mutation so a bad entry
+        can't leave the accumulator partially updated."""
+        buckets = d.get("buckets")
+        try:
+            count = int(d.get("count", 0))
+            if (
+                not isinstance(buckets, list)
+                or len(buckets) != len(self.buckets)
+                or count <= 0
+            ):
+                return False
+            adds = [int(n) for n in buckets]
+            total = float(d.get("sum", 0.0))
+            vmin = float(d.get("min", float("inf")))
+            vmax = float(d.get("max", float("-inf")))
+        except (TypeError, ValueError):
+            return False
+        for i, n in enumerate(adds):
+            self.buckets[i] += n
+        self.count += count
+        self.total += total
+        self.vmin = min(self.vmin, vmin)
+        self.vmax = max(self.vmax, vmax)
+        return True
+
 
 class Registry:
     """Tagged counters / gauges / histograms / sets, shared by all views."""
@@ -237,6 +319,104 @@ class Registry:
         with self._mu:
             h = self._hists.get(_key(name, tuple(tags)))
             return h.quantile(q) if h is not None else 0.0
+
+    def total_counter(self, name: str) -> float:
+        """Sum of one counter family across every tagged series (the
+        telemetry sampler reads cumulative ingest/query totals this way)."""
+        with self._mu:
+            return sum(
+                v for (n, _), v in self._counters.items() if n == name
+            )
+
+    def drop_label(self, key: str, value: str) -> int:
+        """Label GC: remove every series (counter/gauge/histogram/set)
+        carrying the `key:value` tag — called when an index is deleted so
+        a churning tenant set cannot leak per-index gauge families
+        forever. Returns the number of series removed."""
+        tag = f"{key}:{value}"
+        removed = 0
+        with self._mu:
+            for store in (
+                self._counters, self._gauges, self._hists, self._sets,
+            ):
+                for k in [k for k in store if tag in k[1]]:
+                    del store[k]
+                    removed += 1
+        return removed
+
+    # -- federation (server/telemetry.py cluster rollup) -------------------
+
+    def export_state(self) -> dict:
+        """One JSON-safe, MERGEABLE snapshot of every series. Unlike
+        snapshot() (which renders histograms as summary quantiles) this
+        carries raw bucket counts, so a peer can fold it into its own
+        registry with merge_state and compute REAL cluster quantiles
+        from the merged buckets instead of averaging per-node averages."""
+        with self._mu:
+            return {
+                "histBuckets": len(HIST_BOUNDS) + 1,
+                "counters": [
+                    [n, list(t), v] for (n, t), v in self._counters.items()
+                ],
+                "gauges": [
+                    [n, list(t), v] for (n, t), v in self._gauges.items()
+                ],
+                "hists": [
+                    [n, list(t), h.export_dict()]
+                    for (n, t), h in self._hists.items()
+                    if h.count
+                ],
+                "sets": [
+                    [n, list(t), len(m)] for (n, t), m in self._sets.items()
+                ],
+            }
+
+    def merge_state(self, state: dict) -> None:
+        """Fold one export_state() payload into this registry: counters
+        and gauges merge by SUM (the byte ledgers and throughput counters
+        are extensive quantities — the cluster total is the sum of node
+        totals), set series merge by summed cardinality (rendered as
+        gauges either way), histograms bucket-wise (exact, shared
+        bounds). Malformed entries are skipped, never raised — a peer's
+        half-written snapshot must degrade, not 500 the rollup."""
+        with self._mu:
+            for entry in state.get("counters", ()):
+                try:
+                    n, t, v = entry
+                    k, v = _key(n, tuple(t)), float(v)
+                except (TypeError, ValueError):
+                    # coerce BEFORE touching the store: the defaultdict
+                    # would otherwise materialize a phantom zero series
+                    # for an entry whose value fails to parse
+                    continue
+                self._counters[k] += v
+            for entry in list(state.get("gauges", ())) + list(
+                state.get("sets", ())
+            ):
+                try:
+                    n, t, v = entry
+                    k = _key(n, tuple(t))
+                    self._gauges[k] = self._gauges.get(k, 0.0) + float(v)
+                except (TypeError, ValueError):
+                    continue
+            for entry in state.get("hists", ()):
+                try:
+                    n, t, d = entry
+                    k = _key(n, tuple(t))
+                except (TypeError, ValueError):
+                    continue
+                if not isinstance(d, dict):
+                    continue
+                h = self._hists.get(k)
+                if h is None:
+                    # register the series only if the payload merges: a
+                    # malformed entry must not materialize a phantom
+                    # empty histogram
+                    h = Histogram()
+                    if h.merge_dict(d):
+                        self._hists[k] = h
+                else:
+                    h.merge_dict(d)
 
     # -- views -------------------------------------------------------------
 
@@ -417,13 +597,163 @@ class _NopTimer:
         pass
 
 
+def _split_hostport(host: str) -> Tuple[str, int]:
+    """'host', 'host:port', '[v6]:port', or bare 'v6' -> (host, port).
+    Raises a config-shaped ValueError on SYNTAX problems only — name
+    resolution is the transport's (retryable) concern, not parsing's."""
+    h, p = host, 8125
+    if host.startswith("["):  # [v6]:port
+        end = host.find("]")
+        if end < 0:
+            raise ValueError(f"metric.host {host!r}: unclosed '[' in address")
+        h = host[1:end]
+        rest = host[end + 1 :]
+        if rest.startswith(":"):
+            p = rest[1:]
+    elif host.count(":") == 1:  # host:port
+        h, _, p = host.partition(":")
+    # else: bare hostname or bare IPv6 literal, default port
+    try:
+        p = int(p)
+    except ValueError:
+        raise ValueError(
+            f"metric.host {host!r}: port {p!r} is not an integer"
+        ) from None
+    return h or "localhost", p
+
+
+class _StatsdTransport:
+    """Shared UDP push channel for one StatsdClient family (with_tags
+    children share their parent's transport, hence one socket and one
+    buffer). Name resolution is LAZY with bounded retry: a daemon whose
+    DNS entry appears after boot (the common sidecar race) no longer
+    fails the server, and datagrams recorded before resolution succeeds
+    are buffered (bounded, drop-oldest) and flushed on the first
+    successful resolve instead of vanishing — the early-boot latency
+    histograms dashboards kept missing. Every datagram that IS lost
+    (buffer overflow, or still unflushed at close) is counted in the
+    registry as `stats.dropped_preboot`, so the loss is visible on the
+    very scrape endpoints that kept working."""
+
+    BUFFER_MAX = 2048
+    RESOLVE_RETRY = 1.0  # seconds between resolution attempts
+
+    def __init__(
+        self,
+        host: str,
+        registry: Optional[Registry],
+        sock: Optional[socket.socket] = None,
+    ):
+        self.host = host
+        self.registry = registry
+        self._hostport = _split_hostport(host)  # syntax errors raise NOW
+        self._mu = TrackedLock("stats.statsd_mu")
+        self._sock = sock
+        self._addr = None
+        self._resolving = False
+        self._next_resolve = 0.0
+        self._buffer: "deque[bytes]" = deque()
+        self._closed = False
+        # one boot-time attempt (keeps the common resolvable-at-boot
+        # case on the fast path from the very first datagram)
+        with self._mu:
+            attempt = self._mark_resolving_locked()
+        if attempt:
+            self._finish_resolve()
+
+    def _mark_resolving_locked(self) -> bool:
+        """Claim the (single) resolution slot if a retry is due. The DNS
+        lookup itself runs in _finish_resolve with the mutex RELEASED:
+        a slow resolver (missing DNS entry, multi-second timeout) must
+        never park every metric-emitting thread behind the transport
+        lock — at most one emitter per retry interval pays the lookup,
+        everyone else buffers and moves on."""
+        if self._addr is not None or self._resolving or self._closed:
+            return False
+        now = time.monotonic()
+        if now < self._next_resolve:
+            return False
+        self._resolving = True
+        self._next_resolve = now + self.RESOLVE_RETRY
+        return True
+
+    def _finish_resolve(self) -> None:
+        h, p = self._hostport
+        try:
+            info = socket.getaddrinfo(h, p, type=socket.SOCK_DGRAM)[0]
+        except (OSError, UnicodeError):
+            # gaierror IS an OSError; UnicodeError covers an overlong
+            # IDNA label. Either way: stay unresolved, retry next
+            # interval, and — critically — fall through so _resolving
+            # resets (a wedged True would disable resolution forever)
+            info = None
+        with self._mu:
+            self._resolving = False
+            if info is None or self._closed or self._addr is not None:
+                return
+            if self._sock is None:
+                try:
+                    self._sock = socket.socket(info[0], socket.SOCK_DGRAM)
+                except OSError:
+                    # fd exhaustion: _addr stays unset (a half-resolved
+                    # transport with no socket would crash every later
+                    # emission); retry the whole resolve next interval
+                    return
+            self._addr = info[4]
+            while self._buffer:
+                self._sendto_locked(self._buffer.popleft())
+
+    def send(self, datagram: bytes) -> None:
+        dropped = 0
+        attempt = False
+        with self._mu:
+            if self._closed:
+                return
+            if self._addr is None:
+                if len(self._buffer) >= self.BUFFER_MAX:
+                    self._buffer.popleft()
+                    dropped = 1
+                self._buffer.append(datagram)
+                attempt = self._mark_resolving_locked()
+            else:
+                while self._buffer:
+                    self._sendto_locked(self._buffer.popleft())
+                self._sendto_locked(datagram)
+        if attempt:
+            self._finish_resolve()
+        if dropped and self.registry is not None:
+            self.registry.count("stats.dropped_preboot", dropped, ())
+
+    def _sendto_locked(self, datagram: bytes) -> None:
+        try:
+            self._sock.sendto(datagram, self._addr)
+        except OSError:
+            pass  # best-effort: never block or fail the caller
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            unflushed = len(self._buffer)
+            self._buffer.clear()
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+        if unflushed and self.registry is not None:
+            self.registry.count("stats.dropped_preboot", unflushed, ())
+
+
 class StatsdClient(StatsClient):
     """DogStatsD UDP push client (reference: statsd/statsd.go:48 uses the
     DataDog client). Every metric still lands in the shared Registry (so
     /metrics and /debug/vars work), and is ALSO pushed as a datagram:
     `name:value|type|#tag1,tag2`. UDP is fire-and-forget; serialization
     errors and unreachable daemons are swallowed — metrics must never
-    take down a query."""
+    take down a query. Pre-resolution pushes buffer in the shared
+    transport (see _StatsdTransport) instead of silently disappearing."""
 
     def __init__(
         self,
@@ -432,51 +762,19 @@ class StatsdClient(StatsClient):
         tags: Iterable[str] = (),
         prefix: str = "pilosa_tpu.",
         sock: Optional[socket.socket] = None,
+        transport: Optional[_StatsdTransport] = None,
     ):
         super().__init__(registry, tags)
         self.host = host
         self.prefix = prefix
-        self._addr, family = self._parse_host(host)
-        self._sock = sock or socket.socket(family, socket.SOCK_DGRAM)
-
-    @staticmethod
-    def _parse_host(host: str):
-        """'host', 'host:port', '[v6]:port', or bare 'v6' -> (sockaddr,
-        family), resolved via getaddrinfo so IPv6 daemons work. Raises a
-        config-shaped ValueError instead of a bare int() traceback."""
-        h, p = host, 8125
-        if host.startswith("["):  # [v6]:port
-            end = host.find("]")
-            if end < 0:
-                raise ValueError(f"metric.host {host!r}: unclosed '[' in address")
-            h = host[1:end]
-            rest = host[end + 1 :]
-            if rest.startswith(":"):
-                p = rest[1:]
-        elif host.count(":") == 1:  # host:port
-            h, _, p = host.partition(":")
-        # else: bare hostname or bare IPv6 literal, default port
-        try:
-            p = int(p)
-        except ValueError:
-            raise ValueError(
-                f"metric.host {host!r}: port {p!r} is not an integer"
-            ) from None
-        try:
-            info = socket.getaddrinfo(
-                h or "localhost", p, type=socket.SOCK_DGRAM
-            )[0]
-        except socket.gaierror as e:
-            raise ValueError(f"metric.host {host!r}: cannot resolve: {e}") from None
-        return info[4], info[0]
+        self._transport = transport or _StatsdTransport(
+            host, self.registry, sock=sock
+        )
 
     def close(self) -> None:
         """Release the UDP socket (NodeServer.stop calls this; with_tags
-        children share the parent's socket, so close only the root)."""
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        children share the parent's transport, so close only the root)."""
+        self._transport.close()
 
     def with_tags(self, *tags: str) -> "StatsdClient":
         return StatsdClient(
@@ -484,17 +782,14 @@ class StatsdClient(StatsClient):
             self.registry,
             self.tags + tags,
             self.prefix,
-            sock=self._sock,  # children share the socket
+            transport=self._transport,  # children share socket + buffer
         )
 
     def _push(self, name: str, value, mtype: str) -> None:
         datagram = f"{self.prefix}{name}:{value}|{mtype}"
         if self.tags:
             datagram += "|#" + ",".join(self.tags)
-        try:
-            self._sock.sendto(datagram.encode(), self._addr)
-        except OSError:
-            pass  # best-effort: never block or fail the caller
+        self._transport.send(datagram.encode())
 
     def count(self, name: str, value: float = 1, rate: float = 1.0) -> None:
         super().count(name, value, rate)

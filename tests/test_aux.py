@@ -95,20 +95,16 @@ def test_statsd_unreachable_daemon_never_raises():
 def test_statsd_host_parsing():
     import pytest as _pytest
 
-    from pilosa_tpu.utils.stats import StatsdClient
+    from pilosa_tpu.utils.stats import _split_hostport
 
-    assert StatsdClient._parse_host("localhost:9999")[0][:2] == ("127.0.0.1", 9999)
-    assert StatsdClient._parse_host("localhost")[0][1] == 8125
-    addr, fam = StatsdClient._parse_host("[::1]:9125")
-    assert addr[:2] == ("::1", 9125)
-    import socket as _socket
-
-    assert fam == _socket.AF_INET6
-    assert StatsdClient._parse_host("::1")[0][1] == 8125  # bare v6 literal
+    assert _split_hostport("localhost:9999") == ("localhost", 9999)
+    assert _split_hostport("localhost") == ("localhost", 8125)
+    assert _split_hostport("[::1]:9125") == ("::1", 9125)
+    assert _split_hostport("::1") == ("::1", 8125)  # bare v6 literal
     with _pytest.raises(ValueError, match="not an integer"):
-        StatsdClient._parse_host("host:abc")
+        _split_hostport("host:abc")
     with _pytest.raises(ValueError, match="unclosed"):
-        StatsdClient._parse_host("[::1:9125")
+        _split_hostport("[::1:9125")
 
 
 def test_unknown_stats_service_rejected():

@@ -212,8 +212,9 @@ class TestAdmission:
             ctl.admit(cls="batch")
         t.release()
         snap = st.registry.snapshot()
-        assert snap.get("sched.admit;class:interactive") == 1
-        assert snap.get("sched.shed;class:batch") == 1
+        # admit/shed carry class AND index labels ("-" = no index bound)
+        assert snap.get("sched.admit;class:interactive,index:-") == 1
+        assert snap.get("sched.shed;class:batch,index:-") == 1
         assert "sched.queue_depth" in snap
         assert "sched.inflight" in snap
 
@@ -988,7 +989,7 @@ def test_retry_restamps_shrunken_deadline_header():
         th.start()
         _wait_until(
             lambda: srv.stats.registry.snapshot().get(
-                "sched.shed;class:internal", 0
+                "sched.shed;class:internal,index:rd", 0
             )
             >= 1,
             what="first attempt shed",

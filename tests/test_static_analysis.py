@@ -311,6 +311,61 @@ class TestApiInvariantsSeeded:
             f.code == "API001" and "mystery." in f.message for f in fs
         )
 
+    def test_with_tags_chain_emission_scanned(self):
+        """The inline labeled-family form
+        `stats.with_tags(...).gauge(...)` is a real emission: an
+        undeclared name through the chain must be flagged (and a
+        declared one keeps its registry entry non-stale)."""
+        fs = self._with_repo_registry(
+            """
+            class C:
+                def f(self):
+                    self.stats.with_tags("index:a").count("chain_undeclared")
+            """
+        )
+        assert any(
+            f.code == "API001" and "chain_undeclared" in f.message
+            for f in fs
+        )
+
+    def test_api008_stat_labels_must_name_declared_stats(self):
+        stats_mod = seeded_module(
+            "pilosa_tpu/utils/stats.py",
+            """
+            STAT_NAMES = frozenset({"real.metric"})
+            STAT_PREFIXES = frozenset({"dyn."})
+            STAT_LABELS = {
+                "real.metric": ("index",),   # fine
+                "dyn.family": ("node",),     # fine via prefix
+                "typo.metric": ("index",),   # API008: undeclared
+                "real.metric2": (),          # ...and undeclared + empty
+            }
+            """,
+        )
+        emitter = seeded_module(
+            "pilosa_tpu/_seeded.py",
+            """
+            class C:
+                def f(self):
+                    self.stats.count("real.metric")
+            """,
+        )
+        fs = analysis.run_passes(
+            [analysis.ApiInvariantsPass()], [stats_mod, emitter]
+        )
+        assert any(
+            f.code == "API008" and "typo.metric" in f.message for f in fs
+        )
+        assert any(
+            f.code == "API008"
+            and "real.metric2" in f.message
+            and "no label keys" in f.message
+            for f in fs
+        )
+        assert not any(
+            f.code == "API008" and "dyn.family" in f.message for f in fs
+        )
+
     def test_declared_prefix_dynamic_ok(self):
         fs = self._with_repo_registry(
             """

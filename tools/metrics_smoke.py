@@ -1,8 +1,12 @@
 #!/usr/bin/env python
-"""Tier-1-adjacent metrics smoke: boot a real node, drive traffic over
-HTTP, scrape /metrics, and lint the Prometheus exposition
+"""Tier-1-adjacent metrics smoke: boot a real 3-node cluster, drive
+query and ingest traffic at TWO indexes over HTTP, then lint both the
+per-node /metrics and the federated /cluster/metrics expositions
 (tools/prom_lint.py — TYPE-once, histogram bucket monotonicity, every
-rendered family declared in STAT_NAMES). Exits non-zero on any finding.
+rendered family declared in STAT_NAMES, labeled families honoring
+STAT_LABELS). Also asserts the two indexes' per-index families are
+present and disjoint in the cluster rollup, and that /cluster/health
+answers. Exits non-zero on any finding.
 
 Run by .github/workflows/ci.yml alongside tools/check.py; runnable
 locally with `JAX_PLATFORMS=cpu python tools/metrics_smoke.py`.
@@ -12,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import urllib.request
 
@@ -24,56 +29,133 @@ from pilosa_tpu.utils.cpuonly import force_cpu  # noqa: E402
 
 force_cpu(2)
 
-from pilosa_tpu.server.node import NodeServer  # noqa: E402
+from pilosa_tpu.testing import ClusterHarness  # noqa: E402
 from tools.prom_lint import lint_against_registry  # noqa: E402
 
 
+def _post(uri: str, path: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        f"{uri}{path}", data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def _get(uri: str, path: str):
+    with urllib.request.urlopen(f"{uri}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def _index_labels(text: str, family: str) -> set:
+    """index label values rendered for one family in exposition text."""
+    out = set()
+    for m in re.finditer(
+        rf'{family}(?:_bucket|_sum|_count)?\{{([^}}]*)\}}', text
+    ):
+        lm = re.search(r'index="([^"]*)"', m.group(1))
+        if lm:
+            out.add(lm.group(1))
+    return out
+
+
 def main() -> int:
-    srv = NodeServer(None, "smoke0", metric_poll_interval=0.0).start()
-    try:
-        uri = srv.node.uri
-        srv.api.create_index("smoke")
-        srv.api.create_field("smoke", "f", {"type": "set"})
-        # traffic that exercises counters, gauges, and the query_ms /
-        # ingest timing histograms — over real HTTP, like production
-        body = json.dumps({"query": "Set(1, f=1) Set(2, f=1)"}).encode()
-        req = urllib.request.Request(
-            f"{uri}/index/smoke/query", data=body, method="POST",
-            headers={"Content-Type": "application/json"},
-        )
-        urllib.request.urlopen(req, timeout=30).read()
-        for _ in range(3):
-            req = urllib.request.Request(
-                f"{uri}/index/smoke/query",
-                data=json.dumps({"query": "Count(Row(f=1))"}).encode(),
-                method="POST",
-                headers={"Content-Type": "application/json"},
+    errors: list = []
+    with ClusterHarness(
+        3, replica_n=1, in_memory=True, metric_poll_interval=0.0,
+        telemetry_sample_interval=0.0,
+    ) as cluster:
+        uri = cluster[0].node.uri
+        for idx in ("smoke_a", "smoke_b"):
+            cluster[0].api.create_index(idx)
+            cluster[0].api.create_field(idx, "f", {"type": "set"})
+        # traffic tagged to two indexes: ingest (import endpoint) plus
+        # enough Counts to fill per-index query_ms histograms on
+        # whichever nodes own the shards
+        for idx, n_cols in (("smoke_a", 40), ("smoke_b", 12)):
+            _post(
+                uri, f"/index/{idx}/field/f/import",
+                {"rows": [1] * n_cols, "cols": list(range(n_cols))},
             )
-            resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
-            assert resp["results"] == [2], resp
+            for _ in range(3):
+                resp = _post(
+                    uri, f"/index/{idx}/query",
+                    {"query": "Count(Row(f=1))"},
+                )
+                # exact: a routing regression that silently drops bits
+                # must fail the smoke, not render a plausible page
+                assert resp["results"] == [n_cols], resp
         # the resize-job record must scrape as well-formed JSON on a live
         # node (operators poll it during elastic resizes; an idle node
         # reports NONE)
-        with urllib.request.urlopen(f"{uri}/cluster/resize/job", timeout=10) as r:
-            job = json.loads(r.read())
+        job = json.loads(_get(uri, "/cluster/resize/job"))
         assert job.get("state") == "NONE", f"unexpected resize job: {job}"
-        with urllib.request.urlopen(f"{uri}/metrics", timeout=10) as r:
-            text = r.read().decode()
-    finally:
-        srv.stop()
-    errors = lint_against_registry(text)
-    for e in errors:
-        print(f"metrics-smoke: {e}")
+
+        node_texts = [_get(s.node.uri, "/metrics") for s in cluster.nodes]
+        node_text = node_texts[0]
+        cluster_text = _get(uri, "/cluster/metrics")
+        overview = json.loads(_get(uri, "/cluster/overview"))
+        health = json.loads(_get(uri, "/cluster/health"))
+
+    for label, text in (("node", node_text), ("cluster", cluster_text)):
+        for e in lint_against_registry(text):
+            errors.append(f"{label} /metrics: {e}")
+
     # the smoke must actually have produced the histogram the dashboards
     # and the admission tail estimate depend on
-    if "pilosa_tpu_query_ms_bucket" not in text:
-        errors.append("query_ms histogram missing from /metrics")
-        print("metrics-smoke: query_ms histogram missing from /metrics")
-    if not errors:
-        print(
-            "metrics-smoke: OK "
-            f"({sum(1 for ln in text.splitlines() if ln and not ln.startswith('#'))} samples linted)"
+    if "pilosa_tpu_query_ms_bucket" not in cluster_text:
+        errors.append("query_ms histogram missing from /cluster/metrics")
+
+    # per-index attribution: both tenants present, and their label sets
+    # disjoint from each other (a merge that smeared series across
+    # indexes would collapse them)
+    for family in ("pilosa_tpu_query_ms", "pilosa_tpu_ingest_bits"):
+        got = _index_labels(cluster_text, family)
+        for idx in ("smoke_a", "smoke_b"):
+            if idx not in got:
+                errors.append(
+                    f"/cluster/metrics: {family} missing index={idx!r} "
+                    f"series (got {sorted(got)})"
+                )
+    # merge exactness: the cluster rollup's per-index ingest.bits must
+    # equal the SUM of the three per-node values exactly (counters are
+    # extensive quantities; smearing across indexes or peers would
+    # break equality on at least one tenant, since they wrote 40 vs 12
+    # bits)
+    def _bits(text: str, idx: str) -> float:
+        m = re.search(
+            rf'pilosa_tpu_ingest_bits\{{index="{idx}"\}} ([0-9.e+-]+)',
+            text,
         )
+        return float(m.group(1)) if m else 0.0
+
+    for idx in ("smoke_a", "smoke_b"):
+        want = sum(_bits(t, idx) for t in node_texts)
+        got = _bits(cluster_text, idx)
+        if want <= 0 or got != want:
+            errors.append(
+                f"/cluster/metrics: ingest.bits for {idx}: cluster "
+                f"{got} != sum of node values {want}"
+            )
+
+    # the overview and health endpoints must answer with their headline
+    # fields on a healthy cluster
+    if len(overview.get("nodes", [])) != 3:
+        errors.append(f"/cluster/overview: expected 3 nodes: {overview}")
+    if any(n["stale"] for n in overview.get("nodes", [])):
+        errors.append(f"/cluster/overview: live peers marked stale: {overview}")
+    if health.get("status") != "ok":
+        errors.append(f"/cluster/health: expected ok: {health}")
+
+    for e in errors:
+        print(f"metrics-smoke: {e}")
+    if not errors:
+        n = sum(
+            1
+            for t in (node_text, cluster_text)
+            for ln in t.splitlines()
+            if ln and not ln.startswith("#")
+        )
+        print(f"metrics-smoke: OK ({n} samples linted)")
     return 1 if errors else 0
 
 

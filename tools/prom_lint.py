@@ -12,7 +12,12 @@ the registry -> exposition path is caught:
 * every family maps back to a name declared in `utils/stats.py`
   STAT_NAMES (or a STAT_PREFIXES dynamic family) — a rendered metric
   nothing declared is exactly the silent dashboard rot the registry
-  exists to prevent.
+  exists to prevent;
+* labeled families honor `utils/stats.py` STAT_LABELS: every series of
+  a listed family carries EXACTLY the declared label keys (no dropped
+  key, no extra key, no unlabeled series mixed in), and a family NOT
+  listed renders unlabeled — so a per-index dashboard can trust that
+  `sum by (index)` covers the whole family.
 
 `lint(text)` returns a list of error strings (empty = clean); the CLI
 reads a file or stdin and exits non-zero on findings. Used by
@@ -31,6 +36,9 @@ _SAMPLE_RE = re.compile(
     r"\s+(?P<value>[^\s]+)\s*$"
 )
 _LE_RE = re.compile(r'(?:^|,)le="(?P<le>[^"]+)"')
+# key="value" pairs; values may contain escaped quotes (the renderer
+# escapes \ " and newline per the exposition spec)
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="(?:[^"\\]|\\.)*"')
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 
@@ -54,11 +62,16 @@ def _strip_le(labels: Optional[str]) -> str:
     )
 
 
+def _sanitize(name: str, prefix: str) -> str:
+    return prefix + "".join(c if c.isalnum() else "_" for c in name)
+
+
 def lint(
     text: str,
     declared: Optional[set] = None,
     declared_prefixes: Optional[set] = None,
     prefix: str = "pilosa_tpu_",
+    labels: Optional[Dict[str, Tuple[str, ...]]] = None,
 ) -> List[str]:
     errors: List[str] = []
     types: Dict[str, str] = {}
@@ -68,6 +81,13 @@ def lint(
     buckets: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
     counts: Dict[Tuple[str, str], float] = {}
     sums: set = set()
+    # labeled-family contract: sanitized family -> required key set;
+    # families seen -> the label-key sets their series carried (le is a
+    # histogram mechanism, not a label — stripped before comparison)
+    required_keys: Dict[str, frozenset] = {
+        _sanitize(fam, prefix): frozenset(keys)
+        for fam, keys in (labels or {}).items()
+    }
 
     for ln, line in enumerate(text.splitlines(), start=1):
         if not line.strip():
@@ -100,7 +120,7 @@ def lint(
             errors.append(f"line {ln}: unparseable sample line: {line!r}")
             continue
         name = m.group("name")
-        labels = m.group("labels")
+        lbls = m.group("labels")
         try:
             value = float(m.group("value"))
         except ValueError:
@@ -114,10 +134,38 @@ def lint(
                 "declaration"
             )
             continue
+        if labels is not None:
+            keys = frozenset(
+                k for k in _LABEL_PAIR_RE.findall(lbls or "") if k != "le"
+            )
+            want = required_keys.get(family)
+            if want is not None:
+                if keys != want:
+                    missing = sorted(want - keys)
+                    extra = sorted(keys - want)
+                    detail = "; ".join(
+                        p
+                        for p in (
+                            f"missing {missing}" if missing else "",
+                            f"undeclared {extra}" if extra else "",
+                        )
+                        if p
+                    )
+                    errors.append(
+                        f"line {ln}: labeled family {family!r} series "
+                        f"violates its STAT_LABELS key set "
+                        f"{sorted(want)}: {detail}"
+                    )
+            elif keys:
+                errors.append(
+                    f"line {ln}: family {family!r} renders labels "
+                    f"{sorted(keys)} but is not declared in STAT_LABELS "
+                    "— unlisted families must render unlabeled"
+                )
         if types[family] == "histogram":
-            series = _strip_le(labels)
+            series = _strip_le(lbls)
             if name.endswith("_bucket"):
-                le_m = _LE_RE.search(labels or "")
+                le_m = _LE_RE.search(lbls or "")
                 if le_m is None:
                     errors.append(
                         f"line {ln}: histogram bucket without le label"
@@ -158,12 +206,9 @@ def lint(
             errors.append(f"{label}: histogram without _sum series")
 
     if declared is not None:
-        def sanitize(n: str) -> str:
-            return prefix + "".join(c if c.isalnum() else "_" for c in n)
-
-        allowed = {sanitize(n) for n in declared}
+        allowed = {_sanitize(n, prefix) for n in declared}
         allowed_prefixes = tuple(
-            sanitize(p) for p in (declared_prefixes or ())
+            _sanitize(p, prefix) for p in (declared_prefixes or ())
         )
         for family in types:
             if family in allowed or family.startswith(allowed_prefixes):
@@ -176,11 +221,15 @@ def lint(
 
 
 def lint_against_registry(text: str) -> List[str]:
-    """lint() against the package's own declared metric names."""
-    from pilosa_tpu.utils.stats import STAT_NAMES, STAT_PREFIXES
+    """lint() against the package's own declared metric names AND its
+    labeled-family contract (STAT_LABELS)."""
+    from pilosa_tpu.utils.stats import STAT_LABELS, STAT_NAMES, STAT_PREFIXES
 
     return lint(
-        text, declared=set(STAT_NAMES), declared_prefixes=set(STAT_PREFIXES)
+        text,
+        declared=set(STAT_NAMES),
+        declared_prefixes=set(STAT_PREFIXES),
+        labels=dict(STAT_LABELS),
     )
 
 
