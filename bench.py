@@ -48,6 +48,9 @@ WINDOWS = 4
 N_COLS = int(os.environ.get("PILOSA_TPU_BENCH_COLS", "1000000000"))
 BSI_DEPTH = 8
 GB_SHARDS = 64  # config 4 geometry
+MIXED_SECONDS = float(os.environ.get("PILOSA_TPU_BENCH_MIXED_S", "3.0"))
+MIXED_SHARDS = 64  # sustained mixed read/write geometry
+TQ_SHARDS = 8  # time-quantum range-query geometry
 
 
 def _median_ms(fn, reps):
@@ -530,6 +533,172 @@ def main():
         ingest_dirty_restage_mb = (
             hbm_res.stats_snapshot()["restage_bytes"] - restage0
         ) / (1 << 20)
+
+        # ---- deferred-delta merge barrier roofline (ISSUE 9) ----
+        # the read barrier a staged burst pays: per-fragment host merges
+        # (the pre-ISSUE-9 path, ~a dozen small-numpy calls + a lock per
+        # staged fragment) vs the cross-fragment barrier (ONE batched
+        # sort/dedup pass for the whole burst, core/merge.py). The burst
+        # shape is the classic low-cardinality ingest: a handful of hot
+        # rows spread across every shard — per-FRAGMENT overhead is
+        # exactly what the barrier amortizes. merge_barrier_ms rides the
+        # shipped AUTO crossover (host pass on a CPU dev host, device
+        # program on an accelerator); the forced-device run below pins
+        # the one-launch contract on the compiled program itself.
+        from pilosa_tpu.core import merge as merge_mod
+
+        std = f.view("standard")
+        burst_bits = 200_000
+        # keep the roofline bursts STAGED: the op-count snapshot trigger
+        # would otherwise merge them eagerly mid-section (in-memory
+        # snapshots are cheap resets, but they'd empty the barrier)
+        for fr in std.fragments.values():
+            fr.max_op_n = max(fr.max_op_n, 1 << 22)
+
+        def stage_burst():
+            r = rng.integers(3, 8, burst_bits).astype(np.uint64)
+            c = rng.integers(0, n_shards * SHARD_WIDTH, burst_bits).astype(
+                np.uint64
+            )
+            f.import_bits(r, c)
+
+        stage_burst()  # warm: touched rows get stored sparse content
+        std.sync_pending()
+        for fr in std.fragments.values():
+            fr.sync_pending_now()  # materialize overlays: clean baseline
+        stage_burst()
+        frags = [fr for fr in std.fragments.values() if fr._pending_n]
+        t0 = time.perf_counter()
+        for fr in frags:
+            fr.sync_pending_now()
+        merge_perfrag_host_ms = (time.perf_counter() - t0) * 1000
+        stage_burst()
+        merge_mod.reset_stats()
+        t0 = time.perf_counter()
+        std.sync_pending()
+        merge_barrier_ms = (time.perf_counter() - t0) * 1000
+        msnap = merge_mod.stats_snapshot()
+        assert msnap["barriers"] == 1, msnap
+        # the deferred row-store materialization the barrier parked
+        # (installed at each fragment's next HOST read; the device path
+        # reads patched extents and never pays it) — reported so the
+        # barrier number is honest about what moved off the write path
+        t0 = time.perf_counter()
+        for fr in std.fragments.values():
+            fr.sync_pending_now()
+        merge_install_ms = (time.perf_counter() - t0) * 1000
+        # forced-device: the 954-fragment burst pays ONE program launch
+        merge_mod.configure(device_threshold=0)
+        stage_burst()  # warm: compiles the merge program's pow2 bucket
+        std.sync_pending()
+        stage_burst()
+        merge_mod.reset_stats()
+        t0 = time.perf_counter()
+        std.sync_pending()
+        merge_barrier_device_ms = (time.perf_counter() - t0) * 1000
+        msnap = merge_mod.stats_snapshot()
+        assert msnap["barriers"] == 1 and msnap["device"] == 1, msnap
+        merge_mod.configure(device_threshold=None)  # back to AUTO
+
+        # ---- sustained mixed read/write (the production workload) ----
+        # continuous staged ingest against one index while Count/TopN
+        # queries stream in: every query's read barrier merges whatever
+        # the writer staged since the last one. Throughput and query
+        # tail latency are read from the PR 6 flight-recorder histograms
+        # (per-index query_ms series).
+        api.create_index("mx")
+        api.create_field("mx", "f")
+        mf = srv.holder.index("mx").field("f")
+        m_shape = (MIXED_SHARDS, WORDS_PER_ROW)
+        mw = rng.integers(0, 2**32, m_shape, np.uint32)
+        for s in range(MIXED_SHARDS):
+            mf.import_row_words(1, s, mw[s] & (mw[s] >> np.uint32(1)))
+            mf.import_row_words(2, s, mw[s] & (mw[s] << np.uint32(1)))
+        q_mix_count = "Count(Row(f=1))"
+        q_mix_topn = "TopN(f, n=50)"
+        api.query("mx", q_mix_count)  # warm: stage + compile
+        api.query("mx", q_mix_topn)
+        # drop the warm-up observations so the histogram holds ONLY
+        # queries issued under ingest pressure
+        srv.stats.registry.drop_label("index", "mx")
+        stop = threading.Event()
+        wrote = [0]
+        writer_errs = []
+
+        def mixed_writer():
+            try:
+                wrng = np.random.default_rng(99)
+                batch = 20_000
+                while not stop.is_set():
+                    r = wrng.integers(3, 33, batch).astype(np.uint64)
+                    c = wrng.integers(
+                        0, MIXED_SHARDS * SHARD_WIDTH, batch
+                    ).astype(np.uint64)
+                    mf.import_bits(r, c)
+                    wrote[0] += batch
+            except BaseException as e:  # noqa: BLE001 - fail the bench
+                writer_errs.append(e)
+
+        mb0 = merge_mod.stats_snapshot()
+        patches0 = hbm_res.stats_snapshot()["extent_patches"]
+        wt = threading.Thread(target=mixed_writer)
+        t0 = time.perf_counter()
+        wt.start()
+        try:
+            mixed_queries = 0
+            while time.perf_counter() - t0 < MIXED_SECONDS:
+                api.query("mx", q_mix_count)
+                api.query("mx", q_mix_topn)
+                mixed_queries += 2
+        finally:
+            stop.set()
+            wt.join()
+        assert not writer_errs, writer_errs  # a dead writer fakes the numbers
+        mixed_elapsed = time.perf_counter() - t0
+        ingest_mixed_bits_mps = wrote[0] / mixed_elapsed / 1e6
+        reg = srv.stats.registry
+        query_p50_under_ingest_ms = reg.quantile(
+            "query_ms", 0.5, tags=("index:mx",)
+        )
+        query_p99_under_ingest_ms = reg.quantile(
+            "query_ms", 0.99, tags=("index:mx",)
+        )
+        mb1 = merge_mod.stats_snapshot()
+        mixed_merge_barriers = mb1["barriers"] - mb0["barriers"]
+        mixed_merge_barrier_ms_mean = (
+            (mb1["barrier_ms"] - mb0["barrier_ms"]) / mixed_merge_barriers
+            if mixed_merge_barriers
+            else 0.0
+        )
+        mixed_extent_patches = (
+            hbm_res.stats_snapshot()["extent_patches"] - patches0
+        )
+
+        # ---- time-quantum range path (ROADMAP item 5 baseline) ----
+        from datetime import datetime, timedelta
+
+        from pilosa_tpu.core.field import FIELD_TYPE_TIME, FieldOptions
+
+        api.create_index("tqx")
+        tf = srv.holder.index("tqx").create_field(
+            "e", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD")
+        )
+        tq_bits = 50_000
+        t_base = datetime(2019, 1, 1)
+        tq_rows = rng.integers(1, 5, tq_bits).astype(np.uint64)
+        tq_cols = rng.integers(0, TQ_SHARDS * SHARD_WIDTH, tq_bits).astype(
+            np.uint64
+        )
+        tq_days = rng.integers(0, 45, tq_bits)
+        tf.import_bits(
+            tq_rows,
+            tq_cols,
+            timestamps=[t_base + timedelta(days=int(d)) for d in tq_days],
+        )
+        q_tq = "Count(Range(e=1, 2019-01-05T00:00, 2019-01-20T00:00))"
+        (tq_count,) = api.query("tqx", q_tq)  # warm
+        assert int(tq_count) > 0, tq_count
+        timeq_range_ms = _median_ms(lambda: api.query("tqx", q_tq), 5)
     finally:
         srv.stop()
 
@@ -591,6 +760,30 @@ def main():
                     "ingest_dirty_restage_mb": round(
                         ingest_dirty_restage_mb, 2
                     ),
+                    "merge_barrier_ms": round(merge_barrier_ms, 3),
+                    "merge_perfrag_host_ms": round(
+                        merge_perfrag_host_ms, 3
+                    ),
+                    "merge_barrier_device_ms": round(
+                        merge_barrier_device_ms, 3
+                    ),
+                    "merge_install_ms": round(merge_install_ms, 3),
+                    "ingest_mixed_bits_mps": round(
+                        ingest_mixed_bits_mps, 2
+                    ),
+                    "query_p50_under_ingest_ms": round(
+                        query_p50_under_ingest_ms, 3
+                    ),
+                    "query_p99_under_ingest_ms": round(
+                        query_p99_under_ingest_ms, 3
+                    ),
+                    "mixed_queries": mixed_queries,
+                    "mixed_merge_barriers": mixed_merge_barriers,
+                    "mixed_merge_barrier_ms_mean": round(
+                        mixed_merge_barrier_ms_mean, 3
+                    ),
+                    "mixed_extent_patches": mixed_extent_patches,
+                    "timeq_range_ms": round(timeq_range_ms, 3),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),
                     "topn_filtered_device_ms": round(topn_filtered_device_ms, 3),

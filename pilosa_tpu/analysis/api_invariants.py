@@ -64,6 +64,7 @@ _SECTION_CLASSES = {
     "ClusterConfig": "cluster",
     "SchedConfig": "sched",
     "HbmConfig": "hbm",
+    "IngestConfig": "ingest",
     "ResizeConfig": "resize",
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",

@@ -67,6 +67,17 @@ class HbmConfig:
 
 
 @dataclass
+class IngestConfig:
+    # bulk-ingest merge barrier (core/merge.py; docs/configuration.md
+    # "Ingest"): staged deltas merge cross-fragment-batched at read
+    # barriers — one device program launch per burst at or above the
+    # threshold, one vectorized host pass below it. None = AUTO
+    # (65536 on a real accelerator, device-off on the CPU backend,
+    # where the XLA sort is the same silicon ~6x slower than numpy's)
+    merge_device_threshold: Optional[int] = None  # <0 never, 0 always
+
+
+@dataclass
 class ResizeConfig:
     # live elastic resize (streaming resharding under traffic;
     # docs/configuration.md "Elastic resize"): moving fragments stream as
@@ -142,6 +153,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     resize: ResizeConfig = field(default_factory=ResizeConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -218,6 +230,7 @@ class Config:
             ("cluster", self.cluster),
             ("sched", self.sched),
             ("hbm", self.hbm),
+            ("ingest", self.ingest),
             ("resize", self.resize),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
@@ -227,9 +240,13 @@ class Config:
         ):
             out.append(f"\n[{sect_name}]")
             for f_ in dataclasses.fields(sect):
+                val = getattr(sect, f_.name)
+                if val is None:
+                    # TOML has no null: an unset knob (e.g. the AUTO
+                    # merge-device-threshold) is expressed by omission
+                    continue
                 out.append(
-                    f"{f_.name.replace('_', '-')} = "
-                    f"{_toml_value(getattr(sect, f_.name))}"
+                    f"{f_.name.replace('_', '-')} = {_toml_value(val)}"
                 )
         return "\n".join(out) + "\n"
 

@@ -148,6 +148,14 @@ def _build_parser() -> argparse.ArgumentParser:
         "batches ship to their owner nodes on a pool this wide)",
     )
     sp.add_argument(
+        "--merge-device-threshold", type=int,
+        help="staged positions per read-barrier burst at which the "
+        "cross-fragment deferred-delta merge dispatches the device "
+        "program instead of the vectorized host pass (<0 never, "
+        "0 always; unset = backend auto — 65536 on an accelerator, "
+        "never on the CPU backend)",
+    )
+    sp.add_argument(
         "--resize-transfer-concurrency", type=int,
         help="parallel fragment transfer legs per node during a "
         "streaming resize",
@@ -242,6 +250,7 @@ _FLAG_KNOBS = {
     "hbm_extent_rows": ("hbm", "extent_rows"),
     "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
     "hbm_pin_timeout": ("hbm", "pin_timeout"),
+    "merge_device_threshold": ("ingest", "merge_device_threshold"),
     "resize_transfer_concurrency": ("resize", "transfer_concurrency"),
     "resize_cutover_timeout": ("resize", "cutover_timeout"),
     "resize_resume_policy": ("resize", "resume_policy"),
@@ -382,6 +391,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         hbm_extent_rows=cfg.hbm.extent_rows,
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,
+        merge_device_threshold=cfg.ingest.merge_device_threshold,
         import_concurrency=cfg.import_concurrency,
         resize_transfer_concurrency=cfg.resize.transfer_concurrency,
         resize_cutover_timeout=cfg.resize.cutover_timeout,

@@ -302,18 +302,28 @@ class DeviceCache:
                 if cov is None or shard in cov:
                     self._drop_locked(key)
 
-    def invalidate_owner_shards(self, owner: Hashable, shards) -> None:
-        """invalidate_owner_shard for a whole dirty-shard batch under one
-        lock hold (a bulk import touching hundreds of shards runs ONE
-        coverage pass, not one per shard)."""
-        ss = set(shards)
-        if not ss:
-            return
+    def invalidate_owner_uncovered(self, owner: Hashable) -> None:
+        """Drop this owner's entries with NO registered shard coverage
+        (ad-hoc builds like the TopN tally bundles, which are not
+        version-keyed). The staged write path invalidates these eagerly
+        while coverage-registered extents — version-keyed, hence never
+        served stale — defer to the merge barrier's patch-or-invalidate
+        reconciliation (core/view.py sync_pending)."""
         with self._mu:
             for key in list(self._by_owner.get(owner, ())):
-                cov = self._cover.get(key)
-                if cov is None or not ss.isdisjoint(cov):
+                if self._cover.get(key) is None:
                     self._drop_locked(key)
+
+    def owner_entries(self, owner: Hashable):
+        """Snapshot of one owner's live entries as
+        [(key, coverage_or_None, is_extent)] under one lock hold — the
+        merge barrier's extent reconciliation walks this to decide
+        patch vs invalidate per entry."""
+        with self._mu:
+            return [
+                (k, self._cover.get(k), k in self._extent_keys)
+                for k in self._by_owner.get(owner, ())
+            ]
 
     def clear(self) -> None:
         with self._mu:

@@ -33,6 +33,7 @@ from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core import cache as cachemod
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
+from pilosa_tpu.core import merge as merge_mod
 from pilosa_tpu.core import rowstore as rowstore_mod
 from pilosa_tpu.core.rowstore import RowBits
 from pilosa_tpu.utils.arrays import group_slices
@@ -258,6 +259,29 @@ class Fragment:
         # _pending_n so the hot check is one int compare.
         self._pending: List[np.ndarray] = []
         self._pending_n = 0
+        # Cross-fragment merge handshake (core/merge.py): `_pending_gen`
+        # bumps whenever pending parts are consumed (per-fragment
+        # _sync_locked, batched apply_merged_delta, from_bytes reset) so
+        # a barrier that snapshotted parts can tell whether a concurrent
+        # path already merged them; `_staged_base_version` is the
+        # mutation version just BEFORE the first un-merged staged batch
+        # (each staged batch bumps version by exactly one), which is the
+        # version a resident extent must be keyed at for the in-place
+        # patch to be exact.
+        self._pending_gen = 0
+        self._staged_base_version = 0
+        # Pre-merged delta layers (core/merge.py barrier outcome): each
+        # is the fragment's slice of one burst's globally sorted+deduped
+        # staged positions, NOT yet materialized into RowBits. The
+        # barrier pays O(burst) only — the device stays exact via
+        # in-place extent patches built from the same merged delta —
+        # and the host row store catches up at the next HOST read:
+        # every host read funnels through _sync_locked, which folds the
+        # layers into the one vectorized merge pass it already runs for
+        # raw pending parts (layers and pending share the row-major
+        # uint64 key format). Bounded by _LAYER_CAP.
+        self._premerged: List[np.ndarray] = []
+        self._premerged_n = 0
         # Device residency goes through the process-global budgeted LRU
         # (core/devcache.py): per-row arrays under _token, multi-row stacks
         # under _stack_token (stacks are invalidated wholesale on mutation).
@@ -330,17 +354,42 @@ class Fragment:
                         self._rows = rows
                 for op, positions in walmod.replay_wal(self.wal_path):
                     if op == walmod.OP_ROW_WORDS:
+                        # commutes with staged SETs (both only set bits):
+                        # no flush needed before the word union
                         self._apply_row_words(
                             int(positions[0]),
                             np.ascontiguousarray(positions[1:]).view(np.uint32),
                         )
+                    elif op == walmod.OP_SET and self._mutex_map is None:
+                        # replay fast path: staged OP_SET frames are
+                        # already durable (they ARE the WAL), so they
+                        # re-stage straight into the pending buffer and
+                        # land via ONE deferred merge at the first read
+                        # barrier instead of one exact apply per frame
+                        if not self._pending:
+                            self._staged_base_version = self.version
+                        self._pending.append(
+                            positions.astype(np.uint64, copy=False)
+                        )
+                        self._pending_n += len(positions)
+                        self.version += 1
                     else:
+                        # clears do not commute with staged sets: merge
+                        # the pending prefix first so replay order holds
+                        self._sync_locked()
                         self._apply_positions(
                             positions if op == walmod.OP_SET else np.empty(0, np.uint64),
                             positions if op == walmod.OP_CLEAR else np.empty(0, np.uint64),
                         )
                     self._op_n += len(positions)
                     replayed += 1
+                if self._pending:
+                    # land the whole staged replay suffix as ONE deferred
+                    # merge (the fast path's contract: N staged frames,
+                    # one vectorized pass) so open() returns a fully
+                    # merged fragment — the rank-cache rebuild below
+                    # reads _rows directly
+                    self._sync_locked()
                 self._wal = walmod.WalWriter(self.wal_path)
             if self._mutex_map is not None:
                 self._rebuild_mutex_map()
@@ -669,6 +718,8 @@ class Fragment:
             self._check_write_block_locked()
             self._wal_append(walmod.OP_SET, positions)
             self._capture_record(walmod.OP_SET, positions)
+            if not self._pending:
+                self._staged_base_version = self.version
             self._pending.append(positions)
             self._pending_n += n
             self._op_n += n
@@ -688,12 +739,20 @@ class Fragment:
         funnel through row_words, so a staged-then-queried fragment is
         merged exactly once, not per row. Device invalidation and version
         bumps already happened at stage time — this only moves bits and
-        reconciles the rank cache."""
-        if not self._pending_n:
+        reconciles the rank cache. Pre-merged barrier layers fold into
+        the same single pass (they are already sorted/deduped row-major
+        keys, the exact format of a raw pending part)."""
+        if not self._pending_n and not self._premerged:
             return
-        parts = self._pending
+        if self._pending:
+            # parked layers were already booked at their barrier
+            merge_mod.note_host_sync(len(self._pending))
+        parts = self._premerged + self._pending
+        self._premerged = []
+        self._premerged_n = 0
         self._pending = []
         self._pending_n = 0
+        self._pending_gen += 1  # a barrier's snapshot of `parts` is stale now
         inc = parts[0] if len(parts) == 1 else np.concatenate(parts)
         touched: set = set()
         self._bulk_set_sparse(inc, touched)
@@ -704,6 +763,77 @@ class Fragment:
         )
         if rowstore_mod.PARANOIA:
             self._paranoia_check(touched)
+
+    # -- cross-fragment merge barrier handshake (core/merge.py) --------
+
+    def sync_pending_now(self) -> None:
+        """Force the per-fragment merge (the barrier's fallback when key
+        packing would overflow, and the bench's per-fragment baseline)."""
+        with self._mu:
+            self._sync_locked()
+
+    def pending_snapshot(self):
+        """Barrier phase 1: (parts, n_parts, gen, base_version) of the
+        CURRENT pending delta, or None when there is nothing staged.
+        `parts` is a copy of the list (the arrays are shared — staged
+        buffers are append-only); nothing is popped, so a concurrent
+        per-fragment read barrier stays exact."""
+        with self._mu:
+            if not self._pending:
+                return None
+            return (
+                list(self._pending),
+                len(self._pending),
+                self._pending_gen,
+                self._staged_base_version,
+            )
+
+    # Parked pre-merged layers above this many total keys fold into the
+    # row store inline at the barrier instead of lazily at the next
+    # host read: the layers pin the barriers' shared merged buffers,
+    # and a fragment nobody host-reads must not accumulate them
+    # without bound.
+    _LAYER_CAP = 1 << 20
+
+    def apply_merged_delta(
+        self,
+        keys_local: np.ndarray,
+        n_parts: int,
+        captured_n: int,
+        gen: int,
+    ) -> Optional[int]:
+        """Barrier phase 2: accept the burst's merged delta —
+        `keys_local` is this fragment's slice of the globally
+        sorted+deduped staged positions (row-major uint64 keys, the
+        same format as a raw pending part) covering exactly the first
+        `n_parts` pending batches — trim those batches and PARK the
+        layer. Returns the fragment's current version, or None when
+        `gen` is stale (a concurrent `_sync_locked` already merged the
+        captured parts, so applying again would only redo finished
+        work).
+
+        Materialization into RowBits is DEFERRED to the fragment's
+        next HOST read: `_sync_locked` folds parked layers into the
+        one vectorized merge pass it already runs — the contract that
+        already ordered staged deltas before row reads. The device
+        path needs no host rows at all (resident extents are patched
+        in place with this same merged delta), so a barrier under
+        sustained device-served load pays O(burst), never a row-store
+        rewrite. WAL durability is untouched — the staged frames stay
+        on disk until a snapshot, and a crash replays them into
+        pending as before."""
+        with self._mu:
+            if gen != self._pending_gen:
+                return None
+            del self._pending[:n_parts]
+            self._pending_n -= captured_n
+            self._pending_gen += 1
+            self._staged_base_version += n_parts
+            self._premerged.append(keys_local)
+            self._premerged_n += len(keys_local)
+            if self._premerged_n > self._LAYER_CAP:
+                self._sync_locked()  # bound the parked-layer debt
+            return self.version
 
     def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
         # The single EXACT mutation funnel: every write path (including WAL
@@ -1461,9 +1591,13 @@ class Fragment:
         with self._mu:
             # pending deltas describe the REPLACED contents; the forced
             # snapshot below truncates their WAL records with everything
-            # else, so they must not merge into the new rows
+            # else, so they must not merge into the new rows. The gen
+            # bump invalidates any in-flight barrier snapshot of them.
             self._pending = []
             self._pending_n = 0
+            self._pending_gen += 1
+            self._premerged = []  # replaced contents: parked layers are void
+            self._premerged_n = 0
             if self._captures:
                 # a wholesale replace invalidates every in-flight
                 # transfer's snapshot+delta contract: force peers to

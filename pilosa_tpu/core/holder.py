@@ -134,11 +134,16 @@ class Holder:
                         yield frag
 
     def staged_position_count(self) -> int:
-        """WAL-staged write positions not yet merged into row stores
-        (the bulk-ingest fast path defers merges to read barriers). A
-        large, growing value means readers are starved or ingest has
-        outrun the merge — /cluster/health surfaces it as staging debt."""
-        return sum(frag._pending_n for frag in self.fragments())
+        """WAL-staged write positions not yet materialized into row
+        stores: raw pending deltas plus barrier-merged layers still
+        parked for the next host read (the bulk-ingest fast path defers
+        merges to read barriers; the cross-fragment barrier defers the
+        row-store rewrite further, to host reads). A large, growing
+        value means ingest has outrun materialization — /cluster/health
+        surfaces it as staging debt (the WAL still covers every bit)."""
+        return sum(
+            frag._pending_n + frag._premerged_n for frag in self.fragments()
+        )
 
     def flush_caches(self) -> None:
         """Persist every fragment's rank cache (reference: holder.go:506

@@ -45,6 +45,12 @@ class View:
         self.fragments: Dict[int, Fragment] = {}
         # owner token for cross-shard row stacks in the global device cache
         self._stack_token = new_owner_token()
+        # shards with staged writes whose covering stack extents were NOT
+        # invalidated at stage time (they are version-keyed, so they can
+        # never be served stale): the merge barrier's reconciliation
+        # either patches them in place to the merged version or drops
+        # them (sync_pending -> _reconcile_extents)
+        self._dirty_staged: set = set()
 
     def open(self) -> "View":
         """Load existing fragments from disk (view.go:120 openFragments)."""
@@ -68,6 +74,7 @@ class View:
             # per-index attribution must not resurrect the label after
             # telemetry GC
             DEVICE_CACHE.invalidate_owner(self._stack_token)
+            self._dirty_staged.clear()
 
     def _fragment_path(self, shard: int) -> Optional[str]:
         if self.path is None:
@@ -152,6 +159,187 @@ class View:
     def _frag_versions(frags) -> tuple:
         return tuple(f.version if f is not None else -1 for f in frags)
 
+    # -- cross-fragment merge barrier (core/merge.py) ----------------------
+
+    def sync_pending(self, shards=None, frags=None) -> None:
+        """Read barrier over many fragments at once: gather every listed
+        (default: every) fragment's staged pending delta and merge the
+        whole burst in ONE batched pass — device program or vectorized
+        host pass by the `merge-device-threshold` crossover — instead of
+        one `_sync_locked` host pass per fragment. Afterwards, resident
+        stack extents covering the written shards are patched in place
+        on device (or dropped when unpatchable) so sustained mixed load
+        does not oscillate between invalidate and ~32 MB re-stages. No
+        fragment lock is held across another's, and none during the
+        merge itself."""
+        from pilosa_tpu.core import merge as merge_mod
+
+        if frags is None:
+            with self._mu:
+                if shards is None:
+                    frags = list(self.fragments.values())
+                else:
+                    frags = [self.fragments.get(s) for s in shards]
+        merges = merge_mod.merge_barrier(frags)
+        # reconcile ONLY the shards this barrier covered: a query over a
+        # disjoint shard span must not invalidate (and forget) other
+        # shards' still-patchable extents — they stay dirty until their
+        # own barrier merges them
+        synced = {f.shard for f in frags if f is not None}
+        with self._mu:
+            dirty = self._dirty_staged & synced
+        if merges or dirty:
+            self._reconcile_extents(merges, dirty)
+
+    def _reconcile_extents(self, merges, dirty: set) -> None:
+        """Patch-or-invalidate every stack entry covering a shard whose
+        staged delta just merged (or merged earlier via a per-fragment
+        host barrier — `dirty` remembers those). An entry is patched
+        only when every affected shard's fragment was `clean` (moved
+        base -> base+n_parts by exactly the captured staged batches;
+        batches staged mid-barrier stay pending and re-key the entry
+        forward at their own barrier) AND the entry is keyed at exactly
+        the pre-burst version; anything else drops it — the version
+        keys already made it unservable."""
+        patches = {m.shard: m for m in merges if m.clean}
+        affected = dirty | {m.shard for m in merges}
+        stale = affected - set(patches)
+        if not affected:
+            return
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        patchable = pmesh.active_mesh() is None  # never touch sharded arrays
+        for key, cover, is_extent in DEVICE_CACHE.owner_entries(
+            self._stack_token
+        ):
+            if cover is None:
+                # no registered coverage => not version-keyed: drop
+                # conservatively (same rule as invalidate_owner_shard)
+                DEVICE_CACHE.invalidate(key)
+                continue
+            hit = cover & affected
+            if not hit:
+                continue
+            if (
+                not patchable
+                or (hit & stale)
+                or not self._patch_entry(key, hit, patches, is_extent)
+            ) and not self._entry_current(key, hit):
+                # keep-if-current guards the races this reconcile can't
+                # see: a concurrent barrier may have ALREADY patched the
+                # entry to the fragments' live versions (this thread's
+                # stale apply lost the generation race), or a dirty
+                # marker may describe a write another barrier fully
+                # reconciled — an entry keyed at the current versions
+                # is exact by construction and must not be dropped
+                DEVICE_CACHE.invalidate(key)
+        with self._mu:
+            self._dirty_staged -= affected
+
+    def _entry_current(self, key, hit: set) -> bool:
+        """True when the entry's version key matches every hit shard's
+        fragment CURRENT version — i.e. the entry is exact right now
+        and any 'stale' verdict about it is outdated. Lock-free version
+        reads: a racing mutation makes the entry stale-by-key anyway
+        (a wrong keep leaks one unservable entry until eviction, never
+        a wrong answer), and the mutation re-marks the shard dirty so a
+        later reconcile retries."""
+        if key[0] != self._stack_token or len(key) < 6:
+            return False
+        tail = key[5:]
+        if tail[0] == "ext" and len(tail) == 4:
+            versions = tail[3]
+            lo = tail[2] * tail[1]
+        elif tail[0] == "mono" and len(tail) == 2:
+            versions = tail[1]
+            lo = 0
+        else:
+            return False
+        span = key[3][lo : lo + len(versions)]
+        for p, s in enumerate(span):
+            if s in hit:
+                frag = self.fragments.get(s)
+                if frag is None or versions[p] != frag.version:
+                    return False
+        return True
+
+    def _patch_entry(self, key, hit: set, patches, is_extent: bool) -> bool:
+        """Rebuild one resident stack entry as (old contents | merged
+        delta) ON DEVICE and re-insert it under the post-merge version
+        key. True = reconciled (patched, or provably gone); False = the
+        caller must invalidate. Exactness: the entry must be keyed at
+        each patched fragment's pre-burst `base_version`, and the
+        fragment must have been `clean` — content(base) | delta ==
+        content(new) holds only when nothing else mutated in between."""
+        import jax
+
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        if key[0] != self._stack_token or len(key) < 6:
+            return False
+        if key[4] != pmesh.mesh_epoch():
+            return False  # pre-mesh-change entry: a patched key is dead
+        kind, ident, shards_t = key[1], key[2], key[3]
+        tail = key[5:]
+        if tail[0] == "ext" and len(tail) == 4:
+            rows_per, ei, versions = tail[1], tail[2], tail[3]
+            lo = ei * rows_per
+        elif tail[0] == "mono" and len(tail) == 2:
+            versions = tail[1]
+            lo = 0
+        else:
+            return False
+        span = shards_t[lo : lo + len(versions)]
+        if kind == "row":
+            row_ids = [ident]
+        elif kind == "planes":
+            row_ids = list(ident)
+        else:
+            return False
+        upd = list(versions)
+        deltas = []
+        for p, s in enumerate(span):
+            if s not in hit:
+                continue
+            m = patches.get(s)
+            if m is None or versions[p] != m.base_version:
+                return False
+            upd[p] = m.new_version
+            deltas.append((p, m))
+        if not deltas:
+            return False
+        arr = DEVICE_CACHE.get(key)
+        if arr is None:
+            return True  # evicted meanwhile: nothing resident to go stale
+        new_arr = arr
+        for p, m in deltas:
+            for d, rid in enumerate(row_ids):
+                if rid not in m.rows:
+                    continue  # row untouched by the delta: re-key only
+                widx, wvals = m.word_delta(rid)
+                if not len(widx):
+                    continue
+                delta = np.zeros(WORDS_PER_ROW, np.uint32)
+                delta[widx] = wvals
+                ddev = jax.device_put(delta)
+                if kind == "row":
+                    new_arr = new_arr.at[p].set(new_arr[p] | ddev)
+                else:
+                    new_arr = new_arr.at[d, p].set(new_arr[d, p] | ddev)
+        new_key = key[:5] + (
+            ("ext", rows_per, ei, tuple(upd))
+            if tail[0] == "ext"
+            else ("mono", tuple(upd))
+        )
+        DEVICE_CACHE.put(
+            new_key, new_arr, extent=is_extent, shards=span, index=self.index
+        )
+        DEVICE_CACHE.invalidate(key)
+        from pilosa_tpu.hbm import residency as hbm_res
+
+        hbm_res.note_extent_patch()
+        return True
+
     def row_stack(self, row_id: int, shards, extents=None) -> Optional[object]:
         """uint32[S, W] device stack of one row over `shards`, or None when
         no listed shard has a fragment (the row is wholly absent).
@@ -163,6 +351,10 @@ class View:
             frags = [self.fragments.get(s) for s in shards]
         if all(f is None for f in frags):
             return None
+        # merge the staged burst (all touched fragments, one pass) and
+        # patch/drop covering extents BEFORE versions are read below, so
+        # the staged keys reflect the merged state
+        self.sync_pending(frags=frags)
         key = self._stack_key("row", row_id, shards)
 
         def build_slice(lo: int, hi: int):
@@ -213,7 +405,15 @@ class View:
             tokens.append(frag._stack_token)
             dirty.append(int(shard))
         DEVICE_CACHE.invalidate_owners(tokens)
-        DEVICE_CACHE.invalidate_owner_shards(self._stack_token, dirty)
+        # view-level stack entries: ad-hoc (uncovered) builds like the
+        # TopN tally bundles are not version-keyed, so they drop NOW;
+        # coverage-registered extents ARE version-keyed (never served
+        # stale) and defer to the merge barrier, which patches resident
+        # ones in place with the merged delta instead of forcing a
+        # ~extent-sized PCIe re-stage per touched extent
+        DEVICE_CACHE.invalidate_owner_uncovered(self._stack_token)
+        with self._mu:
+            self._dirty_staged.update(dirty)
 
     def plane_stack(self, row_ids, shards, extents=None) -> Optional[object]:
         """uint32[D, S, W] device stack (BSI planes × shards), or None when
@@ -227,6 +427,7 @@ class View:
             frags = [self.fragments.get(s) for s in shards]
         if all(f is None for f in frags):
             return None
+        self.sync_pending(frags=frags)
         key = self._stack_key("planes", row_ids, shards)
 
         def build_slice(lo: int, hi: int):

@@ -2188,7 +2188,13 @@ class Executor:
             for s in shard_list
             if (frag := v.fragment_if_exists(s)) is not None
         ]
-        return (v, present) if present else None
+        if not present:
+            return None
+        # cross-fragment merge barrier: rank caches and tally bundles are
+        # about to read every present fragment — merge the whole staged
+        # burst as one batched pass, not one host pass per fragment
+        v.sync_pending(frags=[frag for _, frag in present])
+        return (v, present)
 
     def _stacked_filter(self, idx: Index, filter_call: Call, present):
         """Lower a filter bitmap over the present (shard, fragment) pairs

@@ -56,6 +56,15 @@ def reset_stats() -> None:
     STATS["evals"] = 0
 
 
+def dispatch_mutex() -> TrackedLock:
+    """The one-compiled-program-at-a-time mutex. Non-plan compiled
+    dispatches (the cross-fragment deferred-delta merge, ops/merge.py)
+    ride the same lock so the execution model stays one program on the
+    device at a time; single-device callers release it BEFORE their
+    blocking host read (no collective rendezvous to deadlock)."""
+    return _DISPATCH_MU
+
+
 class Unsupported(Exception):
     """Raised during lowering when a call shape has no stacked form; the
     executor falls back to the per-shard path."""

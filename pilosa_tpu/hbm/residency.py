@@ -55,6 +55,10 @@ _counters: Dict[str, int] = {
     "restage_bytes": 0,  # host->device upload bytes through this layer
     "prefetch_hits": 0,  # query staging hit an extent the prefetcher warmed
     "prefetch_staged": 0,  # extents the prefetcher uploaded
+    # resident extents rewritten in place (old words | merged staged
+    # delta, on device) instead of invalidated + re-staged over PCIe —
+    # the merge barrier's reconciliation books these (core/view.py)
+    "extent_patches": 0,
 }
 # per-owner-index restage attribution ("-" collects staging not bound to
 # an index); dropped by drop_index() when the index is deleted so a
@@ -121,8 +125,15 @@ def stats_snapshot() -> Dict[str, int]:
             "restage_by_index": dict(_restage_by_index),
             "prefetch_hits": _counters["prefetch_hits"],
             "prefetch_staged": _counters["prefetch_staged"],
+            "extent_patches": _counters["extent_patches"],
             "evicted_extent_bytes": snap["evicted_extent_bytes"],
         }
+
+
+def note_extent_patch() -> None:
+    """Book one in-place device-side extent patch (core/view.py
+    _patch_entry): a write that kept its covering extent resident."""
+    _bump("extent_patches")
 
 
 @contextmanager

@@ -148,6 +148,32 @@ def resident_bytes(idx, field_names: Optional[set] = None) -> int:
     return total
 
 
+def staged_merge_bytes(idx, field_names: Optional[set] = None) -> int:
+    """Bytes of staged-but-unmaterialized ingest delta the next read
+    barrier of this query's fields may have to merge (8-byte position
+    keys, the merge working set — core/merge.py): raw pending buffers
+    plus barrier-merged layers still parked for a host read. A query
+    arriving mid-burst pays that bill before its first dispatch (a
+    warm query over patched extents skips it, so this is the
+    conservative side). Metadata walk only: plain int reads per
+    fragment, no locks taken."""
+    total = 0
+    try:
+        fields = getattr(idx, "_fields", None) or {}
+        for name, f in fields.items():
+            if field_names is not None and name not in field_names:
+                continue
+            for v in getattr(f, "views", {}).values():
+                for frag in getattr(v, "fragments", {}).values():
+                    total += (
+                        int(getattr(frag, "_pending_n", 0))
+                        + int(getattr(frag, "_premerged_n", 0))
+                    ) * 8
+    except Exception:  # noqa: BLE001 - estimation must never fail
+        return 0
+    return total
+
+
 def _shard_count(idx, shards: Optional[Sequence[int]]) -> int:
     if shards is not None:
         return max(1, len(shards))
@@ -210,6 +236,10 @@ def estimate(
                 _referenced_fields(c, touched)
             if touched:
                 peak = max(0, peak - resident_bytes(idx, touched))
+                # staged-delta surcharge: this query's read barrier will
+                # merge the fields' pending ingest delta (device keys at
+                # 8 bytes/position) before it can dispatch
+                peak += staged_merge_bytes(idx, touched)
         return QueryCost(device_bytes=peak, sweeps=sweeps, write=write)
     except Exception:  # noqa: BLE001 - never fail admission on estimation
         return ZERO_COST

@@ -93,6 +93,7 @@ class NodeServer:
         hbm_extent_rows: int = 256,  # shards per operand extent; 0 = monolithic
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
+        merge_device_threshold: Optional[int] = None,  # None = backend AUTO
         import_concurrency: int = 8,  # parallel replica-import RPCs per call
         resize_transfer_concurrency: int = 4,  # parallel fragment fetches
         resize_cutover_timeout: float = 30.0,  # catch-up barrier bound, s
@@ -193,6 +194,12 @@ class NodeServer:
         hbmmod.configure(
             extent_rows=hbm_extent_rows, pin_timeout=hbm_pin_timeout
         )
+        # cross-fragment deferred-delta merge crossover (core/merge.py):
+        # process-global for the same reason as the [hbm] knobs — all
+        # in-process nodes share the one device the merge dispatches to
+        from pilosa_tpu.core import merge as merge_mod
+
+        merge_mod.configure(device_threshold=merge_device_threshold)
         self.prefetcher = None
         if hbm_prefetch_depth > 0 and self.scheduler is not None:
             self.prefetcher = hbmmod.Prefetcher(
@@ -527,6 +534,16 @@ class NodeServer:
         self.stats.gauge("hbm.resident_extents", hsnap["resident_extents"])
         self.stats.gauge("hbm.pinned_bytes", hsnap["pinned_bytes"])
         self.stats.gauge("hbm.prefetch_hits", hsnap["prefetch_hits"])
+        self.stats.gauge("hbm.extent_patches", hsnap["extent_patches"])
+        # cross-fragment deferred-delta merge barrier (core/merge.py):
+        # cumulative barrier wall ms, staged buffers merged through any
+        # path, and barriers that dispatched the device program
+        from pilosa_tpu.core import merge as merge_mod
+
+        msnap = merge_mod.stats_snapshot()
+        self.stats.gauge("ingest.merge_ms", msnap["barrier_ms"])
+        self.stats.gauge("ingest.merge_batches", msnap["batches"])
+        self.stats.gauge("ingest.merge_device", msnap["device"])
         # per-index attribution (the telemetry-plane families): who owns
         # the resident bytes, and who has been paying the restage bill.
         # hbm.resident_bytes sums over labels to the global devcache

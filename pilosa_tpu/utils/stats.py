@@ -89,6 +89,18 @@ STAT_NAMES = frozenset(
         "hbm.resident_bytes",
         "hbm.restage_bytes",
         "hbm.prefetch_hits",
+        # in-place device-side extent patches (core/view.py merge-barrier
+        # reconciliation): writes that kept their covering extent resident
+        # instead of forcing an invalidate + PCIe re-stage
+        "hbm.extent_patches",
+        # cross-fragment deferred-delta merge barrier (core/merge.py,
+        # refreshed at scrape time): cumulative barrier wall ms, staged
+        # buffers merged (any path), and barriers that dispatched the
+        # device merge program. Process-global like the hbm.* gauges —
+        # the merge rides the one shared device.
+        "ingest.merge_ms",
+        "ingest.merge_batches",
+        "ingest.merge_device",
         # live elastic resize (server/node.py streaming resharding):
         # per-fragment transfer legs, delta catch-up volume, cutover
         # latency and aborted jobs

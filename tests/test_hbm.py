@@ -181,10 +181,13 @@ class TestExtentPaging:
         assert ex.execute("hbmx", q)[0] == got2
 
     def test_dirty_extent_bulk_ingest_other_row(self, paging_env):
-        """A staged bulk import into OTHER rows of two shards dirties only
-        those shards' extents of the warm operand (fragment versions are
-        the extent key salt, so any write to a covered fragment re-keys
-        its extent — but never its neighbors')."""
+        """A staged bulk import into OTHER rows of two shards no longer
+        re-stages even the covering extents: the merge barrier's
+        reconciliation (ISSUE 9) patches the resident extents in place
+        to the post-merge version keys — the written row is not part of
+        the operand, so the patch is a pure re-key with ZERO PCIe bytes
+        (the invalidate+restage baseline paid one full extent per
+        touched shard)."""
         import numpy as np
 
         hbm_res.configure(extent_rows=1)
@@ -203,7 +206,89 @@ class TestExtentPaging:
         assert ex.execute("hbmx", q)[0] == got1  # row 0 unchanged
         snap2 = hbm_res.stats_snapshot()
         delta = snap2["restage_bytes"] - snap1["restage_bytes"]
-        assert delta == 2 * WORDS_PER_ROW * 4  # the two dirty extents only
+        baseline = 2 * WORDS_PER_ROW * 4  # invalidate+restage: two extents
+        assert delta == 0, delta  # patched in place: nothing re-shipped
+        assert delta < baseline
+        assert (
+            snap2["extent_patches"] - snap1["extent_patches"] == 2
+        )  # one per covering extent
+        # equality vs a cold full re-stage
+        DEVICE_CACHE.clear()
+        assert ex.execute("hbmx", q)[0] == got1
+
+    def test_extent_patch_same_row_content(self, paging_env):
+        """ISSUE 9 acceptance: a staged write INTO the warm operand's own
+        row is patched into the resident extent ON DEVICE (old words |
+        merged delta, re-keyed to the post-merge version) — the query
+        sees the new bits with ZERO restage bytes, where the
+        invalidate+restage baseline re-shipped the covering extent."""
+        import numpy as np
+
+        hbm_res.configure(extent_rows=4)  # 8 shards -> 2 extents
+        DEVICE_CACHE.budget_bytes = 1 << 30
+        S = 8
+        ex, h = _populated_executor(1, S)
+        q = "Count(Row(f=0))"
+        got1 = ex.execute("hbmx", q)[0]
+        snap1 = hbm_res.stats_snapshot()
+        f = h.index("hbmx").field("f")
+        # two fresh bits in row 0, shard 3: word 0 and a mid-row word
+        frag3 = f.view("standard").fragments[3]
+        w = frag3.row_words(0).copy()
+        free = [
+            int(i) * 32 + int(np.flatnonzero((w[i] & (1 << np.arange(32))) == 0)[0])
+            for i in np.flatnonzero(w != 0xFFFFFFFF)[:2]
+        ]
+        f.import_bits(
+            np.zeros(len(free), np.uint64),
+            np.array([3 * SHARD_WIDTH + c for c in free], np.uint64),
+        )
+        got2 = ex.execute("hbmx", q)[0]
+        assert got2 == got1 + len(free)  # the patched words carry the bits
+        snap2 = hbm_res.stats_snapshot()
+        assert snap2["restage_bytes"] == snap1["restage_bytes"]  # no PCIe re-stage
+        assert snap2["extent_patches"] - snap1["extent_patches"] == 1
+        # equality vs a cold full re-stage of the patched stack
+        DEVICE_CACHE.clear()
+        assert ex.execute("hbmx", q)[0] == got2
+
+    def test_subset_barrier_preserves_other_shards_patchability(
+        self, paging_env
+    ):
+        """A barrier over a SUBSET of shards must not invalidate (or
+        forget) still-patchable extents covering OTHER dirty shards: a
+        query population reading shards 0-3 under sustained ingest into
+        shards 0-7 would otherwise silently defeat in-place patching
+        for the 4-7 population (code-review finding on ISSUE 9)."""
+        import numpy as np
+
+        hbm_res.configure(extent_rows=4)  # 8 shards -> 2 extents
+        DEVICE_CACHE.budget_bytes = 1 << 30
+        S = 8
+        ex, h = _populated_executor(1, S)
+        q = "Count(Row(f=0))"
+        got1 = ex.execute("hbmx", q)[0]  # both extents resident
+        snap1 = hbm_res.stats_snapshot()
+        f = h.index("hbmx").field("f")
+        v = f.view("standard")
+        # stage row-9 bits into BOTH extents' shards
+        f.import_bits(
+            np.array([9, 9], np.uint64),
+            np.array([1 * SHARD_WIDTH + 1, 5 * SHARD_WIDTH + 1], np.uint64),
+        )
+        # subset barrier: only shard 1's fragment (extent 0)
+        v.sync_pending(frags=[v.fragments[1]])
+        assert 5 in v._dirty_staged  # shard 5 stays remembered
+        # shard 5's own barrier still patches its extent in place
+        v.sync_pending(frags=[v.fragments[5]])
+        assert ex.execute("hbmx", q)[0] == got1
+        snap2 = hbm_res.stats_snapshot()
+        assert snap2["restage_bytes"] == snap1["restage_bytes"], (
+            "subset barrier forced an extent re-stage"
+        )
+        assert snap2["extent_patches"] - snap1["extent_patches"] == 2
+        DEVICE_CACHE.clear()
+        assert ex.execute("hbmx", q)[0] == got1
 
     def test_cost_discount_scoped_to_referenced_fields(self, paging_env):
         """Field f's warm residency discounts f-queries only — a cold

@@ -1,0 +1,397 @@
+"""Cross-fragment deferred-delta merge tests (ISSUE 9 tentpole).
+
+The staged write path's read barrier no longer pays one host merge per
+fragment: core/merge.py gathers every staged fragment's pending buffers
+a read is about to touch and sort/dedups the whole burst in ONE batched
+pass — a compiled device program above the `merge-device-threshold`
+crossover, one vectorized host pass below it. These tests pin down:
+
+- kernel-level equivalence: ops/merge.py's device sort/dedup/bit-cumsum
+  vs the vectorized host path, bit-identical on duplicate-heavy bursts,
+- the ONE-launch contract: a staged burst across >= 100 fragments pays
+  exactly one device program launch (counter-asserted — the acceptance
+  criterion),
+- differential barrier equivalence vs naive per-bit semantics and vs
+  the per-fragment host merge, across duplicates, interleaved set/clear
+  batches and rank-cache TopN order (this file is in test_stress.py's
+  shard-width matrix, so the same assertions re-run at exponents 16/22),
+- the crossover-threshold boundary on both sides,
+- the WAL replay fast path (satellite): staged OP_SET frames re-stage at
+  open() and land via ONE deferred merge, bit-identical to the pre-crash
+  state including rank-cache order,
+- concurrent readers racing a barrier: the generation handshake keeps
+  the merge exactly-once and never drops a delta.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import merge as merge_mod
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops import merge as ops_merge
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture(autouse=True)
+def _merge_env():
+    """Restore the process-global crossover knob and counters around
+    every test (configure() is process-global like the [hbm] knobs;
+    the RAW value is saved so None round-trips back to backend AUTO)."""
+    old = merge_mod._device_threshold
+    yield
+    merge_mod.configure(device_threshold=old)
+    merge_mod.reset_stats()
+    ops_merge.reset_stats()
+
+
+def _pairs_set(field):
+    """{(row, absolute_col)} across every standard-view fragment — a
+    host read, so it forces the per-fragment read barrier."""
+    out = set()
+    v = field.view("standard")
+    if v is None:
+        return out
+    for s in v.available_shards():
+        rows, cols = v.fragments[s].pairs()
+        base = s * SHARD_WIDTH
+        out.update(
+            (int(r), int(c) + base)
+            for r, c in zip(rows.tolist(), cols.tolist())
+        )
+    return out
+
+
+def _cache_tops(field):
+    """{shard: rank-cache top pairs} — TopN order must survive however
+    the merge ran."""
+    v = field.view("standard")
+    return {s: v.fragments[s].cache_top() for s in v.available_shards()}
+
+
+def _burst(rng, n, n_shards, row_lo=0, row_hi=12):
+    rows = rng.integers(row_lo, row_hi, n).astype(np.uint64)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, n).astype(np.uint64)
+    return rows, cols
+
+
+class TestKernelEquivalence:
+    """ops/merge.py device program vs vectorized host pass."""
+
+    def test_sorted_unique_and_cumsum_identical(self):
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 1 << 40, 5000).astype(np.uint64)
+        keys = np.concatenate([keys, keys[:1700], keys[:11]])  # dup-heavy
+        md, cd = ops_merge.merge_keys_device(keys)
+        mh, ch = ops_merge.merge_keys_host(keys)
+        np.testing.assert_array_equal(md, mh)
+        np.testing.assert_array_equal(cd, ch)
+        assert md.dtype == np.uint64 and len(md) == len(np.unique(keys))
+
+    def test_word_or_matches_reference(self):
+        rng = np.random.default_rng(4)
+        pos = np.unique(rng.integers(0, SHARD_WIDTH, 4000).astype(np.uint64))
+        merged, cum = ops_merge.merge_keys_host(pos)
+        widx, wvals = ops_merge.word_or_from_sorted(merged, cum)
+        want = np.zeros(SHARD_WIDTH // 32, np.uint32)
+        for p in pos.tolist():
+            want[p >> 5] |= np.uint32(1) << np.uint32(p & 31)
+        got = np.zeros_like(want)
+        got[widx] = wvals
+        np.testing.assert_array_equal(got, want)
+
+    def test_word_or_mid_slice(self):
+        """word_or_from_sorted on a SLICE whose cumsum does not start at
+        the first key (the per-fragment split case): the wrapped base
+        subtraction must stay exact."""
+        rng = np.random.default_rng(5)
+        pos = np.unique(rng.integers(0, SHARD_WIDTH, 3000).astype(np.uint64))
+        merged, cum = ops_merge.merge_keys_host(pos)
+        lo = len(merged) // 3
+        widx, wvals = ops_merge.word_or_from_sorted(merged[lo:], cum[lo:])
+        want = np.zeros(SHARD_WIDTH // 32, np.uint32)
+        for p in merged[lo:].tolist():
+            want[p >> 5] |= np.uint32(1) << np.uint32(p & 31)
+        got = np.zeros_like(want)
+        got[widx] = wvals
+        np.testing.assert_array_equal(got, want)
+
+    def test_empty_word_or(self):
+        widx, wvals = ops_merge.word_or_from_sorted(
+            np.empty(0, np.uint64), np.empty(0, np.uint32)
+        )
+        assert len(widx) == 0 and len(wvals) == 0
+
+
+class TestBarrierDifferential:
+    """View-level barrier vs naive per-bit semantics and vs the
+    per-fragment host merge — bit-identical, TopN order included."""
+
+    def _drive(self, threshold, batches, clears=()):
+        """One holder driven through the staged path with the given
+        crossover threshold; clears (exact path) interleave after the
+        listed batch index. Returns (pairs, cache_tops)."""
+        merge_mod.configure(device_threshold=threshold)
+        h = Holder().open()
+        f = h.create_index("dx").create_field("f", FieldOptions())
+        clears = dict(clears)
+        for i, (rows, cols) in enumerate(batches):
+            f.import_bits(rows, cols)
+            if i in clears:
+                crows, ccols = clears[i]
+                f.import_bits(crows, ccols, clear=True)
+            if i % 2 == 1:
+                # barrier mid-stream: reads between batches must always
+                # see the union of everything staged so far
+                f.view("standard").sync_pending()
+        f.view("standard").sync_pending()
+        return _pairs_set(f), _cache_tops(f)
+
+    def test_device_host_naive_identical_with_duplicates(self):
+        rng = np.random.default_rng(11)
+        n_shards = 6
+        batches = []
+        for _ in range(4):
+            rows, cols = _burst(rng, 3000, n_shards)
+            # duplicates inside AND across batches
+            batches.append(
+                (np.concatenate([rows, rows[:500]]),
+                 np.concatenate([cols, cols[:500]]))
+            )
+        dev_pairs, dev_tops = self._drive(0, batches)  # always device
+        host_pairs, host_tops = self._drive(-1, batches)  # never device
+        assert dev_pairs == host_pairs
+        assert dev_tops == host_tops
+        # ground truth: naive per-bit exact writes
+        h = Holder().open()
+        f = h.create_index("nv").create_field("f", FieldOptions())
+        want = set()
+        for rows, cols in batches:
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                f.set_bit(int(r), int(c))
+                want.add((int(r), int(c)))
+        assert dev_pairs == want == _pairs_set(f)
+        assert dev_tops == _cache_tops(f)
+
+    def test_interleaved_set_clear_batches(self):
+        rng = np.random.default_rng(12)
+        n_shards = 4
+        b0 = _burst(rng, 2000, n_shards)
+        b1 = _burst(rng, 2000, n_shards)
+        b2 = _burst(rng, 2000, n_shards)
+        # clear half of batch 0 right after batch 1 staged
+        clears = {1: (b0[0][:1000], b0[1][:1000])}
+        dev = self._drive(0, [b0, b1, b2], clears)
+        host = self._drive(-1, [b0, b1, b2], clears)
+        assert dev == host
+        # naive ground truth, same order
+        h = Holder().open()
+        f = h.create_index("nv2").create_field("f", FieldOptions())
+        for i, (rows, cols) in enumerate([b0, b1, b2]):
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                f.set_bit(int(r), int(c))
+            if i == 1:
+                for r, c in zip(b0[0][:1000].tolist(), b0[1][:1000].tolist()):
+                    f.clear_bit(int(r), int(c))
+        assert dev[0] == _pairs_set(f)
+        assert dev[1] == _cache_tops(f)
+
+    def test_one_launch_for_120_fragments(self):
+        """THE acceptance counter: a staged burst across >= 100 fragments
+        pays ONE device program launch at the barrier, not one per
+        fragment — and the merged bits are exact."""
+        merge_mod.configure(device_threshold=0)
+        n_shards = 120
+        h = Holder().open()
+        f = h.create_index("burstx").create_field("f", FieldOptions())
+        rng = np.random.default_rng(13)
+        n = 60_000
+        rows = rng.integers(0, 8, n).astype(np.uint64)
+        # at least one position in EVERY fragment
+        cols = np.concatenate(
+            [
+                (np.arange(n_shards, dtype=np.uint64) * SHARD_WIDTH),
+                rng.integers(0, n_shards * SHARD_WIDTH, n - n_shards).astype(
+                    np.uint64
+                ),
+            ]
+        )
+        f.import_bits(rows, cols)
+        v = f.view("standard")
+        staged = [fr for fr in v.fragments.values() if fr._pending_n]
+        assert len(staged) >= 100  # the burst really spans the matrix
+        ops_merge.reset_stats()
+        merge_mod.reset_stats()
+        v.sync_pending()
+        assert ops_merge.MERGE_STATS["device_launches"] == 1
+        snap = merge_mod.stats_snapshot()
+        assert snap["barriers"] == 1 and snap["device"] == 1
+        assert snap["positions"] == n
+        # every fragment drained in that one pass
+        assert not any(fr._pending_n for fr in v.fragments.values())
+        want = set(zip(rows.tolist(), cols.tolist()))
+        assert _pairs_set(f) == want
+
+    def test_crossover_boundary_both_sides(self):
+        merge_mod.configure(device_threshold=1000)
+        h = Holder().open()
+        f = h.create_index("thr").create_field("f", FieldOptions())
+        rng = np.random.default_rng(14)
+        # burst of 999 raw positions: stays on the batched host path
+        rows, cols = _burst(rng, 999, 3)
+        f.import_bits(rows, cols)
+        ops_merge.reset_stats()
+        f.view("standard").sync_pending()
+        assert ops_merge.MERGE_STATS["device_launches"] == 0
+        assert ops_merge.MERGE_STATS["host_merges"] == 1
+        # burst of exactly 1000: dispatches the device program
+        rows, cols = _burst(rng, 1000, 3)
+        f.import_bits(rows, cols)
+        ops_merge.reset_stats()
+        f.view("standard").sync_pending()
+        assert ops_merge.MERGE_STATS["device_launches"] == 1
+        assert ops_merge.MERGE_STATS["host_merges"] == 0
+
+    def test_auto_crossover_resolves_by_backend(self):
+        """Unset threshold = AUTO: device-off on the CPU backend (the
+        XLA sort is the same silicon, ~6x slower than np.unique — the
+        dispatch can never pay), 65536 on a real accelerator. A large
+        burst under AUTO on CPU must therefore stay on the batched
+        host path, still as ONE cross-fragment pass."""
+        import jax
+
+        merge_mod.configure(device_threshold=None)
+        want = -1 if jax.default_backend() == "cpu" else 65536
+        assert merge_mod.device_threshold() == want
+        if want != -1:
+            pytest.skip("accelerator backend: device path is the point")
+        h = Holder().open()
+        f = h.create_index("autox").create_field("f", FieldOptions())
+        rng = np.random.default_rng(16)
+        # big enough to clear any accelerator threshold's intent, small
+        # enough per fragment not to trip the op-count snapshot (which
+        # merges eagerly)
+        f.import_bits(*_burst(rng, 30_000, 6))
+        ops_merge.reset_stats()
+        merge_mod.reset_stats()
+        f.view("standard").sync_pending()
+        assert ops_merge.MERGE_STATS["device_launches"] == 0
+        assert ops_merge.MERGE_STATS["host_merges"] == 1
+        snap = merge_mod.stats_snapshot()
+        assert snap["barriers"] == 1 and snap["device"] == 0
+
+    def test_concurrent_reader_races_barrier_exactly_once(self):
+        """Readers hitting the per-fragment `_sync_locked` barrier while
+        the view barrier merges the same burst: the generation handshake
+        must keep every bit exactly once and never lose a delta."""
+        merge_mod.configure(device_threshold=0)
+        h = Holder().open()
+        f = h.create_index("race").create_field("f", FieldOptions())
+        rng = np.random.default_rng(15)
+        want = set()
+        errs = []
+        for round_i in range(6):
+            rows, cols = _burst(rng, 4000, 5)
+            f.import_bits(rows, cols)
+            want |= set(zip(rows.tolist(), cols.tolist()))
+            v = f.view("standard")
+
+            def reader():
+                try:
+                    for fr in list(v.fragments.values()):
+                        fr.row_count(0)  # per-fragment read barrier
+                except Exception as e:  # noqa: BLE001 - collected
+                    errs.append(e)
+
+            t = threading.Thread(target=reader)
+            t.start()
+            v.sync_pending()
+            t.join()
+        assert not errs, errs[:1]
+        assert _pairs_set(f) == want
+
+
+class TestAdmissionSurcharge:
+    def test_staged_delta_bytes_visible_to_cost_estimate(self):
+        """A query arriving mid-burst pays the merge before its first
+        dispatch, so admission must see the staged delta's bytes
+        (8-byte position keys) on top of the operand estimate — and the
+        barrier's parked layers keep billing until a host read
+        materializes them (a cold stack build would pay that merge)."""
+        from pilosa_tpu.pql import parse
+        from pilosa_tpu.sched import cost as costmod
+
+        def materialize(field):
+            for fr in field.view("standard").fragments.values():
+                fr.sync_pending_now()
+
+        h = Holder().open()
+        f = h.create_index("adm").create_field("f", FieldOptions())
+        rng = np.random.default_rng(31)
+        f.import_bits(*_burst(rng, 100, 2))
+        materialize(f)  # start from a fully materialized state
+        idx = h.index("adm")
+        q = parse("Count(Row(f=0))")
+        c0 = costmod.estimate(idx, q, [0, 1])
+        n = 5000
+        f.import_bits(*_burst(rng, n, 2))
+        c1 = costmod.estimate(idx, q, [0, 1])
+        assert c1.device_bytes == c0.device_bytes + n * 8
+        # the barrier dedups the burst but PARKS the merged layers: the
+        # bill shrinks to the merged key count, not to zero
+        f.view("standard").sync_pending()
+        c2 = costmod.estimate(idx, q, [0, 1])
+        parked = sum(
+            fr._premerged_n
+            for fr in f.view("standard").fragments.values()
+        )
+        assert 0 < parked <= n
+        assert c2.device_bytes == c0.device_bytes + parked * 8
+        # ...and it disappears once host reads materialize the layers
+        materialize(f)
+        c3 = costmod.estimate(idx, q, [0, 1])
+        assert c3.device_bytes == c0.device_bytes
+
+
+class TestWalReplayFastPath:
+    """Satellite: opening a fragment with many staged OP_SET frames lands
+    them via one deferred merge, not one exact apply per frame."""
+
+    def _stage_and_crash(self, tmp_path, n_frames=8):
+        frag = Fragment(str(tmp_path / "w"), "i", "f", "standard", 0).open()
+        rng = np.random.default_rng(21)
+        for _ in range(n_frames):
+            # fragment positions: row * SHARD_WIDTH + col
+            pos = rng.integers(0, 8, 500).astype(np.uint64) * np.uint64(
+                SHARD_WIDTH
+            ) + rng.integers(0, SHARD_WIDTH, 500).astype(np.uint64)
+            frag.stage_positions(pos)
+        pairs = frag.pairs()  # read barrier: merges, WAL keeps the frames
+        top = frag.cache_top()
+        frag._wal.close()  # crash: no snapshot, no cache flush
+        frag._wal = None
+        return (
+            {(int(r), int(c)) for r, c in zip(*map(np.ndarray.tolist, pairs))},
+            top,
+        )
+
+    def test_replay_equivalence_and_one_merge(self, tmp_path, monkeypatch):
+        want_pairs, want_top = self._stage_and_crash(tmp_path, n_frames=8)
+        calls = []
+        real = merge_mod.note_host_sync
+        monkeypatch.setattr(
+            merge_mod,
+            "note_host_sync",
+            lambda n: (calls.append(n), real(n))[1],
+        )
+        frag2 = Fragment(str(tmp_path / "w"), "i", "f", "standard", 0).open()
+        # ONE deferred merge covering every staged frame — not 8 applies
+        assert calls == [8]
+        rows, cols = frag2.pairs()
+        got = {(int(r), int(c)) for r, c in zip(rows.tolist(), cols.tolist())}
+        assert got == want_pairs
+        assert frag2.cache_top() == want_top
+        assert frag2._pending_n == 0  # open() returns a merged fragment

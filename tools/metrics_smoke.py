@@ -84,6 +84,18 @@ def main() -> int:
                 # exact: a routing regression that silently drops bits
                 # must fail the smoke, not render a plausible page
                 assert resp["results"] == [n_cols], resp
+        # ISSUE 9: one staged import burst, then a query whose read
+        # barrier merges it — the merge-barrier gauges must move and the
+        # extent-patch counter must render (asserted below on the
+        # scraped text)
+        _post(
+            uri, "/index/smoke_a/field/f/import",
+            {"rows": [2] * 600, "cols": list(range(600))},
+        )
+        resp = _post(
+            uri, "/index/smoke_a/query", {"query": "Count(Row(f=2))"}
+        )
+        assert resp["results"] == [600], resp
         # the resize-job record must scrape as well-formed JSON on a live
         # node (operators poll it during elastic resizes; an idle node
         # reports NONE)
@@ -104,6 +116,25 @@ def main() -> int:
     # and the admission tail estimate depend on
     if "pilosa_tpu_query_ms_bucket" not in cluster_text:
         errors.append("query_ms histogram missing from /cluster/metrics")
+
+    # deferred-delta merge plane (ISSUE 9): the staged burst above was
+    # merged by the query's read barrier, so the merge gauges and the
+    # extent-patch counter must render — and merge_batches must have
+    # actually moved (a burst that silently bypassed the staged path
+    # would leave it zero)
+    for fam in (
+        "pilosa_tpu_ingest_merge_ms",
+        "pilosa_tpu_ingest_merge_batches",
+        "pilosa_tpu_ingest_merge_device",
+        "pilosa_tpu_hbm_extent_patches",
+    ):
+        if not re.search(rf"^{fam} ", node_text, re.M):
+            errors.append(f"node /metrics: {fam} missing")
+    m = re.search(
+        r"^pilosa_tpu_ingest_merge_batches ([0-9.e+-]+)", node_text, re.M
+    )
+    if m and float(m.group(1)) <= 0:
+        errors.append("ingest.merge_batches stayed zero after a staged burst")
 
     # per-index attribution: both tenants present, and their label sets
     # disjoint from each other (a merge that smeared series across
