@@ -723,6 +723,30 @@ def main():
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         mesh_scaling = [{"error": f"{type(e).__name__}: {e}"[:200]}]
 
+    # mesh-group certification (ISSUE 10): 16- and 32-virtual-device
+    # clusters, one ICI domain, Count folded into ONE compiled dispatch
+    # + ONE blocking host read (counter-asserted in the child) and
+    # bit-identical to the HTTP fan-out — the numbers the north-star
+    # arithmetic now rests on (tools/mesh_cert.py; the cert env clears
+    # XLA_FLAGS itself, one subprocess per device count)
+    mesh_group: dict = {}
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "mesh_cert.py")],
+            capture_output=True, text=True, timeout=1800, env=env, cwd=here,
+        )
+        cert = json.loads(out.stdout.strip())
+        for rnd in cert.get("rounds", []):
+            n = rnd.get("n_devices")
+            mesh_group[f"mesh{n}_count_ms"] = rnd.get("mesh_count_ms")
+            mesh_group[f"mesh{n}_http_count_ms"] = rnd.get("http_count_ms")
+            mesh_group[f"mesh{n}_dispatches"] = rnd.get("dispatches")
+            mesh_group[f"mesh{n}_host_reads"] = rnd.get("host_reads")
+        mesh_group["ok"] = cert.get("ok", False)
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        mesh_group = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # ---- CPU comparator: vectorized numpy popcount, same data ----
     if hasattr(np, "bitwise_count"):
         def cpu_count():
@@ -796,6 +820,7 @@ def main():
                         hbm_restage_mb_per_query, 2
                     ),
                     "mesh_scaling": mesh_scaling,
+                    "mesh_group": mesh_group,
                     "batch": BATCH,
                     "n_shards": n_shards,
                 },

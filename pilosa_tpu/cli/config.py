@@ -78,6 +78,21 @@ class IngestConfig:
 
 
 @dataclass
+class MeshConfig:
+    # mesh-local sharded execution (exec/meshgroup.py; docs/
+    # configuration.md "Mesh execution"): nodes declaring the same
+    # non-empty `group` share an ICI domain — their shards fold into ONE
+    # compiled sharded program with in-program collectives instead of
+    # per-node HTTP legs. HTTP/DCN remains the transport across groups.
+    group: str = ""  # ICI domain id; "" = no mesh-local execution
+    min_nodes: int = 2  # group-local owners before the fold engages; 0 disables
+    # collective-cost link classes (sched/cost.py transport terms):
+    # intra-group reductions ride ICI, cross-group legs ride HTTP/DCN
+    ici_gbps: float = 100.0
+    dcn_gbps: float = 3.0
+
+
+@dataclass
 class ResizeConfig:
     # live elastic resize (streaming resharding under traffic;
     # docs/configuration.md "Elastic resize"): moving fragments stream as
@@ -154,6 +169,7 @@ class Config:
     sched: SchedConfig = field(default_factory=SchedConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
     resize: ResizeConfig = field(default_factory=ResizeConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -231,6 +247,7 @@ class Config:
             ("sched", self.sched),
             ("hbm", self.hbm),
             ("ingest", self.ingest),
+            ("mesh", self.mesh),
             ("resize", self.resize),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),

@@ -156,6 +156,27 @@ def _build_parser() -> argparse.ArgumentParser:
         "never on the CPU backend)",
     )
     sp.add_argument(
+        "--mesh-group",
+        help="ICI domain id of this node: nodes sharing a non-empty group "
+        "execute mesh-local queries as one compiled sharded program "
+        "instead of per-node HTTP legs (empty disables)",
+    )
+    sp.add_argument(
+        "--mesh-min-nodes", type=int,
+        help="group-local owner nodes a fan-out must span before the "
+        "mesh-group fold engages (0 disables mesh-local execution)",
+    )
+    sp.add_argument(
+        "--mesh-ici-gbps", type=float,
+        help="assumed intra-group (ICI) collective bandwidth, GB/s, for "
+        "admission's collective-cost terms",
+    )
+    sp.add_argument(
+        "--mesh-dcn-gbps", type=float,
+        help="assumed cross-group (HTTP/DCN) bandwidth, GB/s, for "
+        "admission's collective-cost terms",
+    )
+    sp.add_argument(
         "--resize-transfer-concurrency", type=int,
         help="parallel fragment transfer legs per node during a "
         "streaming resize",
@@ -251,6 +272,10 @@ _FLAG_KNOBS = {
     "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
     "hbm_pin_timeout": ("hbm", "pin_timeout"),
     "merge_device_threshold": ("ingest", "merge_device_threshold"),
+    "mesh_group": ("mesh", "group"),
+    "mesh_min_nodes": ("mesh", "min_nodes"),
+    "mesh_ici_gbps": ("mesh", "ici_gbps"),
+    "mesh_dcn_gbps": ("mesh", "dcn_gbps"),
     "resize_transfer_concurrency": ("resize", "transfer_concurrency"),
     "resize_cutover_timeout": ("resize", "cutover_timeout"),
     "resize_resume_policy": ("resize", "resume_policy"),
@@ -320,7 +345,13 @@ def _join_on_boot(
         clock = time.monotonic
     if wake is None:
         wake = threading.Event()
-    payload = {"id": srv.node.id, "uri": srv.node.uri}
+    payload = {
+        "id": srv.node.id,
+        "uri": srv.node.uri,
+        # the joiner's ICI-domain declaration rides the join so the
+        # post-resize topology carries its mesh-group membership
+        "meshGroup": srv.mesh_group_name,
+    }
     deadline = clock() + timeout
     registered_at: Optional[float] = None
     while clock() < deadline:
@@ -392,6 +423,10 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,
         merge_device_threshold=cfg.ingest.merge_device_threshold,
+        mesh_group=cfg.mesh.group,
+        mesh_min_nodes=cfg.mesh.min_nodes,
+        mesh_ici_gbps=cfg.mesh.ici_gbps,
+        mesh_dcn_gbps=cfg.mesh.dcn_gbps,
         import_concurrency=cfg.import_concurrency,
         resize_transfer_concurrency=cfg.resize.transfer_concurrency,
         resize_cutover_timeout=cfg.resize.cutover_timeout,

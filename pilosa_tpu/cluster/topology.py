@@ -79,6 +79,12 @@ class Node:
     uri: str = ""
     is_coordinator: bool = False
     state: str = NODE_STATE_READY
+    # ICI-domain membership for mesh-local sharded execution: nodes that
+    # share a non-empty mesh_group execute queries as ONE compiled sharded
+    # program with in-program collectives (exec/meshgroup.py); HTTP/DCN is
+    # the transport only ACROSS groups. Configured per node via the [mesh]
+    # knob set and carried in every topology install/broadcast.
+    mesh_group: str = ""
 
     def to_json(self) -> dict:
         return {
@@ -86,6 +92,7 @@ class Node:
             "uri": self.uri,
             "isCoordinator": self.is_coordinator,
             "state": self.state,
+            "meshGroup": self.mesh_group,
         }
 
     @classmethod
@@ -95,6 +102,7 @@ class Node:
             uri=d.get("uri", ""),
             is_coordinator=d.get("isCoordinator", False),
             state=d.get("state", NODE_STATE_READY),
+            mesh_group=d.get("meshGroup", ""),
         )
 
 
@@ -237,6 +245,29 @@ class Cluster:
                 if n.state != NODE_STATE_DOWN:
                     out.setdefault(n.id, []).append(s)
         return out
+
+    # -- mesh-group membership (mesh-local sharded execution) ---------------
+
+    def mesh_group_of(self, node_id: str) -> str:
+        """The ICI-domain id `node_id` declared via its [mesh] config, or
+        "" when the node is unknown or declared no group."""
+        n = self.node_by_id(node_id)
+        return n.mesh_group if n is not None else ""
+
+    def mesh_peers(self, node_id: str) -> List[Node]:
+        """Every OTHER live node sharing `node_id`'s non-empty mesh group —
+        the set whose shards can fold into one compiled sharded program
+        instead of HTTP legs (exec/distributed.py mesh-group path)."""
+        group = self.mesh_group_of(node_id)
+        if not group:
+            return []
+        return [
+            n
+            for n in self.nodes
+            if n.id != node_id
+            and n.mesh_group == group
+            and n.state != NODE_STATE_DOWN
+        ]
 
     # -- resize math (cluster.go:784-870) ----------------------------------
 

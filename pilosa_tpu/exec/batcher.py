@@ -80,14 +80,19 @@ def batch_eligible(query, shards, opt) -> bool:
 
 
 class _Waiter:
-    __slots__ = ("query", "event", "results", "error", "promoted")
+    __slots__ = ("query", "event", "results", "error", "promoted", "cls")
 
-    def __init__(self, query: Query):
+    def __init__(self, query: Query, cls=None):
         self.query = query
         self.event = threading.Event()
         self.results = None
         self.error = None
         self.promoted = False  # woken to take over leadership
+        # lowering class (CountBatcher.classify): queries of different
+        # classes must not merge into one multi-root plan — a mesh-group
+        # Count's sharded operands and an extent-path Count's local
+        # stacks have incompatible placements
+        self.cls = cls
 
 
 class CountBatcher:
@@ -122,11 +127,27 @@ class CountBatcher:
         # stats client (NodeServer wires its own); emits one
         # `batcher.batch_size` observation per executed round
         self.stats = None
+        # lowering-class hook: classify(index, query) -> hashable key.
+        # Rounds are executed per class — a merged multi-root plan must
+        # never mix mesh-group and extent-path Counts (incompatible
+        # operand placements). None = one class for everything (the
+        # single-node default). Must never raise for a valid query; a
+        # failure degrades to the shared default class.
+        self.classify: Optional[Callable[[str, Query], object]] = None
+
+    def _class_of(self, index: str, query: Query):
+        if self.classify is None:
+            return None
+        try:
+            return self.classify(index, query)
+        except Exception:  # noqa: BLE001 - classification is advisory
+            return None
 
     def run(self, index: str, query: Query, execute: Callable[[Query], list]):
+        cls = self._class_of(index, query)
         with self._mu:
             if self._busy.get(index):
-                w = _Waiter(query)
+                w = _Waiter(query, cls)
                 self._queue.setdefault(index, deque()).append(w)
                 self._arrived.notify_all()
             else:
@@ -170,7 +191,7 @@ class CountBatcher:
             # adaptive hold: the admission controller reports `target`
             # queries in flight/queued — wait (bounded) for them to line
             # up behind us, then run the whole set as ONE merged dispatch
-            lead = _Waiter(query)
+            lead = _Waiter(query, cls)
             deadline = time.monotonic() + self.hold_timeout
             with self._mu:
                 # target counts QUERIES (the admission hint's unit), so
@@ -206,22 +227,37 @@ class CountBatcher:
         """Serve the waiters present right now (in MAX_BATCH_CALLS-sized
         merges, `first` prepended when a promoted leader brings its own
         query), then hand leadership to the first later arrival — or
-        release the slot when the queue is empty."""
+        release the slot when the queue is empty.
+
+        Merges are split BY LOWERING CLASS (self.classify): a round mixing
+        mesh-group and fan-out/extent Counts executes as one sub-batch per
+        class in arrival order — one merged multi-root plan must never mix
+        operand placements."""
         with self._mu:
             round_ = self._queue.get(index) or deque()
             self._queue[index] = deque()
         if first is not None:
             round_.appendleft(first)
-        while round_:
-            batch: List[_Waiter] = []
-            n = 0
-            while round_ and n + len(round_[0].query.calls) <= MAX_BATCH_CALLS:
-                wtr = round_.popleft()
-                batch.append(wtr)
-                n += len(wtr.query.calls)
-            if not batch:  # single oversized query: run it alone
-                batch = [round_.popleft()]
-            self._run_batch(batch, execute)
+        # partition by class, preserving arrival order within each
+        by_cls: Dict[object, Deque[_Waiter]] = {}
+        order: List[object] = []
+        for wtr in round_:
+            if wtr.cls not in by_cls:
+                by_cls[wtr.cls] = deque()
+                order.append(wtr.cls)
+            by_cls[wtr.cls].append(wtr)
+        for cls in order:
+            bucket = by_cls[cls]
+            while bucket:
+                batch: List[_Waiter] = []
+                n = 0
+                while bucket and n + len(bucket[0].query.calls) <= MAX_BATCH_CALLS:
+                    wtr = bucket.popleft()
+                    batch.append(wtr)
+                    n += len(wtr.query.calls)
+                if not batch:  # single oversized query: run it alone
+                    batch = [bucket.popleft()]
+                self._run_batch(batch, execute)
         with self._mu:
             queued = self._queue.get(index)
             if queued:

@@ -18,7 +18,15 @@ pass 2 each go through the overridden `_topn_shards` fan-out.
 Write calls route by ownership: single-column writes go to every replica
 owner of the column's shard (executor.go:2142-2172 fan-out to owners);
 row-wide writes (ClearRow/Store) run on every node over its owned shards;
-attr writes replicate to all nodes."""
+attr writes replicate to all nodes.
+
+Mesh-group execution (exec/meshgroup.py): read fan-outs first fold every
+owner node sharing this node's ICI domain (topology mesh_group + the
+process-local registry, parallel/mesh.py) into ONE compiled sharded
+program with the reduction in program — one dispatch + one blocking host
+read for the whole group instead of one HTTP leg per member. HTTP/DCN
+legs remain the transport only for nodes OUTSIDE the group; any
+mesh-ineligible shape falls back to legs transparently."""
 
 from __future__ import annotations
 
@@ -68,6 +76,7 @@ class DistributedExecutor(Executor):
         local_id: str,
         stats=None,
         query_deadline: float = DEFAULT_QUERY_DEADLINE,
+        mesh_min_nodes: int = 2,
     ):
         super().__init__(holder)
         self.cluster_fn = cluster_fn
@@ -77,6 +86,10 @@ class DistributedExecutor(Executor):
         # overall wall-clock bound on one distributed call's fan-out,
         # covering every re-map round and backoff (config: query-deadline)
         self.query_deadline = query_deadline
+        # mesh-group execution ([mesh] min-nodes knob): group-local owner
+        # nodes below this count keep their HTTP legs (folding a single
+        # node buys nothing); 0 disables the mesh path entirely
+        self.mesh_min_nodes = mesh_min_nodes
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_mu = TrackedLock("distributed.pool_mu")
 
@@ -149,6 +162,25 @@ class DistributedExecutor(Executor):
         if write:
             fspan.set_tag("fanout.write", True)
         with fspan:
+            # mesh-group fold: owner nodes sharing this node's ICI domain
+            # answer as ONE compiled sharded program (exec/meshgroup.py)
+            # instead of one HTTP leg each; ineligible shapes fall back to
+            # legs below, transparently
+            if not write and remaining:
+                mesh_nodes = self._mesh_group_nodes(remaining)
+                if mesh_nodes and self._mesh_eligible(c):
+                    from pilosa_tpu.exec import meshgroup
+
+                    try:
+                        partials.append(
+                            self._mesh_group_partial(idx, c, mesh_nodes, fspan)
+                        )
+                    except meshgroup.MeshUnsupported:
+                        meshgroup.note_fallback()
+                    else:
+                        for nid in mesh_nodes:
+                            remaining.pop(nid, None)
+                        fspan.set_tag("fanout.mesh_nodes", len(mesh_nodes))
             while remaining:
                 attempts += 1
                 if attempts > len(cluster.nodes) + 1:
@@ -251,6 +283,217 @@ class DistributedExecutor(Executor):
             if failed:
                 fspan.set_tag("fanout.failed_peers", sorted(failed))
         return partials
+
+    # ------------------------------------------------------------------
+    # mesh-group execution (exec/meshgroup.py)
+    # ------------------------------------------------------------------
+
+    def _mesh_group(self) -> str:
+        """This node's ICI-domain id per the installed topology ([mesh]
+        group knob, carried on every topology install)."""
+        return self._cluster().mesh_group_of(self.local_id)
+
+    def _mesh_members(self) -> Dict[str, Any]:
+        """node_id -> holder for every group member reachable in-process
+        (the registry, parallel/mesh.py) — the local node always is."""
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        group = self._mesh_group()
+        if not group or self.mesh_min_nodes <= 0:
+            return {}
+        members = pmesh.group_members(group)
+        members[self.local_id] = self.holder
+        return members
+
+    def _mesh_group_nodes(
+        self, remaining: Dict[str, List[int]]
+    ) -> Dict[str, List[int]]:
+        """The subset of a read fan-out's owner grouping answerable as one
+        mesh-group dispatch: nodes declaring this node's mesh group in the
+        topology AND registered in the process-local registry (sharing an
+        ICI domain means sharing this process's device mesh). Below the
+        min-nodes knob the fold buys nothing over plain legs — {}."""
+        members = self._mesh_members()
+        if not members:
+            return {}
+        cluster = self._cluster()
+        group = self._mesh_group()
+        out = {
+            nid: shards
+            for nid, shards in remaining.items()
+            if nid in members and cluster.mesh_group_of(nid) == group
+        }
+        # the knob is honored as documented: min-nodes=1 folds even a
+        # single group-local owner (saving its HTTP leg when it is a
+        # peer); the default of 2 skips the adapter overhead when only
+        # this node's own shards are in play
+        if len(out) < max(1, self.mesh_min_nodes):
+            return {}
+        return out
+
+    def _mesh_eligible(self, c: Call) -> bool:
+        from pilosa_tpu.exec import meshgroup
+
+        return meshgroup.eligible(c)
+
+    def _mesh_group_index(self, idx: Index, mesh_nodes: Dict[str, List[int]]):
+        from pilosa_tpu.exec import meshgroup
+
+        return meshgroup.group_index(idx, self._mesh_members(), mesh_nodes)
+
+    def _mesh_group_partial(
+        self, idx: Index, c: Call, mesh_nodes: Dict[str, List[int]], fspan
+    ) -> Any:
+        """One partial for the WHOLE mesh group: the unchanged single-node
+        execution over a group-spanning index adapter, so the result is
+        bit-identical to merging the members' per-leg partials (the merge
+        is associative) while the device work is one compiled program.
+        Count ends in the in-program reduction (plan "total" mode) — one
+        dispatch + one scalar-sized blocking read regardless of group
+        shard count."""
+        from pilosa_tpu.exec import meshgroup
+
+        gidx = self._mesh_group_index(idx, mesh_nodes)
+        shard_list = sorted(s for lst in mesh_nodes.values() for s in lst)
+        span = tracing.start_span("exec.mesh_dispatch", parent=fspan)
+        with span:
+            span.set_tag("mesh.group_size", len(mesh_nodes))
+            span.set_tag("mesh.local_shards", len(shard_list))
+            span.set_tag("mesh.call", c.name)
+            if c.name == "Count":
+                result, cbytes = meshgroup.mesh_count(self, gidx, c, shard_list)
+            else:
+                # TopN tallies and bitmap trees ride the unchanged local
+                # execution paths over the group adapter (remote
+                # semantics: untrimmed candidates, no attr/translate tail)
+                result = Executor._execute_call(
+                    self, gidx, c, shard_list, ExecOptions(remote=True)
+                )
+                from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+                # a row-shaped result gathers its [S, W] stack; tallies
+                # and counts read shard-count-bound vectors
+                cbytes = (
+                    len(shard_list) * WORDS_PER_ROW * 4
+                    if isinstance(result, Row)
+                    else len(shard_list) * 8
+                )
+            span.set_tag("mesh.collective_bytes", cbytes)
+            meshgroup.note_dispatch(len(mesh_nodes), len(shard_list), cbytes)
+        return result
+
+    def _execute_count_batch(
+        self, idx: Index, calls: List[Call], shards, opt: Optional[ExecOptions] = None
+    ):
+        """Coordinator-side multi-Count batching: legal only when EVERY
+        call's owners fold into one mesh-group dispatch (operands of the
+        mesh and extent paths have incompatible placements — the batcher
+        splits its rounds by lowering class for exactly this reason).
+        Remote legs and single-node execution keep the local lowering."""
+        if (opt is not None and opt.remote) or self._is_single_node():
+            return super()._execute_count_batch(idx, calls, shards, opt)
+        from pilosa_tpu.exec import meshgroup
+
+        cluster = self._cluster()
+        lists = [self._shards_for(idx, shards, c) for c in calls]
+        if any(lst != lists[0] for lst in lists[1:]):
+            return None
+        if not all(self._mesh_eligible(c) for c in calls):
+            return None
+        remaining = dict(cluster.shards_by_node(idx.name, lists[0]))
+        mesh_nodes = self._mesh_group_nodes(remaining)
+        if set(mesh_nodes) != set(remaining):
+            return None  # cross-group legs present: per-call fan-out
+        gidx = self._mesh_group_index(idx, mesh_nodes)
+        shard_list = sorted(s for lst in mesh_nodes.values() for s in lst)
+        span = tracing.start_span("exec.mesh_dispatch")
+        try:
+            with span:
+                span.set_tag("mesh.group_size", len(mesh_nodes))
+                span.set_tag("mesh.local_shards", len(shard_list))
+                span.set_tag("mesh.call", f"Count[{len(calls)}]")
+                totals, cbytes = meshgroup.mesh_count_batch(
+                    self, gidx, calls, shard_list
+                )
+                span.set_tag("mesh.collective_bytes", cbytes)
+                meshgroup.note_dispatch(len(mesh_nodes), len(shard_list), cbytes)
+                return totals
+        except meshgroup.MeshUnsupported:
+            meshgroup.note_fallback()
+            return None
+
+    def count_lowering_class(self, index_name: str, query) -> str:
+        """Which lowering a pure-Count query's batch round would ride:
+        "mesh" when every call folds into one mesh-group dispatch,
+        "fanout" when any call needs HTTP legs, "local" on a single node.
+        The CountBatcher splits its group-commit rounds by this key —
+        merging a mesh-path Count with a fan-out Count into one multi-root
+        plan would hand XLA operands with incompatible placements.
+        Classification must never fail a query: errors degrade to
+        "fanout" (per-call execution is always correct)."""
+        try:
+            if self._is_single_node():
+                return "local"
+            idx = self.holder.index(index_name)
+            if idx is None:
+                return "fanout"
+            cluster = self._cluster()
+            for c in query.calls:
+                if not self._mesh_eligible(c):
+                    return "fanout"
+                shard_list = self._shards_for(idx, None, c)
+                remaining = dict(cluster.shards_by_node(idx.name, shard_list))
+                mesh_nodes = self._mesh_group_nodes(remaining)
+                if set(mesh_nodes) != set(remaining):
+                    return "fanout"
+            return "mesh"
+        except Exception:  # noqa: BLE001 - classification is advisory
+            return "fanout"
+
+    def transport_profile(self, idx: Index, shards=None) -> Optional[Dict[str, int]]:
+        """Admission-time transport split for sched/cost.py's collective
+        terms: how many of the query's shards fold into the mesh-group
+        collective vs ride cross-group HTTP legs. `device_shards` is the
+        shard axis THIS node's device actually materializes — the whole
+        group's shards when the fold engages (the one compiled program
+        stages every member's operands here, while the members admit no
+        leg) plus the local-only share — which the api layer feeds to the
+        cost estimator so a mesh dispatch is byte-charged in full, not at
+        the coordinator's 1/N share. Metadata walk only; failures degrade
+        to None (the caller keeps its local-share heuristic)."""
+        try:
+            if self._is_single_node():
+                return {
+                    "mesh_shards": 0, "legs": 0, "leg_shards": 0,
+                    "device_shards": 0,
+                }
+            all_shards = self._shards_for(idx, shards, None)
+            remaining = dict(
+                self._cluster().shards_by_node(idx.name, all_shards)
+            )
+            mesh_nodes = self._mesh_group_nodes(remaining)
+            mesh_shards = sum(len(v) for v in mesh_nodes.values())
+            # the local node's own share crosses no link: it is neither a
+            # DCN leg nor (unless folded with peers) a collective
+            legs = [
+                n
+                for n in remaining
+                if n not in mesh_nodes and n != self.local_id
+            ]
+            leg_shards = sum(len(remaining[n]) for n in legs)
+            local_only = (
+                0
+                if self.local_id in mesh_nodes
+                else len(remaining.get(self.local_id, []))
+            )
+            return {
+                "mesh_shards": mesh_shards,
+                "legs": len(legs),
+                "leg_shards": leg_shards,
+                "device_shards": mesh_shards + local_only,
+            }
+        except Exception:  # noqa: BLE001 - estimation must never fail
+            return None
 
     def _node_partial(
         self,
@@ -382,8 +625,11 @@ class DistributedExecutor(Executor):
     def _counts_batchable(self, opt: ExecOptions) -> bool:
         # batching evaluates locally over the given shard list, which is
         # only this node's responsibility under remote/single-node
-        # execution; coordinator-side calls must fan out per call
-        return opt.remote or self._is_single_node()
+        # execution. Coordinator-side batches are legal exactly when the
+        # mesh-group path can fold EVERY call into one sharded dispatch —
+        # _execute_count_batch checks per batch and returns None (per-call
+        # fan-out) otherwise.
+        return opt.remote or self._is_single_node() or self.mesh_min_nodes > 0
 
     def _execute_call(self, idx: Index, c: Call, shards, opt: ExecOptions):
         if opt.remote or self._is_single_node():

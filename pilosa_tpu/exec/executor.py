@@ -610,7 +610,7 @@ class Executor:
             ):
                 j += 1
             if j - i >= 2 and self._counts_batchable(opt):
-                batch = self._execute_count_batch(idx, calls[i:j], shards)
+                batch = self._execute_count_batch(idx, calls[i:j], shards, opt)
                 if batch is not None:
                     results.extend(batch)
                 else:
@@ -1269,11 +1269,14 @@ class Executor:
         return True
 
     def _execute_count_batch(
-        self, idx: Index, calls: List[Call], shards
+        self, idx: Index, calls: List[Call], shards, opt: Optional[ExecOptions] = None
     ) -> Optional[List[int]]:
         """N adjacent Count calls as ONE multi-root dispatch + one [N, S]
         host read. Returns None (caller falls back to per-call execution)
-        when any child has no stacked form."""
+        when any child has no stacked form. `opt` lets the distributed
+        override distinguish remote legs (local lowering) from
+        coordinator-side batches (mesh-group lowering or per-call
+        fan-out); the local path ignores it."""
         children = []
         for c in calls:
             if len(c.children) != 1:
@@ -1336,7 +1339,10 @@ class Executor:
     def _fused_count_read(words_list) -> int:
         import jax.numpy as jnp
 
+        from pilosa_tpu.exec import plan as planmod
+
         FALLBACK_STATS["count_reads"] += 1
+        planmod.STATS["host_reads"] += 1
         counts = ob.popcount_rows(jnp.stack(words_list))
         return int(np.asarray(counts, dtype=np.uint64).sum())
 
@@ -1441,13 +1447,19 @@ class Executor:
             # shard chunk — usually exactly one; exact host combine
             from pilosa_tpu.ops import bsi as obsi
 
+            from pilosa_tpu.exec import plan as planmod
+
             depth = f.options.bit_depth
             count = 0
             total = 0
             for exists, sign, planes, filt in chunks:
                 fused = np.asarray(
-                    obsi.sum_counts_stacked(
-                        planes, exists, sign, exists if filt is None else filt, depth
+                    planmod.run_serialized(
+                        lambda planes=planes, exists=exists, sign=sign,
+                        filt=filt: obsi.sum_counts_stacked(
+                            planes, exists, sign,
+                            exists if filt is None else filt, depth
+                        )
                     ),
                     dtype=np.uint64,
                 )  # ONE device read: [1 + 2*depth, S]
@@ -1483,16 +1495,21 @@ class Executor:
         if chunks is not None:
             from pilosa_tpu.ops import bsi as obsi
 
+            from pilosa_tpu.exec import plan as planmod
+
             best: Optional[Tuple[int, int]] = None  # (value, count)
             for exists, sign, planes, filt in chunks:
                 fused = np.asarray(
-                    obsi.min_max_signed(
-                        planes,
-                        exists,
-                        sign,
-                        exists if filt is None else filt,
-                        f.options.bit_depth,
-                        is_min,
+                    planmod.run_serialized(
+                        lambda planes=planes, exists=exists, sign=sign,
+                        filt=filt: obsi.min_max_signed(
+                            planes,
+                            exists,
+                            sign,
+                            exists if filt is None else filt,
+                            f.options.bit_depth,
+                            is_min,
+                        )
                     ),
                     dtype=np.uint64,
                 )  # ONE device read: [magnitude, negative, any, counts...]
@@ -1607,7 +1624,13 @@ class Executor:
         if not present:
             return {"id": 0, "count": 0}
         src_stack = sp.rows_full()
-        if not bool(np.asarray(ob.popcount(src_stack))):
+        from pilosa_tpu.exec import plan as planmod
+
+        if not bool(
+            np.asarray(
+                planmod.run_serialized(lambda: ob.popcount(src_stack))
+            )
+        ):
             # filter matched nothing anywhere: no candidate can score
             return {"id": 0, "count": 0}
         cand: set = set()
@@ -2070,9 +2093,12 @@ class Executor:
         src_stack = sp.rows_full()  # one plan dispatch, stays on device
         src_counts = None
         if spec.tanimoto > 0:
+            from pilosa_tpu.exec import plan as planmod
+
             TOPN_STATS["tally_evals"] += 1
             src_counts = np.asarray(
-                ob.popcount_rows(src_stack), dtype=np.uint64
+                planmod.run_serialized(lambda: ob.popcount_rows(src_stack)),
+                dtype=np.uint64,
             )[: len(present)]
         # Per-shard pools + host-side survivor prunes.
         pools = []
@@ -2243,37 +2269,56 @@ class Executor:
             bundle.sparse_rows,
             bundle.dev,
         )
-        parts = []  # device uint32 [*, n_present] blocks
+        from pilosa_tpu.exec import plan as planmod
+
+        parts = []  # device uint32 [*, n_present] blocks (materialized)
         order: List[int] = []  # row ids aligned with the fused row axis
         if dense_rows:
             r_c = gb._gmax(s_pad, w)
             for i in range(0, len(dense_rows), r_c):
                 ids = dense_rows[i : i + r_c]
                 pad_ids = [int(x) for x in gb._pad_pow2(np.asarray(ids))]
+                # staging OUTSIDE the dispatch mutex (transfers overlap
+                # the in-flight program; they don't rendezvous)
                 planes = view.plane_stack(pad_ids, pshards)
                 src = src_stack
                 if planes.shape[1] != s_pad:
                     # stacked src may carry extra Shift-predecessor shards
                     src = src_stack[: planes.shape[1]]
                 TOPN_STATS["tally_evals"] += 1
-                counts = gb._counts_cross(src[None], planes)[0]
-                parts.append(counts[: len(ids), :n_present])
+                # tally programs consume mesh-sharded stacks: serialized
+                # like every other compiled dispatch (plan.run_serialized)
+                parts.append(
+                    planmod.run_serialized(
+                        lambda src=src, planes=planes, n=len(ids):
+                        gb._counts_cross(src[None], planes)[0][:n, :n_present]
+                    )
+                )
                 order.extend(ids)
         if sparse_rows:
             if dev is None:
-                parts.append(jnp.zeros((len(sparse_rows), n_present), jnp.uint32))
+                parts.append(
+                    jnp.zeros((len(sparse_rows), n_present), jnp.uint32)
+                )
             else:
                 idx, mask, starts, ends, r_pad, s_pow2 = dev
                 TOPN_STATS["tally_evals"] += 1
-                counts = ob.gather_tally_sorted(
-                    src_stack, idx, mask, starts, ends
-                ).reshape(r_pad, s_pow2)
-                parts.append(counts[: len(sparse_rows), :n_present])
+                parts.append(
+                    planmod.run_serialized(
+                        lambda: ob.gather_tally_sorted(
+                            src_stack, idx, mask, starts, ends
+                        ).reshape(r_pad, s_pow2)[: len(sparse_rows), :n_present]
+                    )
+                )
             order.extend(sparse_rows)
         if not order:
             return [], np.empty((0, n_present), np.uint64), bundle
         fused = np.asarray(
-            parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0),
+            parts[0]
+            if len(parts) == 1
+            else planmod.run_serialized(
+                lambda: jnp.concatenate(parts, axis=0)
+            ),
             dtype=np.uint64,
         )
         return order, fused, bundle
@@ -2632,8 +2677,15 @@ class Executor:
         finally:
             low.extents.release()  # staging-window pins (see _stacked_bsi)
         from pilosa_tpu.exec import groupby as qgb
+        from pilosa_tpu.exec import plan as planmod
 
-        return qgb.group_by_device(planes_list, child_rows, filt)
+        # the whole cross-tally pipeline (multiple dispatches + reads over
+        # mesh-sharded plane stacks) runs as one serialized occupancy of
+        # the device — concurrent GroupBy legs from other in-process nodes
+        # must not interleave collective-bearing programs (plan.run_serialized
+        # rationale); operands above were staged before entry
+        with planmod.dispatch_mutex():
+            return qgb.group_by_device(planes_list, child_rows, filt)
 
     def _group_by_shard(
         self, idx, child_fields, child_rows, filter_words, shard, merged

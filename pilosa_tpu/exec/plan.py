@@ -37,9 +37,12 @@ from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.ops import bsi as obsi
 from pilosa_tpu.ops.bitmap import shift_bits
 
-# Dispatch accounting: evals counts jitted plan executions (the "one device
-# dispatch per query" contract is asserted against this in tests).
-STATS = {"evals": 0}
+# Dispatch accounting: evals counts jitted plan executions; host_reads
+# counts blocking device->host result reads (the "one dispatch + one
+# blocking host read" contracts are asserted against these in tests — the
+# mesh-group path's acceptance depends on both staying at exactly 1 per
+# query regardless of group shard count).
+STATS = {"evals": 0, "host_reads": 0}
 
 # One in-flight compiled mesh dispatch at a time. Concurrent entry into a
 # multi-device program from several HTTP handler threads can DEADLOCK the
@@ -54,6 +57,14 @@ _DISPATCH_MU = TrackedLock("plan.dispatch_mu")
 
 def reset_stats() -> None:
     STATS["evals"] = 0
+    STATS["host_reads"] = 0
+
+
+def _note_host_read() -> None:
+    """Book one blocking device->host result read. Counted at the read
+    site, not the dispatch site: a dispatch whose eval raised never
+    reached its read."""
+    STATS["host_reads"] += 1
 
 
 def dispatch_mutex() -> TrackedLock:
@@ -63,6 +74,26 @@ def dispatch_mutex() -> TrackedLock:
     device at a time; single-device callers release it BEFORE their
     blocking host read (no collective rendezvous to deadlock)."""
     return _DISPATCH_MU
+
+
+def run_serialized(fn):
+    """Run one non-plan compiled dispatch under the one-program-at-a-time
+    mutex, holding it through completion, and return fn()'s result fully
+    materialized. The executor's tally/aggregate dispatches (TopN
+    intersection counts, BSI fused aggregates, the GroupBy cross-tally)
+    consume mesh-sharded operand stacks, so their compiled programs carry
+    collectives exactly like plan dispatches — concurrent entry from
+    fan-out legs of several in-process nodes can park the XLA-CPU
+    collective rendezvous when virtual devices outnumber cores (the PR-1
+    deadlock, observed again on the 16-virtual-device mesh-group
+    certification). Dispatch AND the blocking wait stay under the lock:
+    releasing before completion would let a second program interleave
+    into the same rendezvous. Callers stage operands BEFORE entering
+    (staging is transfers, which don't rendezvous — it may overlap)."""
+    import jax
+
+    with _DISPATCH_MU:
+        return jax.block_until_ready(fn())
 
 
 class Unsupported(Exception):
@@ -231,6 +262,32 @@ def _eval_node(node: PNode, operands, scalars, shape, memo) -> jax.Array:
     return val
 
 
+# Shard-axis bound for the exact (lo, hi) uint32 split of "total" mode:
+# per-shard counts are < 2^20 (one row within a shard), so the low-halfword
+# sum stays under 2^32 while the shard axis is at most this wide. Wider
+# stacks fall back to the [S] per-shard read.
+_TOTAL_MAX_SHARDS = 65536
+
+
+def _root_out(res, out_mode: str):
+    """Finish one evaluated root for the requested output mode. "count"
+    keeps the per-shard [S] vector (the executor sums host-side); "total"
+    folds the shard axis IN PROGRAM — under a mesh NamedSharding the SPMD
+    partitioner emits this reduction as the cross-device collective
+    (psum), which is what lets a mesh-group dispatch return a scalar-sized
+    result instead of a gathered [S] vector. The grand total is returned
+    as an exact (lo, hi) uint32 halfword pair: uint64 accumulation needs
+    x64 mode, and callers bound the shard axis by _TOTAL_MAX_SHARDS."""
+    if out_mode == "row":
+        return res
+    counts = jnp.sum(jax.lax.population_count(res), axis=-1, dtype=jnp.uint32)
+    if out_mode == "count":
+        return counts
+    lo = jnp.sum(jnp.bitwise_and(counts, jnp.uint32(0xFFFF)), dtype=jnp.uint32)
+    hi = jnp.sum(jnp.right_shift(counts, 16), dtype=jnp.uint32)
+    return jnp.stack([lo, hi])
+
+
 @partial(jax.jit, static_argnums=(0, 1))
 def _eval_multi_jit(roots: Tuple[PNode, ...], out_mode: str, operands: Tuple, scalars: Tuple):
     """Evaluate several plan roots in ONE compiled program: the shared memo
@@ -252,12 +309,7 @@ def _eval_multi_jit(roots: Tuple[PNode, ...], out_mode: str, operands: Tuple, sc
     outs = []
     for r in roots:
         res = _eval_node(r, operands, scalars, shape, memo)
-        if out_mode == "count":
-            outs.append(
-                jnp.sum(jax.lax.population_count(res), axis=-1, dtype=jnp.uint32)
-            )
-        else:
-            outs.append(res)
+        outs.append(_root_out(res, out_mode))
     return jnp.stack(outs)
 
 
@@ -275,9 +327,7 @@ def _eval_jit(plan: PNode, out_mode: str, operands: Tuple, scalars: Tuple):
                 shape = op.shape[1:]
                 break
     res = _eval_node(plan, operands, scalars, shape, {})
-    if out_mode == "count":
-        return jnp.sum(jax.lax.population_count(res), axis=-1, dtype=jnp.uint32)
-    return res
+    return _root_out(res, out_mode)
 
 
 def _flush_stage_span() -> None:
@@ -401,11 +451,40 @@ class StackedPlan:
                     self.root, "count", tuple(self.operands), self._scalar_args()
                 )
                 probe.evaled()
+                _note_host_read()
                 host = np.asarray(counts[: self.n_shards], dtype=np.uint64)
             finally:
                 probe.finish()
                 self.release_extents()
         return int(host.sum())
+
+    def total(self) -> int:
+        """Grand-total count with the shard reduction folded IN PROGRAM:
+        the compiled program ends in the collective (psum under a mesh
+        NamedSharding), so the blocking host read is a single (lo, hi)
+        halfword pair — one dispatch + one scalar-sized read regardless
+        of the stack's shard count. This is the mesh-group dispatch shape
+        (exec/meshgroup.py); stacks too wide for the exact halfword split
+        fall back to the [S] read."""
+        from pilosa_tpu.parallel.mesh import padded_shards
+
+        if padded_shards(self.n_shards) > _TOTAL_MAX_SHARDS:
+            return self.count()
+        t_lock = _pre_dispatch()
+        with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
+            probe.tag("dispatch.mode", "total")
+            try:
+                out = _eval_jit(
+                    self.root, "total", tuple(self.operands), self._scalar_args()
+                )
+                probe.evaled()
+                _note_host_read()
+                host = np.asarray(out, dtype=np.uint64)
+            finally:
+                probe.finish()
+                self.release_extents()
+        return int(host[0]) + (int(host[1]) << 16)
 
     def shard_counts(self) -> np.ndarray:
         t_lock = _pre_dispatch()
@@ -416,6 +495,7 @@ class StackedPlan:
                     self.root, "count", tuple(self.operands), self._scalar_args()
                 )
                 probe.evaled()
+                _note_host_read()
                 return np.asarray(counts)[: self.n_shards]
             finally:
                 probe.finish()
@@ -431,6 +511,7 @@ class StackedPlan:
                     self.root, "row", tuple(self.operands), self._scalar_args()
                 )
                 probe.evaled()
+                _note_host_read()
                 return out[: self.n_shards].block_until_ready()
             finally:
                 probe.finish()
@@ -447,6 +528,7 @@ class StackedPlan:
                     self.root, "row", tuple(self.operands), self._scalar_args()
                 )
                 probe.evaled()
+                _note_host_read()
                 return out.block_until_ready()
             finally:
                 probe.finish()
@@ -489,8 +571,38 @@ class MultiCountPlan:
                     tuple(jnp.uint32(s) for s in self.scalars),
                 )
                 probe.evaled()
+                _note_host_read()
                 h = np.asarray(out, dtype=np.uint64)[:, : self.n_shards]
             finally:
                 probe.finish()
                 self.release_extents()
         return [int(x) for x in h.sum(axis=1)]
+
+    def totals(self) -> List[int]:
+        """All roots' grand totals with the shard reduction in program
+        (see StackedPlan.total): ONE dispatch + one [N, 2] halfword-pair
+        read however many roots and shards the batch spans — the
+        mesh-group shape of the multi-Count batch."""
+        from pilosa_tpu.parallel.mesh import padded_shards
+
+        if padded_shards(self.n_shards) > _TOTAL_MAX_SHARDS:
+            return self.counts()
+        t_lock = _pre_dispatch()
+        with _DISPATCH_MU:
+            probe = _DispatchProbe(t_lock)
+            probe.tag("dispatch.roots", len(self.roots))
+            probe.tag("dispatch.mode", "total")
+            try:
+                out = _eval_multi_jit(
+                    tuple(self.roots),
+                    "total",
+                    tuple(self.operands),
+                    tuple(jnp.uint32(s) for s in self.scalars),
+                )
+                probe.evaled()
+                _note_host_read()
+                h = np.asarray(out, dtype=np.uint64)
+            finally:
+                probe.finish()
+                self.release_extents()
+        return [int(lo) + (int(hi) << 16) for lo, hi in h]

@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.ops import bitmap as ob
+from pilosa_tpu.utils.locks import TrackedLock
 
 # jax.shard_map graduated from jax.experimental in newer releases; support
 # both so the mesh step runs on the 0.4.x line this image ships.
@@ -118,6 +119,80 @@ def activate_default_mesh() -> Optional[Mesh]:
         if _ACTIVE_MESH is None or set(_ACTIVE_MESH.devices.flat) != set(devices):
             set_active_mesh(make_mesh(devices))
     return _ACTIVE_MESH
+
+
+# ---------------------------------------------------------------------------
+# Mesh-group runtime: which cluster nodes share THIS process's ICI domain.
+#
+# A mesh group (cluster/topology.py Node.mesh_group, the [mesh] config knob)
+# is the set of nodes whose chips sit in one ICI domain: their shards can be
+# answered by ONE compiled sharded program with in-program collectives
+# instead of per-node HTTP legs. Sharing an ICI domain means sharing the
+# process's device mesh, so reachability is a process-local registry: each
+# NodeServer registers its (group, node id, holder) on boot, and the
+# distributed executor folds exactly the registered peers of its own group
+# into the mesh dispatch (exec/meshgroup.py builds the group-spanning
+# operand stacks from the registered holders). Unregistered peers — other
+# processes, other ICI domains — keep riding HTTP/DCN.
+# ---------------------------------------------------------------------------
+
+_GROUP_MU = TrackedLock("mesh.group_mu")
+_GROUP_MEMBERS: dict = {}  # group -> node_id -> holder
+_GROUP_GEN = 0  # bumps on every (un)register; group-index caches key on it
+
+
+def _group_mu() -> TrackedLock:
+    return _GROUP_MU
+
+
+def register_group_member(group: str, node_id: str, holder) -> None:
+    """Announce that `node_id`'s shards are reachable in-process through
+    `holder` for mesh-group execution (NodeServer.start)."""
+    global _GROUP_GEN
+    if not group:
+        return
+    with _group_mu():
+        _GROUP_MEMBERS.setdefault(group, {})[node_id] = holder
+        _GROUP_GEN += 1
+
+
+def unregister_group_member(group: str, node_id: str) -> None:
+    global _GROUP_GEN
+    if not group:
+        return
+    with _group_mu():
+        members = _GROUP_MEMBERS.get(group)
+        if members is not None and members.pop(node_id, None) is not None:
+            _GROUP_GEN += 1
+            if not members:
+                del _GROUP_MEMBERS[group]
+
+
+def group_members(group: str) -> dict:
+    """node_id -> holder for every registered member of `group` (copy)."""
+    if not group:
+        return {}
+    with _group_mu():
+        return dict(_GROUP_MEMBERS.get(group, {}))
+
+
+def registered_group_of(node_id: str) -> str:
+    """The group `node_id` registered under in THIS process, or "" — used
+    to enrich topology installs that predate a member's group config
+    (server/node.py set_topology)."""
+    with _group_mu():
+        for group, members in _GROUP_MEMBERS.items():
+            if node_id in members:
+                return group
+    return ""
+
+
+def group_generation() -> int:
+    """Bumps whenever group membership changes; mesh-group operand caches
+    (exec/meshgroup.py) key on it so a restarted member's stale holder is
+    never read through a cached adapter."""
+    with _group_mu():
+        return _GROUP_GEN
 
 
 def stack_sharding(ndim: int) -> Optional[NamedSharding]:

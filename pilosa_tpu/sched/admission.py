@@ -272,6 +272,12 @@ class AdmissionController:
         cls = self.normalize_class(cls)
         t0 = self._clock()
         deadline_at = t0 + deadline if deadline is not None else None
+        if deadline_at is not None and cost.transport_ms > 0.0:
+            # collective-cost accounting (sched/cost.py): a granted query
+            # still pays its mesh-collective / cross-group-leg transport
+            # before results land, so it must START that much before its
+            # deadline — feasibility and in-queue expiry both honor it
+            deadline_at -= cost.transport_ms / 1000.0
         if leg:
             return self._admit_leg(
                 cls, cost, deadline, deadline_at, t0, index
@@ -279,7 +285,13 @@ class AdmissionController:
         shed_why: Optional[str] = None
         waited = 0.0
         with self._cv:
-            if deadline is not None and deadline <= 0:
+            if deadline is not None and (
+                deadline <= 0
+                or (deadline_at is not None and deadline_at <= t0)
+            ):
+                # exhausted outright, or the transport bill alone
+                # (collective + cross-group legs, sched/cost.py) already
+                # exceeds it — no grant could land results in time
                 shed_why = "deadline already exhausted on arrival"
             elif (
                 not self._queued_total_locked()
@@ -373,7 +385,10 @@ class AdmissionController:
         shed_why: Optional[str] = None
         waited = 0.0
         with self._cv:
-            if deadline is not None and deadline <= 0:
+            if deadline is not None and (
+                deadline <= 0
+                or (deadline_at is not None and deadline_at <= t0)
+            ):
                 shed_why = "deadline already exhausted on arrival"
             elif (
                 self._inflight_leg < self.max_concurrent

@@ -49,6 +49,59 @@ _WRITE_CALLS = frozenset(
     {"Set", "Clear", "Store", "ClearRow", "SetRowAttrs", "SetColumnAttrs"}
 )
 
+# ---------------------------------------------------------------------------
+# Collective-cost link classes (mesh-group execution). A mesh dispatch's
+# in-program reduction rides ICI; a cross-group HTTP leg ships its partial
+# result over DCN and pays a per-leg round trip. Admission prices both so
+# a mesh dispatch is weighed honestly against the legs it replaced:
+# transport_ms shrinks a query's effective deadline in the feasibility
+# check (sched/admission.py). Process-global knobs ([mesh] ici-gbps /
+# dcn-gbps) — in-process nodes share one device mesh.
+# ---------------------------------------------------------------------------
+
+_ICI_GBPS = 100.0  # intra-group collective link
+_DCN_GBPS = 3.0  # cross-group HTTP/DCN link
+_DCN_LEG_MS = 0.5  # fixed per-leg round-trip floor (serialization + HTTP)
+
+
+def configure_links(
+    ici_gbps: Optional[float] = None, dcn_gbps: Optional[float] = None
+) -> None:
+    """Install the server's [mesh] link-class knobs (cli/config.py ->
+    server/node.py). Values <= 0 keep the current setting."""
+    global _ICI_GBPS, _DCN_GBPS
+    if ici_gbps is not None and ici_gbps > 0:
+        _ICI_GBPS = float(ici_gbps)
+    if dcn_gbps is not None and dcn_gbps > 0:
+        _DCN_GBPS = float(dcn_gbps)
+
+
+def link_gbps(link: str) -> float:
+    return _ICI_GBPS if link == "ici" else _DCN_GBPS
+
+
+def collective_ms(nbytes: int, link: str = "ici") -> float:
+    """Milliseconds to move `nbytes` over one link class (bytes x
+    link-class term — the per-collective accounting unit)."""
+    if nbytes <= 0:
+        return 0.0
+    return nbytes / (link_gbps(link) * 1e9) * 1e3
+
+
+def transport_ms(
+    mesh_collective_bytes: int, leg_bytes: int, legs: int
+) -> float:
+    """One query's estimated transport bill: the mesh dispatch's ICI
+    collective plus every cross-group leg's DCN result shipping and
+    round-trip floor. Legs run concurrently (the fan-out pool), so the
+    per-leg floor is paid once, not per leg; the byte terms sum because
+    they funnel into one coordinator NIC."""
+    ms = collective_ms(mesh_collective_bytes, "ici")
+    ms += collective_ms(leg_bytes, "dcn")
+    if legs > 0:
+        ms += _DCN_LEG_MS
+    return ms
+
 
 @dataclass(frozen=True)
 class QueryCost:
@@ -57,12 +110,16 @@ class QueryCost:
     device_bytes — estimated PEAK per-dispatch operand residency (bytes);
     sweeps — estimated jitted dispatches (chunking inflates this, never
     the peak); write — mutates data (writes skip stacked lowering, so
-    they carry no device weight, but they still hold a concurrency slot).
+    they carry no device weight, but they still hold a concurrency slot);
+    transport_ms — estimated collective + cross-group transport latency
+    (mesh ICI reduction and DCN legs priced by link class), which the
+    admission feasibility check subtracts from the query's deadline.
     """
 
     device_bytes: int = 0
     sweeps: int = 0
     write: bool = False
+    transport_ms: float = 0.0
 
 
 ZERO_COST = QueryCost()
@@ -185,18 +242,57 @@ def _shard_count(idx, shards: Optional[Sequence[int]]) -> int:
     return 1
 
 
+_ROW_RESULT_CALLS = frozenset(
+    {"Row", "Union", "Intersect", "Difference", "Xor", "Not", "Shift",
+     "Range", "All"}
+)
+
+
+def _transport_estimate(calls, transport: dict) -> float:
+    """Price a query's transport from the executor's fan-out split
+    (exec/distributed.py transport_profile): mesh-local shards fold into
+    an ICI collective, cross-group legs ship partials over DCN. A
+    row-returning root gathers its [S, W] result stack; everything else
+    (counts, tallies, aggregates) reads shard-count-bound vectors."""
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+    mesh_shards = int(transport.get("mesh_shards", 0))
+    legs = int(transport.get("legs", 0))
+    leg_shards = int(transport.get("leg_shards", 0))
+    if mesh_shards <= 0 and legs <= 0:
+        return 0.0
+    total = 0.0
+    read_calls = 0
+    for c in calls:
+        if c.name in _WRITE_CALLS:
+            continue
+        read_calls += 1
+        per_shard = WORDS_PER_ROW * 4 if c.name in _ROW_RESULT_CALLS else 8
+        # byte terms per call (each call's results ship); the fixed
+        # round-trip floor is added ONCE below — legs run concurrently
+        # and adjacent calls share dispatches, so charging it per call
+        # would shed batched queries whose wall time pays it once
+        total += transport_ms(mesh_shards * per_shard, leg_shards * per_shard, 0)
+    if legs > 0 and read_calls > 0:
+        total += transport_ms(0, 0, legs)  # the round-trip floor, once
+    return total
+
+
 def estimate(
     idx,
     query,
     shards: Optional[Sequence[int]] = None,
     shard_count: Optional[int] = None,
+    transport: Optional[dict] = None,
 ) -> QueryCost:
     """Estimate `query` (a parsed Query/Call, or raw PQL text) against
     index object `idx` (may be None — e.g. not created yet).
     `shard_count` overrides the shard-axis size — the api layer passes
     this node's expected LOCAL share in a multi-node cluster, since a
     coordinator's own device only materializes the shards it owns (the
-    rest are charged by the peers admitting the fan-out legs)."""
+    rest are charged by the peers admitting the fan-out legs).
+    `transport` (exec/distributed.py transport_profile) adds the
+    mesh-collective / cross-group-leg latency terms."""
     from pilosa_tpu.core.devcache import DEVICE_CACHE
     from pilosa_tpu.shardwidth import WORDS_PER_ROW
 
@@ -240,6 +336,9 @@ def estimate(
                 # merge the fields' pending ingest delta (device keys at
                 # 8 bytes/position) before it can dispatch
                 peak += staged_merge_bytes(idx, touched)
-        return QueryCost(device_bytes=peak, sweeps=sweeps, write=write)
+        t_ms = _transport_estimate(calls, transport) if transport else 0.0
+        return QueryCost(
+            device_bytes=peak, sweeps=sweeps, write=write, transport_ms=t_ms
+        )
     except Exception:  # noqa: BLE001 - never fail admission on estimation
         return ZERO_COST

@@ -359,8 +359,27 @@ class API:
 
                 share = min(1.0, self.cluster.replica_n / nodes)
                 shard_count = max(1, _math.ceil(total * share))
+        # transport terms (collective-cost accounting): how much of this
+        # query folds into the mesh-group collective vs rides cross-group
+        # legs — remote legs are somebody else's fan-out and price nothing
+        transport = None
+        if not remote and idx is not None and len(self.cluster.nodes) > 1:
+            profile_fn = getattr(
+                self.server.executor, "transport_profile", None
+            )
+            if profile_fn is not None:
+                transport = profile_fn(idx, shards)
+            # a mesh-group dispatch stages the WHOLE group's operands on
+            # this node's device while the members admit no leg: charge
+            # the full device shard axis, not the coordinator's 1/N
+            # heuristic share (admission's byte budget must see the real
+            # residency the fold creates)
+            if transport and transport.get("device_shards", 0) > 0:
+                shard_count = max(
+                    shard_count or 1, transport["device_shards"]
+                )
         qcost = costmod.estimate(
-            idx, query, shards, shard_count=shard_count
+            idx, query, shards, shard_count=shard_count, transport=transport
         )
         from pilosa_tpu.exec import batcher as batchmod
 
@@ -491,6 +510,13 @@ class API:
             idx.delete_field(name)
         except KeyError:
             pass
+        # mesh-group adapters cache this index's Field/View objects; a
+        # delete (+ possible recreate) must not leave the mesh path
+        # reading the dead objects — drop the whole index's adapters
+        # (coarse but exact; they rebuild lazily on the next fold)
+        from pilosa_tpu.exec import meshgroup
+
+        meshgroup.drop_index(index)
         if broadcast:
             self._broadcast({"type": "delete-field", "index": index, "field": name})
 
@@ -934,7 +960,10 @@ class API:
         if not any(n.id == node_id for n in cur):
             raise NotFoundError(f"node not in cluster: {node_id}")
         remaining = [
-            Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+            Node(
+                id=n.id, uri=n.uri, is_coordinator=n.is_coordinator,
+                mesh_group=n.mesh_group,
+            )
             for n in cur
             if n.id != node_id
         ]
@@ -1041,6 +1070,7 @@ class API:
             Node(
                 id=n.id, uri=n.uri,
                 is_coordinator=(n.id == node_id), state=n.state,
+                mesh_group=n.mesh_group,
             )
             for n in cur
         ]
@@ -1048,6 +1078,7 @@ class API:
             Node(
                 id=n.id, uri=n.uri,
                 is_coordinator=n.is_coordinator, state=n.state,
+                mesh_group=n.mesh_group,
             )
             for n in cur
         ]

@@ -94,6 +94,10 @@ class NodeServer:
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
         merge_device_threshold: Optional[int] = None,  # None = backend AUTO
+        mesh_group: str = "",  # ICI domain id; "" = no mesh-local execution
+        mesh_min_nodes: int = 2,  # group-local owners before the fold engages; 0 off
+        mesh_ici_gbps: float = 100.0,  # intra-group collective link (cost model)
+        mesh_dcn_gbps: float = 3.0,  # cross-group HTTP/DCN link (cost model)
         import_concurrency: int = 8,  # parallel replica-import RPCs per call
         resize_transfer_concurrency: int = 4,  # parallel fragment fetches
         resize_cutover_timeout: float = 30.0,  # catch-up barrier bound, s
@@ -112,7 +116,10 @@ class NodeServer:
         node_id = self._load_or_create_id(node_id)
         # a fresh node is its own coordinator until a topology install says
         # otherwise (set_topology syncs identity from the membership list)
-        self.node = Node(id=node_id, uri="", is_coordinator=True)
+        self.mesh_group_name = mesh_group
+        self.node = Node(
+            id=node_id, uri="", is_coordinator=True, mesh_group=mesh_group
+        )
         self.bind = bind
         self.cluster = Cluster(
             nodes=[self.node], replica_n=replica_n, hasher=hasher or JumpHasher()
@@ -159,12 +166,22 @@ class NodeServer:
             node_id,
             stats=self.stats,
             query_deadline=query_deadline,
+            mesh_min_nodes=mesh_min_nodes,
         )
+        # mesh collective-cost link classes (sched/cost.py): process-global
+        # like the [hbm]/[ingest] knobs — all in-process nodes share one
+        # device mesh, so the last-constructed server's values win
+        from pilosa_tpu.sched import cost as costmod
+
+        costmod.configure_links(ici_gbps=mesh_ici_gbps, dcn_gbps=mesh_dcn_gbps)
         # cross-request group-commit Count batching (exec/batcher.py)
         from pilosa_tpu.exec.batcher import CountBatcher
 
         self.count_batcher = CountBatcher()
         self.count_batcher.stats = self.stats
+        # group-commit rounds split by lowering class: a merged multi-root
+        # plan must not mix mesh-group and fan-out/extent Counts
+        self.count_batcher.classify = self.executor.count_lowering_class
         # query admission control & QoS (pilosa_tpu/sched/): every query
         # is admitted before it may dispatch — bounded concurrency, a
         # bounded priority queue, 429 load shedding — and the observed
@@ -364,6 +381,7 @@ class NodeServer:
                         "id": n.id,
                         "uri": n.uri,
                         "isCoordinator": n.is_coordinator,
+                        "meshGroup": n.mesh_group,
                         # liveness is probed fresh each boot, never trusted
                         # from disk
                     }
@@ -394,6 +412,7 @@ class NodeServer:
                     id=n["id"],
                     uri=n.get("uri", ""),
                     is_coordinator=n.get("isCoordinator", False),
+                    mesh_group=n.get("meshGroup", ""),
                 )
                 for n in doc.get("nodes", [])
             ]
@@ -445,9 +464,20 @@ class NodeServer:
         # mesh: stacked plan operands get NamedSharding placement and XLA
         # inserts the ICI collectives (parallel/mesh.py). Single-device
         # hosts (and the CPU test harness before force_cpu(n>1)) no-op.
-        from pilosa_tpu.parallel.mesh import activate_default_mesh
+        from pilosa_tpu.parallel.mesh import (
+            activate_default_mesh,
+            register_group_member,
+        )
 
         activate_default_mesh()
+        # mesh-group membership ([mesh] group knob): announce this node's
+        # shards as in-process-reachable for mesh-local sharded execution
+        # (exec/meshgroup.py) — peers in the same ICI domain fold our
+        # shards into their compiled dispatch instead of sending a leg
+        if self.mesh_group_name:
+            register_group_member(
+                self.mesh_group_name, self.node.id, self.holder
+            )
         self.holder.open()
         from pilosa_tpu.server.handler import make_http_server
 
@@ -544,6 +574,22 @@ class NodeServer:
         self.stats.gauge("ingest.merge_ms", msnap["barrier_ms"])
         self.stats.gauge("ingest.merge_batches", msnap["batches"])
         self.stats.gauge("ingest.merge_device", msnap["device"])
+        # mesh-group execution (exec/meshgroup.py): live registered group
+        # size plus cumulative shards served mesh-locally and bytes moved
+        # by in-program collectives (the observability contract of the
+        # mesh dispatch — docs/observability.md)
+        from pilosa_tpu.exec import meshgroup
+        from pilosa_tpu.parallel import mesh as pmesh_mod
+
+        gsnap = meshgroup.stats_snapshot()
+        group_size = (
+            len(pmesh_mod.group_members(self.mesh_group_name))
+            if self.mesh_group_name
+            else 0
+        )
+        self.stats.gauge("mesh.group_size", group_size)
+        self.stats.gauge("mesh.local_shards", gsnap["local_shards"])
+        self.stats.gauge("mesh.collective_bytes", gsnap["collective_bytes"])
         # per-index attribution (the telemetry-plane families): who owns
         # the resident bytes, and who has been paying the restage bill.
         # hbm.resident_bytes sums over labels to the global devcache
@@ -585,6 +631,11 @@ class NodeServer:
         from pilosa_tpu import hbm as hbmmod
 
         hbmmod.drop_index(index)
+        # mesh-group adapters hold device-cache owner tokens per index;
+        # a deleted index's group stacks must leave the ledger with it
+        from pilosa_tpu.exec import meshgroup
+
+        meshgroup.drop_index(index)
         if self.scheduler is not None:
             self.scheduler.drop_index(index)
         published = getattr(self, "_hbm_idx_published", None)
@@ -648,6 +699,10 @@ class NodeServer:
 
     def stop(self) -> None:
         self._closing.set()
+        if self.mesh_group_name:
+            from pilosa_tpu.parallel.mesh import unregister_group_member
+
+            unregister_group_member(self.mesh_group_name, self.node.id)
         self.profiler.close()  # unblock any open /debug/pprof window
         with self._import_pool_mu:
             pool, self._import_pool = self._import_pool, None
@@ -680,6 +735,7 @@ class NodeServer:
                 Node(
                     id=n.id, uri=n.uri,
                     is_coordinator=n.is_coordinator, state=n.state,
+                    mesh_group=n.mesh_group,
                 )
                 for n in nodes
             ],
@@ -689,12 +745,25 @@ class NodeServer:
             state=STATE_NORMAL,
         )
         # keep self.node identity in sync with the membership entry; we are
-        # definitionally alive, whatever a peer's stale view says
+        # definitionally alive, whatever a peer's stale view says — and OUR
+        # mesh group comes from OUR config, not a peer's (possibly stale or
+        # group-unaware) membership broadcast
         mine = self.cluster.node_by_id(self.node.id)
         if mine is not None:
             mine.uri = self.node.uri
             mine.state = "READY"
+            mine.mesh_group = self.mesh_group_name
             self.node = mine
+        # in-process peers that registered a mesh group but were seeded
+        # into this topology without one (e.g. a static-flag or harness
+        # install that predates their group config) are enriched from the
+        # process-local registry — topology stays the source of truth for
+        # cross-process deployments (join payloads and .topology carry it)
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        for n in self.cluster.nodes:
+            if not n.mesh_group and n.id != self.node.id:
+                n.mesh_group = pmesh.registered_group_of(n.id)
         self.wire_translation()
         self._save_topology()
         # a departed node's drift debt is moot (it owns nothing anymore);

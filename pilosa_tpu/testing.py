@@ -63,7 +63,14 @@ class ClusterHarness:
 
     def sync_topology(self, replica_n: Optional[int] = None) -> None:
         members = [
-            Node(id=s.node.id, uri=s.node.uri, is_coordinator=(i == 0))
+            Node(
+                id=s.node.id,
+                uri=s.node.uri,
+                is_coordinator=(i == 0),
+                # carry each node's [mesh] group declaration so topology
+                # learns ICI-domain membership (mesh-local execution)
+                mesh_group=s.mesh_group_name,
+            )
             for i, s in enumerate(self.nodes)
         ]
         for s in self.nodes:

@@ -101,6 +101,15 @@ STAT_NAMES = frozenset(
         "ingest.merge_ms",
         "ingest.merge_batches",
         "ingest.merge_device",
+        # mesh-group execution (exec/meshgroup.py, refreshed at scrape/
+        # sampler time): live registered members of this node's ICI
+        # domain, cumulative shards answered mesh-locally (no HTTP leg),
+        # and cumulative bytes moved by in-program collectives. Process-
+        # global counters like the hbm.* gauges — all in-process nodes
+        # share one device mesh.
+        "mesh.group_size",
+        "mesh.local_shards",
+        "mesh.collective_bytes",
         # live elastic resize (server/node.py streaming resharding):
         # per-fragment transfer legs, delta catch-up volume, cutover
         # latency and aborted jobs

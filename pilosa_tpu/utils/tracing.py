@@ -70,6 +70,11 @@ SPAN_NAMES = frozenset(
         # a whole distributed fan-out incl. re-map rounds
         # (exec/distributed.py)
         "exec.fanout",
+        # one mesh-group dispatch: the ICI-domain-local share of a
+        # fan-out answered as ONE compiled sharded program with the
+        # reduction in program (exec/distributed.py + exec/meshgroup.py);
+        # tags: mesh.group_size / mesh.local_shards / mesh.collective_bytes
+        "exec.mesh_dispatch",
         # one per-peer fan-out leg, with retry/breaker outcome tags
         # (exec/distributed.py; server/client.py tags rpc.retries)
         "rpc.leg",

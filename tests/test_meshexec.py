@@ -1,0 +1,738 @@
+"""Mesh-group execution tests (ISSUE 10): topology membership, the
+group-spanning lowering's differential equivalence against both the HTTP
+fan-out path and the naive set model, the 1-dispatch/1-read acceptance
+counters, the batcher's lowering-class round split, and the
+collective-cost admission terms.
+
+Runs on the tier-1 virtual 8-device mesh (conftest force_cpu(8)); the
+16/32-device certification lives in tools/mesh_cert.py (CI mesh job)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster.topology import Cluster, JumpHasher, Node
+from pilosa_tpu.core.naive import NaiveBitmap
+from pilosa_tpu.exec import meshgroup
+from pilosa_tpu.exec import plan as planmod
+from pilosa_tpu.exec.batcher import CountBatcher
+from pilosa_tpu.parallel import mesh as pmesh
+from pilosa_tpu.pql import parse
+from pilosa_tpu.sched import cost as costmod
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import ClusterHarness
+
+N_SHARDS = 6
+
+
+# ---------------------------------------------------------------------------
+# topology membership
+# ---------------------------------------------------------------------------
+
+
+def test_node_mesh_group_json_roundtrip():
+    n = Node(id="a", uri="http://h:1", mesh_group="ici0")
+    assert Node.from_json(n.to_json()).mesh_group == "ici0"
+    # absent key (pre-mesh peer) degrades to no group
+    assert Node.from_json({"id": "b"}).mesh_group == ""
+
+
+def test_cluster_mesh_peers():
+    c = Cluster(
+        nodes=[
+            Node(id="a", mesh_group="g1"),
+            Node(id="b", mesh_group="g1"),
+            Node(id="c", mesh_group="g2"),
+            Node(id="d"),
+            Node(id="e", mesh_group="g1", state="DOWN"),
+        ],
+        hasher=JumpHasher(),
+    )
+    assert c.mesh_group_of("a") == "g1"
+    assert c.mesh_group_of("zzz") == ""
+    peers = {n.id for n in c.mesh_peers("a")}
+    assert peers == {"b"}  # not self, not g2, not groupless, not DOWN
+    assert c.mesh_peers("d") == []
+
+
+def test_registry_register_unregister():
+    gen0 = pmesh.group_generation()
+    pmesh.register_group_member("tg", "n1", "h1")
+    try:
+        assert pmesh.group_members("tg") == {"n1": "h1"}
+        assert pmesh.registered_group_of("n1") == "tg"
+        assert pmesh.group_generation() > gen0
+    finally:
+        pmesh.unregister_group_member("tg", "n1")
+    assert pmesh.group_members("tg") == {}
+    assert pmesh.registered_group_of("n1") == ""
+
+
+# ---------------------------------------------------------------------------
+# eligibility
+# ---------------------------------------------------------------------------
+
+
+def test_eligibility_gates():
+    ok = parse("Count(Intersect(Row(f=1), Row(f=2)))").calls[0]
+    assert meshgroup.eligible(ok)
+    assert meshgroup.eligible(parse("TopN(f, Row(f=2), n=3)").calls[0])
+    # Shift's cross-shard carry may read predecessors outside the group
+    assert not meshgroup.eligible(parse("Count(Shift(Row(f=1), n=1))").calls[0])
+    # time ranges walk the coordinator's view list only
+    assert not meshgroup.eligible(
+        parse("Row(f=1, from='2020-01-01T00:00', to='2020-02-01T00:00')").calls[0]
+    )
+    assert not meshgroup.eligible(parse("Sum(field=v)").calls[0])
+
+
+# ---------------------------------------------------------------------------
+# differential equivalence on a real 3-node one-group cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_cluster():
+    with ClusterHarness(
+        3, in_memory=True, mesh_group="test-ici",
+        telemetry_sample_interval=0.0,
+    ) as cluster:
+        api = cluster[0].api
+        api.create_index("mx")
+        api.create_field("mx", "f")
+        api.create_field(
+            "mx", "v", options={"type": "int", "min": -500, "max": 500}
+        )
+        rng = np.random.default_rng(7)
+        cols = {}
+        for r in range(1, 5):
+            c = rng.integers(
+                0, N_SHARDS * SHARD_WIDTH, 4000
+            ).astype(np.uint64)
+            api.import_bits("mx", "f", np.full(len(c), r, np.uint64), c)
+            cols[r] = c
+        vcols = np.unique(
+            rng.integers(0, N_SHARDS * SHARD_WIDTH, 2000).astype(np.uint64)
+        )
+        vvals = rng.integers(-500, 501, len(vcols)).astype(np.int64)
+        api.import_values("mx", "v", vcols, vvals)
+        yield cluster, cols, (vcols, vvals)
+
+
+def _set_mesh(cluster, on: bool) -> None:
+    for node in cluster.nodes:
+        node.executor.mesh_min_nodes = 2 if on else 0
+
+
+def _both(cluster, pql, index="mx"):
+    """(mesh-path results, HTTP-fan-out results, mesh stats delta)."""
+    api = cluster[0].api
+    _set_mesh(cluster, True)
+    meshgroup.reset_stats()
+    r_mesh = api.query(index, pql)
+    snap = meshgroup.stats_snapshot()
+    _set_mesh(cluster, False)
+    try:
+        r_http = api.query(index, pql)
+    finally:
+        _set_mesh(cluster, True)
+    return r_mesh, r_http, snap
+
+
+class TestDifferentialEquivalence:
+    def test_count_shapes_vs_http_and_naive(self, mesh_cluster):
+        cluster, cols, _ = mesh_cluster
+        na = {r: NaiveBitmap(c.tolist()) for r, c in cols.items()}
+        shapes = [
+            (
+                "Count(Intersect(Row(f=1), Row(f=2)))",
+                na[1].intersect(na[2]).count(),
+            ),
+            ("Count(Union(Row(f=1), Row(f=2)))", na[1].union(na[2]).count()),
+            (
+                "Count(Difference(Row(f=1), Row(f=3)))",
+                na[1].difference(na[3]).count(),
+            ),
+            ("Count(Xor(Row(f=2), Row(f=4)))", na[2].xor(na[4]).count()),
+        ]
+        for pql, want in shapes:
+            (got_mesh,), (got_http,), snap = _both(cluster, pql)
+            assert got_mesh == got_http == want, (pql, got_mesh, got_http, want)
+            assert snap["dispatches"] == 1 and snap["fallbacks"] == 0, (pql, snap)
+
+    def test_row_results_vs_http_and_naive(self, mesh_cluster):
+        cluster, cols, _ = mesh_cluster
+        na = {r: NaiveBitmap(c.tolist()) for r, c in cols.items()}
+        (rm,), (rh,), snap = _both(cluster, "Union(Row(f=1), Row(f=2))")
+        want = na[1].union(na[2]).slice()
+        assert sorted(rm.columns().tolist()) == sorted(rh.columns().tolist())
+        assert sorted(rm.columns().tolist()) == want
+        assert snap["dispatches"] == 1, snap
+
+    def test_bsi_condition_count(self, mesh_cluster):
+        cluster, _, (vcols, vvals) = mesh_cluster
+        (gm,), (gh,), snap = _both(cluster, "Count(Row(v > 100))")
+        assert gm == gh == int((vvals > 100).sum())
+        assert snap["dispatches"] == 1, snap
+
+    def test_not_count(self, mesh_cluster):
+        cluster, cols, (vcols, _) = mesh_cluster
+        exists = set()
+        for c in cols.values():
+            exists.update(c.tolist())
+        exists.update(vcols.tolist())
+        (gm,), (gh,), snap = _both(cluster, "Count(Not(Row(f=1)))")
+        assert gm == gh == len(exists - set(cols[1].tolist()))
+        assert snap["dispatches"] == 1, snap
+
+    def test_topn_plain_and_filtered(self, mesh_cluster):
+        cluster, _, _ = mesh_cluster
+        for pql in ("TopN(f, n=3)", "TopN(f, Row(f=2), n=3)"):
+            (pm,), (ph,), _ = _both(cluster, pql)
+            assert [(p.id, p.count) for p in pm] == [
+                (p.id, p.count) for p in ph
+            ], pql
+
+    def test_topn_tally_not_stale_after_member_write(self, mesh_cluster):
+        """Regression: the filtered-TopN tally bundle is cached under the
+        GROUP view's owner token, which no member write ever eagerly
+        invalidates — only the versions salted into its cache key keep it
+        honest. Warm the bundle, write through a member, re-query: the
+        mesh result must reflect the write and match the HTTP path."""
+        cluster, _, _ = mesh_cluster
+        api = cluster[0].api
+        # own index: this test mutates rows, and the module fixture's
+        # cols map must stay exact for the other differential tests
+        api.create_index("tn")
+        api.create_field("tn", "f")
+        rng = np.random.default_rng(11)
+        for r in (1, 2):
+            c = rng.integers(0, N_SHARDS * SHARD_WIDTH, 3000).astype(np.uint64)
+            api.import_bits("tn", "f", np.full(len(c), r, np.uint64), c)
+        _set_mesh(cluster, True)
+        pql = "TopN(f, Row(f=2), n=5)"
+        (warm,) = api.query("tn", pql)  # populate the group tally bundle
+        # land a bit present in BOTH row 1 and the filter row 2, on a
+        # shard another member owns, so the (1 ∩ 2) tally must move
+        col = 4 * SHARD_WIDTH + 99_999
+        api.query("tn", f"Set({col}, f=1)Set({col}, f=2)")
+        (pm,) = api.query("tn", pql)
+        _set_mesh(cluster, False)
+        try:
+            (ph,) = api.query("tn", pql)
+        finally:
+            _set_mesh(cluster, True)
+        assert [(p.id, p.count) for p in pm] == [(p.id, p.count) for p in ph]
+        by_id = {p.id: p.count for p in pm}
+        warm_by_id = {p.id: p.count for p in warm}
+        assert by_id[1] == warm_by_id.get(1, 0) + 1, (warm, pm)
+
+    def test_every_coordinator_agrees(self, mesh_cluster):
+        """Any member may coordinate a mesh-group query, not just node 0."""
+        cluster, cols, _ = mesh_cluster
+        na = NaiveBitmap(cols[1].tolist()).intersect(
+            NaiveBitmap(cols[2].tolist())
+        )
+        _set_mesh(cluster, True)
+        for node in cluster.nodes:
+            (got,) = node.api.query(
+                "mx", "Count(Intersect(Row(f=1), Row(f=2)))"
+            )
+            assert got == na.count()
+
+    def test_write_visible_through_mesh_path(self, mesh_cluster):
+        """A write landing after a warm mesh query re-keys the covering
+        group stack: the next mesh query sees it (version-keyed staging,
+        never served stale)."""
+        cluster, _, _ = mesh_cluster
+        api = cluster[0].api
+        _set_mesh(cluster, True)
+        (before,) = api.query("mx", "Count(Row(f=9))")
+        col = 3 * SHARD_WIDTH + 17
+        api.query("mx", f"Set({col}, f=9)")
+        (after,) = api.query("mx", "Count(Row(f=9))")
+        assert after == before + 1
+        (after_http,) = _both(cluster, "Count(Row(f=9))")[1]
+        assert after_http == after
+
+
+# ---------------------------------------------------------------------------
+# acceptance counters: 1 compiled dispatch + 1 blocking host read,
+# independent of group shard count
+# ---------------------------------------------------------------------------
+
+
+class TestAcceptanceCounters:
+    def test_one_dispatch_one_read(self, mesh_cluster):
+        cluster, cols, _ = mesh_cluster
+        api = cluster[0].api
+        _set_mesh(cluster, True)
+        pql = "Count(Intersect(Row(f=1), Row(f=2)))"
+        api.query("mx", pql)  # warm: compile + stage under this mode
+        planmod.reset_stats()
+        meshgroup.reset_stats()
+        (got,) = api.query("mx", pql)
+        na = NaiveBitmap(cols[1].tolist()).intersect(
+            NaiveBitmap(cols[2].tolist())
+        )
+        assert got == na.count()
+        assert planmod.STATS["evals"] == 1, planmod.STATS
+        assert planmod.STATS["host_reads"] == 1, planmod.STATS
+        snap = meshgroup.stats_snapshot()
+        assert snap["dispatches"] == 1 and snap["fallbacks"] == 0, snap
+        assert snap["local_shards"] == N_SHARDS, snap
+
+    def test_counters_independent_of_shard_count(self, mesh_cluster):
+        """Twice the shards, same 1 dispatch + 1 read (the whole point:
+        blocking-read count no longer scales with the group)."""
+        cluster, _, _ = mesh_cluster
+        api = cluster[0].api
+        api.create_index("wide")
+        api.create_field("wide", "f")
+        rng = np.random.default_rng(3)
+        for width in (4, 12):
+            c = rng.integers(0, width * SHARD_WIDTH, 3000).astype(np.uint64)
+            api.import_bits(
+                "wide", "f", np.full(len(c), width, np.uint64), c
+            )
+        _set_mesh(cluster, True)
+        reads = []
+        for width in (4, 12):
+            pql = f"Count(Row(f={width}))"
+            api.query("wide", pql)  # warm
+            planmod.reset_stats()
+            api.query("wide", pql)
+            reads.append(
+                (planmod.STATS["evals"], planmod.STATS["host_reads"])
+            )
+        assert reads == [(1, 1), (1, 1)], reads
+
+    def test_multi_count_batch_one_dispatch(self, mesh_cluster):
+        cluster, cols, _ = mesh_cluster
+        api = cluster[0].api
+        _set_mesh(cluster, True)
+        pql = "Count(Row(f=1))Count(Row(f=2))Count(Xor(Row(f=1),Row(f=2)))"
+        got_w = api.query("mx", pql)  # warm
+        planmod.reset_stats()
+        got = api.query("mx", pql)
+        assert got == got_w
+        assert planmod.STATS["evals"] == 1, planmod.STATS
+        assert planmod.STATS["host_reads"] == 1, planmod.STATS
+        _set_mesh(cluster, False)
+        try:
+            assert api.query("mx", pql) == got
+        finally:
+            _set_mesh(cluster, True)
+
+
+# ---------------------------------------------------------------------------
+# mixed topology: the group covers only part of the query's owners
+# ---------------------------------------------------------------------------
+
+
+def test_group_subset_mixed_legs():
+    """Nodes 0+1 share an ICI domain, node 2 does not: one mesh dispatch
+    covers the group's shards, node 2's shards ride an HTTP leg, and the
+    merged result is bit-identical to the all-HTTP path and the naive
+    model."""
+    with ClusterHarness(
+        3, in_memory=True, mesh_group="sub-ici",
+        telemetry_sample_interval=0.0,
+    ) as cluster:
+        # evict node 2 from the domain: registry + topology both drop it
+        pmesh.unregister_group_member("sub-ici", cluster[2].node.id)
+        cluster.nodes[2].mesh_group_name = ""
+        cluster[2].node.mesh_group = ""
+        cluster.sync_topology()
+        api = cluster[0].api
+        api.create_index("sx")
+        api.create_field("sx", "f")
+        rng = np.random.default_rng(5)
+        a = rng.integers(0, 8 * SHARD_WIDTH, 5000).astype(np.uint64)
+        b = rng.integers(0, 8 * SHARD_WIDTH, 5000).astype(np.uint64)
+        api.import_bits("sx", "f", np.full(len(a), 1, np.uint64), a)
+        api.import_bits("sx", "f", np.full(len(b), 2, np.uint64), b)
+        na = NaiveBitmap(a.tolist()).intersect(NaiveBitmap(b.tolist()))
+
+        # sanity: node 2 actually owns some shards of this index
+        idx = cluster[0].holder.index("sx")
+        owners = cluster[0].cluster.shards_by_node(
+            "sx", sorted(idx.available_shards())
+        )
+        assert cluster[2].node.id in owners, owners
+
+        meshgroup.reset_stats()
+        (got,) = api.query("sx", "Count(Intersect(Row(f=1), Row(f=2)))")
+        assert got == na.count()
+        snap = meshgroup.stats_snapshot()
+        assert snap["dispatches"] == 1, snap  # nodes 0+1 folded
+        total = sum(len(v) for v in owners.values())
+        assert 0 < snap["local_shards"] < total, (snap, owners)
+
+        _set_mesh(cluster, False)
+        (got_http,) = api.query("sx", "Count(Intersect(Row(f=1), Row(f=2)))")
+        assert got_http == got
+
+
+def test_mesh_disabled_by_min_nodes_zero(mesh_cluster):
+    cluster, cols, _ = mesh_cluster
+    _set_mesh(cluster, False)
+    try:
+        meshgroup.reset_stats()
+        (got,) = cluster[0].api.query(
+            "mx", "Count(Intersect(Row(f=1), Row(f=2)))"
+        )
+        na = NaiveBitmap(cols[1].tolist()).intersect(
+            NaiveBitmap(cols[2].tolist())
+        )
+        assert got == na.count()
+        assert meshgroup.stats_snapshot()["dispatches"] == 0
+    finally:
+        _set_mesh(cluster, True)
+
+
+# ---------------------------------------------------------------------------
+# batcher: rounds split by lowering class
+# ---------------------------------------------------------------------------
+
+
+class TestBatcherClassSplit:
+    def _drive(self, classify):
+        """Run a leader + 4 queued waiters of alternating classes through
+        one batcher round; returns the merged call-name sets per execute."""
+        b = CountBatcher()
+        b.classify = classify
+        execs = []
+        release = threading.Event()
+
+        def execute(q):
+            if not release.is_set():  # the leader's own solo execution
+                release.wait(5.0)
+            execs.append([str(c) for c in q.calls])
+            return [0] * len(q.calls)
+
+        def leader():
+            b.run("i", parse("Count(Row(a=0))"), execute)
+
+        t = threading.Thread(target=leader)
+        t.start()
+        # queue waiters while the leader blocks in execute
+        threads = []
+        for i in range(4):
+            row = "m" if i % 2 == 0 else "x"
+            q = parse(f"Count(Row({row}={i}))")
+
+            def run(q=q):
+                b.run("i", q, execute)
+
+            w = threading.Thread(target=run)
+            w.start()
+            threads.append(w)
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with b._mu:
+                if len(b._queue.get("i", ())) == 4:
+                    break
+            time.sleep(0.005)
+        release.set()
+        t.join(10.0)
+        for w in threads:
+            w.join(10.0)
+        return execs[1:]  # drop the leader's solo run
+
+    def test_rounds_split_by_class(self):
+        """Waiters of two lowering classes never merge into one multi-root
+        execution (mesh-sharded and extent-local operand placements are
+        incompatible)."""
+
+        def classify(index, q):
+            return "mesh" if "Row(m" in str(q.calls[0]) else "fanout"
+
+        rounds = self._drive(classify)
+        assert len(rounds) == 2, rounds  # one merged round per class
+        for calls in rounds:
+            kinds = {("m" if "Row(m" in c else "x") for c in calls}
+            assert len(kinds) == 1, rounds
+
+    def test_no_classifier_merges_one_round(self):
+        rounds = self._drive(None)
+        assert len(rounds) == 1 and len(rounds[0]) == 4, rounds
+
+    def test_classifier_errors_degrade_to_shared_class(self):
+        def classify(index, q):
+            raise RuntimeError("boom")
+
+        rounds = self._drive(classify)
+        assert len(rounds) == 1 and len(rounds[0]) == 4, rounds
+
+
+def test_count_lowering_class(mesh_cluster):
+    cluster, _, _ = mesh_cluster
+    ex = cluster[0].executor
+    _set_mesh(cluster, True)
+    assert ex.count_lowering_class("mx", parse("Count(Row(f=1))")) == "mesh"
+    # Shift is mesh-ineligible -> fanout
+    assert (
+        ex.count_lowering_class("mx", parse("Count(Shift(Row(f=1), n=1))"))
+        == "fanout"
+    )
+    _set_mesh(cluster, False)
+    try:
+        assert (
+            ex.count_lowering_class("mx", parse("Count(Row(f=1))")) == "fanout"
+        )
+    finally:
+        _set_mesh(cluster, True)
+
+
+# ---------------------------------------------------------------------------
+# collective-cost accounting (sched/cost.py) + admission integration
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveCost:
+    def test_link_terms(self):
+        costmod.configure_links(ici_gbps=100.0, dcn_gbps=2.0)
+        try:
+            # 1 GB over 100 GB/s = 10 ms; over 2 GB/s = 500 ms
+            assert costmod.collective_ms(10**9, "ici") == pytest.approx(10.0)
+            assert costmod.collective_ms(10**9, "dcn") == pytest.approx(500.0)
+            assert costmod.collective_ms(0, "ici") == 0.0
+            # leg floor charged once per fan-out, not per leg
+            base = costmod.transport_ms(0, 0, 0)
+            one = costmod.transport_ms(0, 1000, 1)
+            three = costmod.transport_ms(0, 1000, 3)
+            assert base == 0.0 and one == three > 0.0
+        finally:
+            costmod.configure_links(ici_gbps=100.0, dcn_gbps=3.0)
+
+    def test_estimate_carries_transport(self, mesh_cluster):
+        cluster, _, _ = mesh_cluster
+        idx = cluster[0].holder.index("mx")
+        q = parse("Count(Row(f=1))")
+        profile = cluster[0].executor.transport_profile(idx)
+        assert profile["mesh_shards"] > 0, profile
+        c_mesh = costmod.estimate(idx, q, transport=profile)
+        assert c_mesh.transport_ms > 0.0
+        c_plain = costmod.estimate(idx, q)
+        assert c_plain.transport_ms == 0.0
+
+    def test_transport_profile_split(self):
+        with ClusterHarness(
+            3, in_memory=True, mesh_group="tp-ici",
+            telemetry_sample_interval=0.0,
+        ) as cluster:
+            pmesh.unregister_group_member("tp-ici", cluster[2].node.id)
+            cluster.nodes[2].mesh_group_name = ""
+            cluster[2].node.mesh_group = ""
+            cluster.sync_topology()
+            api = cluster[0].api
+            api.create_index("tp")
+            api.create_field("tp", "f")
+            cols = np.arange(0, 8 * SHARD_WIDTH, SHARD_WIDTH, dtype=np.uint64)
+            api.import_bits("tp", "f", np.ones(len(cols), np.uint64), cols)
+            idx = cluster[0].holder.index("tp")
+            profile = cluster[0].executor.transport_profile(idx)
+            owners = cluster[0].cluster.shards_by_node(
+                "tp", sorted(idx.available_shards())
+            )
+            total = sum(len(v) for v in owners.values())
+            # node2 left the domain: its shards (if any) are DCN legs;
+            # the local node's own share crosses no link
+            want_leg_shards = len(owners.get(cluster[2].node.id, []))
+            assert profile["leg_shards"] == want_leg_shards, (profile, owners)
+            assert profile["legs"] == (1 if want_leg_shards else 0)
+            assert profile["mesh_shards"] + profile["leg_shards"] <= total
+
+    def test_admission_honors_transport_ms(self):
+        from pilosa_tpu.sched.admission import AdmissionController, ShedError
+        from pilosa_tpu.sched.cost import QueryCost
+
+        ctl = AdmissionController(max_concurrent=2)
+        # transport alone exceeds the deadline: shed on arrival
+        heavy = QueryCost(device_bytes=0, transport_ms=5000.0)
+        with pytest.raises(ShedError):
+            ctl.admit(cost=heavy, deadline=1.0)
+        # same deadline without the transport bill admits
+        t = ctl.admit(cost=QueryCost(device_bytes=0), deadline=1.0)
+        t.release()
+        # and on the leg lane too
+        with pytest.raises(ShedError):
+            ctl.admit(cost=heavy, deadline=1.0, leg=True)
+
+
+# ---------------------------------------------------------------------------
+# GC + config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_view_created_after_adapter_cached(mesh_cluster):
+    """Regression: a field whose view materializes AFTER the group
+    adapter was cached (views are created lazily on first write) must
+    become visible to the mesh path — a memoized miss would pin its
+    rows at zero forever while the HTTP path counts them."""
+    cluster, _, _ = mesh_cluster
+    api = cluster[0].api
+    _set_mesh(cluster, True)
+    api.create_field("mx", "late")
+    # same shard assignment as the warm adapter: Count the empty field
+    # first (memoizes the view resolution), then import into it
+    (empty,) = api.query("mx", "Count(Row(late=1))")
+    assert empty == 0
+    cols = np.arange(0, 6 * SHARD_WIDTH, SHARD_WIDTH // 2, dtype=np.uint64)
+    api.import_bits("mx", "late", np.ones(len(cols), np.uint64), cols)
+    (got,) = api.query("mx", "Count(Row(late=1))")
+    _set_mesh(cluster, False)
+    try:
+        (http,) = api.query("mx", "Count(Row(late=1))")
+    finally:
+        _set_mesh(cluster, True)
+    assert got == http == len(cols), (got, http, len(cols))
+
+
+def test_field_delete_recreate_drops_adapters(mesh_cluster):
+    """Regression: deleting a field drops the index's cached group
+    adapters — a recreate must not serve the dead Field/View objects."""
+    cluster, _, _ = mesh_cluster
+    api = cluster[0].api
+    _set_mesh(cluster, True)
+    api.create_field("mx", "reborn")
+    cols = np.arange(0, 6 * SHARD_WIDTH, SHARD_WIDTH, dtype=np.uint64)
+    api.import_bits("mx", "reborn", np.ones(len(cols), np.uint64), cols)
+    (first,) = api.query("mx", "Count(Row(reborn=1))")
+    assert first == len(cols)
+    api.delete_field("mx", "reborn")
+    api.create_field("mx", "reborn")
+    cols2 = cols[:3]
+    api.import_bits("mx", "reborn", np.ones(len(cols2), np.uint64), cols2)
+    (got,) = api.query("mx", "Count(Row(reborn=1))")
+    _set_mesh(cluster, False)
+    try:
+        (http,) = api.query("mx", "Count(Row(reborn=1))")
+    finally:
+        _set_mesh(cluster, True)
+    assert got == http == len(cols2), (got, http)
+
+
+def test_transport_floor_once_per_query():
+    costmod.configure_links(ici_gbps=100.0, dcn_gbps=3.0)
+    q1 = parse("Count(Row(f=1))").calls
+    q20 = parse("".join(f"Count(Row(f={i}))" for i in range(20))).calls
+    profile = {"mesh_shards": 0, "legs": 2, "leg_shards": 4}
+    one = costmod._transport_estimate(q1, profile)
+    twenty = costmod._transport_estimate(q20, profile)
+    # byte terms scale with calls; the fixed round-trip floor must not
+    # (legs run concurrently, adjacent Counts share a dispatch)
+    floor = costmod.transport_ms(0, 0, 2)
+    assert one >= floor
+    assert twenty - floor < 20 * (one - floor) + 1e-9
+    assert twenty < 20 * one
+
+
+def test_min_nodes_one_folds_single_peer():
+    """min-nodes=1 honors its documented contract: even a single
+    group-local peer owner folds (saving its HTTP leg)."""
+    with ClusterHarness(
+        2, in_memory=True, mesh_group="mn-ici",
+        telemetry_sample_interval=0.0,
+    ) as cluster:
+        api = cluster[0].api
+        api.create_index("mn")
+        api.create_field("mn", "f")
+        cols = np.arange(0, 6 * SHARD_WIDTH, SHARD_WIDTH, dtype=np.uint64)
+        api.import_bits("mn", "f", np.ones(len(cols), np.uint64), cols)
+        for node in cluster.nodes:
+            node.executor.mesh_min_nodes = 1
+        meshgroup.reset_stats()
+        (got,) = api.query("mn", "Count(Row(f=1))")
+        assert got == len(cols)
+        assert meshgroup.stats_snapshot()["dispatches"] >= 1
+
+
+def test_admission_charges_full_group_shards(mesh_cluster):
+    """A mesh-group dispatch stages the WHOLE group's operands on this
+    device: the admission estimate must charge every folded shard, not
+    the coordinator's 1/N share."""
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+    cluster, _, _ = mesh_cluster
+    idx = cluster[0].holder.index("mx")
+    profile = cluster[0].executor.transport_profile(idx)
+    assert profile["device_shards"] == profile["mesh_shards"] > 0
+    q = parse("Count(Row(f=1))")
+    c = costmod.estimate(
+        idx, q, shard_count=profile["device_shards"], transport=profile
+    )
+    # one row stack over the full group shard axis (minus any warm
+    # residency discount, hence >= a single-shard charge floor)
+    assert c.device_bytes <= profile["device_shards"] * WORDS_PER_ROW * 4
+
+
+def test_group_index_cache_drops_with_index(mesh_cluster):
+    cluster, _, _ = mesh_cluster
+    api = cluster[0].api
+    api.create_index("gone")
+    api.create_field("gone", "f")
+    cols = np.arange(0, 6 * SHARD_WIDTH, SHARD_WIDTH // 2, dtype=np.uint64)
+    api.import_bits("gone", "f", np.ones(len(cols), np.uint64), cols)
+    _set_mesh(cluster, True)
+    (got,) = api.query("gone", "Count(Row(f=1))")
+    assert got == len(cols)
+    with meshgroup._cache_mu:
+        assert any(k[0] == "gone" for k in meshgroup._cache)
+    api.delete_index("gone")
+    with meshgroup._cache_mu:
+        assert not any(k[0] == "gone" for k in meshgroup._cache)
+
+
+def test_mesh_config_three_way():
+    from pilosa_tpu.cli.config import Config
+
+    cfg = Config.load(
+        env={
+            "PILOSA_TPU_MESH__GROUP": "podA",
+            "PILOSA_TPU_MESH__MIN_NODES": "3",
+            "PILOSA_TPU_MESH__ICI_GBPS": "186.0",
+        }
+    )
+    assert cfg.mesh.group == "podA"
+    assert cfg.mesh.min_nodes == 3
+    assert cfg.mesh.ici_gbps == 186.0
+    text = cfg.to_toml()
+    assert "[mesh]" in text and 'group = "podA"' in text
+
+    from pilosa_tpu.cli.main import _FLAG_KNOBS, _build_parser
+
+    # every [mesh] knob is flag-reachable (API003-005 enforce docs sync)
+    assert _FLAG_KNOBS["mesh_group"] == ("mesh", "group")
+    p = _build_parser()
+    args = p.parse_args(
+        ["server", "--mesh-group", "podB", "--mesh-min-nodes", "2"]
+    )
+    assert args.mesh_group == "podB" and args.mesh_min_nodes == 2
+
+
+def test_topology_carries_group_through_persistence(tmp_path):
+    srv = None
+    try:
+        from pilosa_tpu.server.node import NodeServer
+
+        srv = NodeServer(
+            str(tmp_path / "n0"), "n0", mesh_group="persist-ici",
+            telemetry_sample_interval=0.0,
+        ).start()
+        peer = Node(id="n1", uri="http://h:1", mesh_group="persist-ici")
+        srv.set_topology([srv.node, peer])
+        assert srv.cluster.mesh_group_of("n0") == "persist-ici"
+        assert srv.cluster.mesh_group_of("n1") == "persist-ici"
+        import json
+
+        with open(srv._topology_path) as f:
+            doc = json.load(f)
+        assert {n["meshGroup"] for n in doc["nodes"]} == {"persist-ici"}
+    finally:
+        if srv is not None:
+            srv.stop()

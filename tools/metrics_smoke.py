@@ -62,7 +62,7 @@ def main() -> int:
     errors: list = []
     with ClusterHarness(
         3, replica_n=1, in_memory=True, metric_poll_interval=0.0,
-        telemetry_sample_interval=0.0,
+        telemetry_sample_interval=0.0, mesh_group="smoke-ici",
     ) as cluster:
         uri = cluster[0].node.uri
         for idx in ("smoke_a", "smoke_b"):
@@ -96,6 +96,22 @@ def main() -> int:
             uri, "/index/smoke_a/query", {"query": "Count(Row(f=2))"}
         )
         assert resp["results"] == [600], resp
+        # mesh-group execution (ISSUE 10): a Count spanning shards on at
+        # least two owner nodes folds into ONE mesh dispatch (the whole
+        # harness shares the "smoke-ici" domain) — this is what moves
+        # the mesh.local_shards / mesh.collective_bytes gauges asserted
+        # below
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        mesh_cols = [i * SHARD_WIDTH for i in range(6)]
+        _post(
+            uri, "/index/smoke_a/field/f/import",
+            {"rows": [3] * len(mesh_cols), "cols": mesh_cols},
+        )
+        resp = _post(
+            uri, "/index/smoke_a/query", {"query": "Count(Row(f=3))"}
+        )
+        assert resp["results"] == [len(mesh_cols)], resp
         # the resize-job record must scrape as well-formed JSON on a live
         # node (operators poll it during elastic resizes; an idle node
         # reports NONE)
@@ -135,6 +151,24 @@ def main() -> int:
     )
     if m and float(m.group(1)) <= 0:
         errors.append("ingest.merge_batches stayed zero after a staged burst")
+
+    # mesh-group execution (ISSUE 10): the cluster runs as one ICI
+    # domain, so the Counts above must have ridden mesh dispatches —
+    # all three mesh gauges must render and group_size must equal the
+    # 3 registered members (local_shards moving proves at least one
+    # fan-out actually folded instead of paying HTTP legs)
+    for fam, want_min in (
+        ("pilosa_tpu_mesh_group_size", 3.0),
+        ("pilosa_tpu_mesh_local_shards", 1.0),
+        ("pilosa_tpu_mesh_collective_bytes", 1.0),
+    ):
+        m = re.search(rf"^{fam} ([0-9.e+-]+)", node_text, re.M)
+        if m is None:
+            errors.append(f"node /metrics: {fam} missing")
+        elif float(m.group(1)) < want_min:
+            errors.append(
+                f"node /metrics: {fam} = {m.group(1)}, expected >= {want_min}"
+            )
 
     # per-index attribution: both tenants present, and their label sets
     # disjoint from each other (a merge that smeared series across
