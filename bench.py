@@ -122,6 +122,118 @@ def mesh_scaling_main():
     print(json.dumps(rows))
 
 
+def replicated_bench(seconds=None, writers=8, sync_interval=0.0):
+    """Replicated mixed read/write — the benched configuration (ISSUE 12):
+    two NodeServers with REAL data dirs (WAL + fsync on the bench host's
+    filesystem) and real HTTP between them, replica_n=2, `writers`
+    concurrent import threads driving api.import_bits under the strict
+    group-commit WAL while a Count stream runs against the same node.
+    Reports aggregate logical ingest bits/s (each bit also lands on the
+    replica — physical write volume is 2x), the fsyncs-per-import
+    coalescing ratio and mean commit-group size from the group-commit
+    counters, and query p99 under replicated ingest from the PR 6
+    flight-recorder histograms."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from pilosa_tpu.core import wal as walmod
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.testing import ClusterHarness
+
+    if seconds is None:
+        seconds = float(os.environ.get("PILOSA_TPU_BENCH_REPL_S", "3.0"))
+    n_shards = 16
+    base = tempfile.mkdtemp(prefix="pilosa-benchrepl-")
+    try:
+        with ClusterHarness(
+            2, replica_n=2, base_dir=base, wal_sync_interval=sync_interval
+        ) as c:
+            api = c[0].api
+            api.create_index("rx")
+            api.create_field("rx", "f", {"type": "set"})
+            rng = np.random.default_rng(5)
+            cols0 = rng.integers(0, n_shards * SHARD_WIDTH, 20_000).astype(
+                np.uint64
+            )
+            api.import_bits("rx", "f", np.ones(len(cols0), np.uint64), cols0)
+            api.query("rx", "Count(Row(f=1))")  # warm: stage + compile
+            # drop warm-up observations: the histogram must hold ONLY
+            # queries issued under replicated ingest pressure
+            c[0].stats.registry.drop_label("index", "rx")
+            w0 = walmod.stats_snapshot()
+            stop = threading.Event()
+            wrote = [0] * writers
+            calls = [0] * writers
+            errs = []
+
+            def writer(t):
+                try:
+                    wrng = np.random.default_rng(200 + t)
+                    batch = 20_000
+                    while not stop.is_set():
+                        r = wrng.integers(1, 9, batch).astype(np.uint64)
+                        cl = wrng.integers(
+                            0, n_shards * SHARD_WIDTH, batch
+                        ).astype(np.uint64)
+                        api.import_bits("rx", "f", r, cl)
+                        wrote[t] += batch
+                        calls[t] += 1
+                except BaseException as e:  # noqa: BLE001 - fail the bench
+                    errs.append(e)
+
+            threads = [
+                threading.Thread(target=writer, args=(t,))
+                for t in range(writers)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            queries = 0
+            try:
+                while time.perf_counter() - t0 < seconds:
+                    api.query("rx", "Count(Row(f=1))")
+                    queries += 1
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join()
+            elapsed = time.perf_counter() - t0
+            if errs:  # a dead writer fakes the numbers
+                raise errs[0]
+            w1 = walmod.stats_snapshot()
+            reg = c[0].stats.registry
+            n_calls = sum(calls) or 1
+            groups = max(w1["commit_groups"] - w0["commit_groups"], 1)
+            return {
+                "ingest_replicated_bits_mps": round(
+                    sum(wrote) / elapsed / 1e6, 2
+                ),
+                "query_p99_under_replicated_ingest_ms": round(
+                    reg.quantile("query_ms", 0.99, tags=("index:rx",)), 3
+                ),
+                "replicated_queries": queries,
+                "replicated_imports": n_calls,
+                "wal_fsyncs_per_import": round(
+                    (w1["fsyncs"] - w0["fsyncs"]) / n_calls, 3
+                ),
+                # per WAL APPEND (one per fragment touched per node):
+                # the group commit's real coalescing ratio when a call
+                # fans across many fragment files
+                "wal_fsyncs_per_append": round(
+                    (w1["fsyncs"] - w0["fsyncs"])
+                    / max(w1["commits"] - w0["commits"], 1),
+                    3,
+                ),
+                "wal_commit_group_mean": round(
+                    (w1["commits"] - w0["commits"]) / groups, 2
+                ),
+            }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main():
     os.environ.setdefault("PILOSA_TPU_HBM_BUDGET_MB", "16384")
     # bigger tally tiles at bench scale: fewer filtered-TopN chunk dispatches
@@ -702,6 +814,15 @@ def main():
     finally:
         srv.stop()
 
+    # replicated mixed read/write — the production write configuration
+    # (ISSUE 12): replica_n=2 over two real-data-dir HTTP nodes with the
+    # strict group-commit WAL on; its own harness, so it runs after the
+    # in-memory node is down
+    try:
+        replicated = replicated_bench()
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        replicated = {"replicated_error": f"{type(e).__name__}: {e}"[:200]}
+
     # config 5 stand-in: virtual-mesh scaling curve in a CPU subprocess
     # (hermetic from the TPU tunnel; same env recipe as tests/conftest.py)
     env = dict(os.environ)
@@ -807,6 +928,7 @@ def main():
                         mixed_merge_barrier_ms_mean, 3
                     ),
                     "mixed_extent_patches": mixed_extent_patches,
+                    **replicated,
                     "timeq_range_ms": round(timeq_range_ms, 3),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),
@@ -832,4 +954,8 @@ def main():
 if __name__ == "__main__":
     if "--mesh-scaling" in sys.argv:
         sys.exit(mesh_scaling_main())
+    if "--replicated" in sys.argv:
+        # the replicated write-path section alone (quick durability runs)
+        print(json.dumps(replicated_bench()))
+        sys.exit(0)
     sys.exit(main())

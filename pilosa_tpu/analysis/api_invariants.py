@@ -65,6 +65,7 @@ _SECTION_CLASSES = {
     "SchedConfig": "sched",
     "HbmConfig": "hbm",
     "IngestConfig": "ingest",
+    "WalConfig": "wal",
     "MeshConfig": "mesh",
     "ResizeConfig": "resize",
     "AntiEntropyConfig": "anti_entropy",

@@ -78,6 +78,18 @@ class IngestConfig:
 
 
 @dataclass
+class WalConfig:
+    # durable write path (core/wal.py group commit; docs/configuration.md
+    # "Durability"): 0 = strict — every commit group fsyncs before any
+    # caller returns, so an acked write survives a crash; > 0 = bounded-
+    # loss cadence in seconds — callers return after the buffered
+    # write+flush and a background syncer fsyncs on this interval, the
+    # crash loss window. Process-global (WAL files belong to the
+    # process, not to one in-process node).
+    sync_interval: float = 0.0
+
+
+@dataclass
 class MeshConfig:
     # mesh-local sharded execution (exec/meshgroup.py; docs/
     # configuration.md "Mesh execution"): nodes declaring the same
@@ -169,6 +181,7 @@ class Config:
     sched: SchedConfig = field(default_factory=SchedConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    wal: WalConfig = field(default_factory=WalConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     resize: ResizeConfig = field(default_factory=ResizeConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
@@ -247,6 +260,7 @@ class Config:
             ("sched", self.sched),
             ("hbm", self.hbm),
             ("ingest", self.ingest),
+            ("wal", self.wal),
             ("mesh", self.mesh),
             ("resize", self.resize),
             ("anti-entropy", self.anti_entropy),

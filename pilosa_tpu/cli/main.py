@@ -156,6 +156,14 @@ def _build_parser() -> argparse.ArgumentParser:
         "never on the CPU backend)",
     )
     sp.add_argument(
+        "--wal-sync-interval", type=float,
+        help="WAL group-commit fsync cadence, seconds: 0 = strict (every "
+        "commit group fsyncs before any caller returns), > 0 = bounded-"
+        "loss mode (callers return after the buffered write; a "
+        "background syncer fsyncs on this interval — the crash loss "
+        "window)",
+    )
+    sp.add_argument(
         "--mesh-group",
         help="ICI domain id of this node: nodes sharing a non-empty group "
         "execute mesh-local queries as one compiled sharded program "
@@ -272,6 +280,7 @@ _FLAG_KNOBS = {
     "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
     "hbm_pin_timeout": ("hbm", "pin_timeout"),
     "merge_device_threshold": ("ingest", "merge_device_threshold"),
+    "wal_sync_interval": ("wal", "sync_interval"),
     "mesh_group": ("mesh", "group"),
     "mesh_min_nodes": ("mesh", "min_nodes"),
     "mesh_ici_gbps": ("mesh", "ici_gbps"),
@@ -423,6 +432,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,
         merge_device_threshold=cfg.ingest.merge_device_threshold,
+        wal_sync_interval=cfg.wal.sync_interval,
         mesh_group=cfg.mesh.group,
         mesh_min_nodes=cfg.mesh.min_nodes,
         mesh_ici_gbps=cfg.mesh.ici_gbps,

@@ -18,6 +18,7 @@ import numpy as np
 
 from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.core import timeq
+from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.cache import (  # single source of truth: core/cache.py
     CACHE_TYPE_LRU,
     CACHE_TYPE_NONE,
@@ -349,41 +350,49 @@ class Field:
         # extra vector passes are measurable at bulk-ingest rates
         shards = cols >> np.uint64(SHARD_WIDTH_EXPONENT)
 
-        # standard view — one argsort groups the batch by shard
-        # (utils/arrays.group_slices; a mask per shard would rescan the
-        # whole batch n_shards times)
-        if not self.options.no_standard_view:
-            std = self._view_create(VIEW_STANDARD)
-            if not clear and self.options.type not in (
-                FIELD_TYPE_MUTEX,
-                FIELD_TYPE_BOOL,
-            ):
-                positions = (row_ids << np.uint64(SHARD_WIDTH_EXPONENT)) | (
-                    cols & np.uint64(SHARD_WIDTH - 1)
-                )
-                std.stage_bulk(shards, positions)
-            else:
-                for shard, sl in group_slices(shards):
-                    std.fragment(int(shard)).bulk_import(
-                        row_ids[sl], cols[sl], clear=clear
-                    )
-
-        # time views
-        if timestamps is not None and self.options.time_quantum:
-            by_view: Dict[str, List[int]] = {}
-            for i, ts in enumerate(timestamps):
-                if ts is None:
-                    continue
-                for vname in timeq.views_by_time(
-                    VIEW_STANDARD, ts, self.options.time_quantum
+        # ONE group-commit round per call, covering the standard view AND
+        # every time view it fans into (nested barriers — stage_bulk's,
+        # bulk_import's mutex path — fold into this outermost one): a
+        # timestamped import must not pay two sequential fsync rounds
+        with walmod.GROUP_COMMIT.barrier():
+            # standard view — one argsort groups the batch by shard
+            # (utils/arrays.group_slices; a mask per shard would rescan
+            # the whole batch n_shards times)
+            if not self.options.no_standard_view:
+                std = self._view_create(VIEW_STANDARD)
+                if not clear and self.options.type not in (
+                    FIELD_TYPE_MUTEX,
+                    FIELD_TYPE_BOOL,
                 ):
-                    by_view.setdefault(vname, []).append(i)
-            for vname, idxs in by_view.items():
-                v = self._view_create(vname)
-                idx = np.array(idxs)
-                for shard, sl in group_slices(shards[idx]):
-                    m = idx[sl]
-                    v.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
+                    positions = (row_ids << np.uint64(SHARD_WIDTH_EXPONENT)) | (
+                        cols & np.uint64(SHARD_WIDTH - 1)
+                    )
+                    std.stage_bulk(shards, positions)
+                else:
+                    # per-shard exact imports coalesce into the same
+                    # round (clears/mutex still fsync-strict, just not
+                    # once per shard)
+                    for shard, sl in group_slices(shards):
+                        std.fragment(int(shard)).bulk_import(
+                            row_ids[sl], cols[sl], clear=clear
+                        )
+
+            # time views
+            if timestamps is not None and self.options.time_quantum:
+                by_view: Dict[str, List[int]] = {}
+                for i, ts in enumerate(timestamps):
+                    if ts is None:
+                        continue
+                    for vname in timeq.views_by_time(
+                        VIEW_STANDARD, ts, self.options.time_quantum
+                    ):
+                        by_view.setdefault(vname, []).append(i)
+                for vname, idxs in by_view.items():
+                    v = self._view_create(vname)
+                    idx = np.array(idxs)
+                    for shard, sl in group_slices(shards[idx]):
+                        m = idx[sl]
+                        v.fragment(int(shard)).bulk_import(row_ids[m], cols[m], clear=clear)
 
     def import_row_words(self, row_id: int, shard: int, words: np.ndarray) -> int:
         """Word-level bulk union of one row of one shard (standard view);
@@ -413,10 +422,11 @@ class Field:
                 self.save_meta()
         v = self._view_create(self.bsi_view_name())
         shards = cols // SHARD_WIDTH
-        for shard, m in group_slices(shards):
-            v.fragment(int(shard)).import_values(
-                cols[m], base_values[m], self.options.bit_depth
-            )
+        with walmod.GROUP_COMMIT.barrier():
+            for shard, m in group_slices(shards):
+                v.fragment(int(shard)).import_values(
+                    cols[m], base_values[m], self.options.bit_depth
+                )
 
     # ------------------------------------------------------------------
     # reads

@@ -648,17 +648,25 @@ class Fragment:
         return cleared > 0
 
     def _set_bit_mutex(self, row_id: int, in_shard: int) -> bool:
-        with self._mu:
-            existing = self._mutex_map.get(in_shard)
-            if existing == row_id:
-                return False
-            to_clear = None
-            if existing is not None:
-                to_clear = np.array([existing * SHARD_WIDTH + in_shard], np.uint64)
-            to_set = np.array([row_id * SHARD_WIDTH + in_shard], np.uint64)
-            changed, _ = self.import_positions(to_set, to_clear)
-            self._mutex_map[in_shard] = row_id
-            return changed > 0
+        # the barrier defers import_positions' group-commit wait past the
+        # `with self._mu` below: a strict-mode fsync round must never run
+        # WITH the fragment lock held (it would serialize every reader
+        # and writer of this fragment behind disk latency and defeat the
+        # cross-caller coalescing)
+        with walmod.GROUP_COMMIT.barrier():
+            with self._mu:
+                existing = self._mutex_map.get(in_shard)
+                if existing == row_id:
+                    return False
+                to_clear = None
+                if existing is not None:
+                    to_clear = np.array(
+                        [existing * SHARD_WIDTH + in_shard], np.uint64
+                    )
+                to_set = np.array([row_id * SHARD_WIDTH + in_shard], np.uint64)
+                changed, _ = self.import_positions(to_set, to_clear)
+                self._mutex_map[in_shard] = row_id
+        return changed > 0
 
     def import_positions(
         self, to_set: Optional[np.ndarray], to_clear: Optional[np.ndarray]
@@ -668,7 +676,13 @@ class Fragment:
         pending ingest delta is merged first so the returned
         (n_set_changed, n_clear_changed) counts are exact. WAL framing is
         one append per import call: set+clear land as one write+flush
-        instead of interleaving two syscall round-trips with the apply."""
+        instead of interleaving two syscall round-trips with the apply.
+        Durability is a GROUP COMMIT: the fsync wait happens after the
+        fragment lock is released, so concurrent importers coalesce into
+        one commit round instead of serializing fsyncs behind each
+        other's locks (strict mode; `wal-sync-interval` > 0 acks on the
+        buffered write and defers the fsync to the background cadence)."""
+        tok = None
         with self._mu:
             self._check_write_block_locked()
             self._sync_locked()
@@ -678,7 +692,7 @@ class Fragment:
             if to_clear is not None and len(to_clear):
                 records.append((walmod.OP_CLEAR, to_clear))
             if records and self._wal is not None:
-                self._wal.append_many(records)
+                tok = self._wal.append_many(records)
             for op, positions in records:
                 self._capture_record(op, positions)
             n_set, n_clear = self._apply_positions(
@@ -688,7 +702,10 @@ class Fragment:
             self._op_n += n_set + n_clear
             if self._op_n > self.max_op_n:
                 self.snapshot()
-            return n_set, n_clear
+                tok = None  # snapshot fsynced + truncated: already durable
+        if tok is not None:
+            walmod.GROUP_COMMIT.wait_durable(tok)
+        return n_set, n_clear
 
     def stage_positions(self, positions: np.ndarray, *, notify: bool = True) -> int:
         """Bulk-ingest fast path: append SET positions to the fragment's
@@ -716,7 +733,7 @@ class Fragment:
             return 0
         with self._mu:
             self._check_write_block_locked()
-            self._wal_append(walmod.OP_SET, positions)
+            tok = self._wal_append(walmod.OP_SET, positions)
             self._capture_record(walmod.OP_SET, positions)
             if not self._pending:
                 self._staged_base_version = self.version
@@ -731,6 +748,13 @@ class Fragment:
                     self.on_mutate()
             if self._op_n > self.max_op_n:
                 self.snapshot()  # merges pending first (snapshot reads rows)
+                tok = None  # snapshot fsynced + truncated: already durable
+        if tok is not None:
+            # group-commit durability wait OUTSIDE the fragment lock:
+            # View.stage_bulk wraps its whole per-shard loop in a
+            # GROUP_COMMIT.barrier(), so a bulk import pays ONE commit
+            # round however many fragments it staged
+            walmod.GROUP_COMMIT.wait_durable(tok)
         return n
 
     def _sync_locked(self) -> None:
@@ -825,6 +849,10 @@ class Fragment:
         with self._mu:
             if gen != self._pending_gen:
                 return None
+            # crash-matrix injection point: a kill here leaves every
+            # staged WAL frame on disk (merges never truncate), so
+            # restart replay rebuilds the exact pre-install state
+            walmod.fault_point("merge.install", self.path or "")
             del self._pending[:n_parts]
             self._pending_n -= captured_n
             self._pending_gen += 1
@@ -1036,6 +1064,7 @@ class Fragment:
             raise ValueError(
                 f"import_row_words: want shape ({SHARD_WIDTH // 32},), got {words.shape}"
             )
+        tok = None
         with self._mu:
             self._check_write_block_locked()
             self._sync_locked()
@@ -1044,13 +1073,16 @@ class Fragment:
                 payload[0] = row_id
                 payload[1:] = words.view(np.uint64)
                 if self._wal is not None:
-                    self._wal.append(walmod.OP_ROW_WORDS, payload)
+                    tok = self._wal.append(walmod.OP_ROW_WORDS, payload)
                 self._capture_record(walmod.OP_ROW_WORDS, payload)
             added = self._apply_row_words(row_id, words)
             self._op_n += added
             if self._op_n > self.max_op_n:
                 self.snapshot()
-            return added
+                tok = None  # snapshot fsynced + truncated: already durable
+        if tok is not None:
+            walmod.GROUP_COMMIT.wait_durable(tok)
+        return added
 
     def _apply_row_words(self, row_id: int, words: np.ndarray) -> int:
         rb = self._rows.get(row_id)
@@ -1097,9 +1129,10 @@ class Fragment:
                             f"mutex vector disagrees at col {int(col)}"
                         )
 
-    def _wal_append(self, op: int, positions: np.ndarray) -> None:
+    def _wal_append(self, op: int, positions: np.ndarray) -> Optional[int]:
         if self._wal is not None:
-            self._wal.append(op, positions)
+            return self._wal.append(op, positions)
+        return None
 
     def _pos(self, row_id: int, col: int) -> int:
         if col >= SHARD_WIDTH:
@@ -1124,8 +1157,10 @@ class Fragment:
 
     def _bulk_import_mutex(self, row_ids: np.ndarray, cols: np.ndarray) -> int:
         """Mutex import: last write per column wins
-        (reference: fragment.go:2106 bulkImportMutex)."""
-        with self._mu:
+        (reference: fragment.go:2106 bulkImportMutex). The barrier
+        defers the group-commit wait until the fragment lock below is
+        released (see _set_bit_mutex)."""
+        with walmod.GROUP_COMMIT.barrier(), self._mu:
             # keep last occurrence per column
             _, last_idx = np.unique(cols[::-1], return_index=True)
             idx = len(cols) - 1 - last_idx
@@ -1530,22 +1565,24 @@ class Fragment:
         positions applied."""
         records = list(walmod.decode_records(data))
         n = 0
-        for op, positions in records:
-            if op == walmod.OP_ROW_WORDS:
-                words = np.ascontiguousarray(positions[1:]).view(np.uint32)
-                self.import_row_words(int(positions[0]), words)
-                # count set BITS, not payload words: `n` feeds
-                # resize.delta_positions and the job's deltas counter,
-                # documented as write positions — a whole-row union
-                # record would otherwise add 1 + words_per_row
-                # regardless of how many bits the row carries
-                n += int(np.unpackbits(words.view(np.uint8)).sum())
-            else:
-                if op == walmod.OP_SET:
-                    self.import_positions(positions, None)
+        # one group-commit round for the whole delta, not one per record
+        with walmod.GROUP_COMMIT.barrier():
+            for op, positions in records:
+                if op == walmod.OP_ROW_WORDS:
+                    words = np.ascontiguousarray(positions[1:]).view(np.uint32)
+                    self.import_row_words(int(positions[0]), words)
+                    # count set BITS, not payload words: `n` feeds
+                    # resize.delta_positions and the job's deltas counter,
+                    # documented as write positions — a whole-row union
+                    # record would otherwise add 1 + words_per_row
+                    # regardless of how many bits the row carries
+                    n += int(np.unpackbits(words.view(np.uint8)).sum())
                 else:
-                    self.import_positions(None, positions)
-                n += len(positions)
+                    if op == walmod.OP_SET:
+                        self.import_positions(positions, None)
+                    else:
+                        self.import_positions(None, positions)
+                    n += len(positions)
         return n
 
     def merge_from_bytes(self, data: bytes) -> int:
@@ -1568,10 +1605,13 @@ class Fragment:
                 f"fragment stream shard width {n_bits} != local {SHARD_WIDTH}"
             )
         added = 0
-        for row_id, rb in rows.items():
-            words = np.array(rb.to_words(), dtype=np.uint32)
-            if words.any():
-                added += self.import_row_words(row_id, words)
+        # one group-commit round for the whole merged stream, not one
+        # fsync wait per row
+        with walmod.GROUP_COMMIT.barrier():
+            for row_id, rb in rows.items():
+                words = np.array(rb.to_words(), dtype=np.uint32)
+                if words.any():
+                    added += self.import_row_words(row_id, words)
         return added
 
     def from_bytes(self, data: bytes) -> None:
@@ -1646,6 +1686,11 @@ class Fragment:
             # never a stale sidecar served as "provably complete" exact
             # counts (code-review r5 crash-window finding)
             self.flush_cache()
+            # crash-matrix injection point: snapshot durable (written,
+            # fsynced, dir-synced), WAL not yet truncated — a kill here
+            # must replay the full WAL over the fresh snapshot without
+            # double-applying (all ops are idempotent re-unions/clears)
+            walmod.fault_point("snapshot.pre_truncate", self.snap_path or "")
             if self._wal is not None:
                 self._wal.truncate()
             self._op_n = 0

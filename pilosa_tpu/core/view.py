@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from pilosa_tpu.utils.locks import TrackedRLock
+from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
@@ -398,12 +399,17 @@ class View:
         chunks = np.split(pos, bounds)
         tokens = []
         dirty = []
-        for shard, chunk in zip(uniq.tolist(), chunks):
-            frag = self.fragment(int(shard))
-            frag.stage_positions(chunk, notify=False)
-            tokens.append(frag._token)
-            tokens.append(frag._stack_token)
-            dirty.append(int(shard))
+        # one group-commit fsync round for the WHOLE batch at barrier
+        # exit: each stage_positions defers its durability wait, so a
+        # 100-shard import pays one commit round, not 100 — and
+        # concurrent import calls coalesce into each other's rounds
+        with walmod.GROUP_COMMIT.barrier():
+            for shard, chunk in zip(uniq.tolist(), chunks):
+                frag = self.fragment(int(shard))
+                frag.stage_positions(chunk, notify=False)
+                tokens.append(frag._token)
+                tokens.append(frag._stack_token)
+                dirty.append(int(shard))
         DEVICE_CACHE.invalidate_owners(tokens)
         # view-level stack entries: ad-hoc (uncovered) builds like the
         # TopN tally bundles are not version-keyed, so they drop NOW;

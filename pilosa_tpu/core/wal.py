@@ -19,20 +19,34 @@ Snapshot file (.snap):
 WAL file (.wal), per record:
     u32 magic 0x5054574C ("PTWL"), u8 op (0=set 1=clear), u32 n,
     u32 crc32(payload), payload = uint64[n] fragment positions
+
+Durability model (ISSUE 12): an append is a buffered write+flush under
+the writer's fd pin; the fsync that makes it crash-durable is a GROUP
+COMMIT (`WalGroupCommit`): concurrent appenders mark their writers
+dirty and `wait_durable` joins a leader/follower commit loop — the
+first waiter becomes the leader, fsyncs EVERY dirty WAL in one round,
+and releases the whole group, so N concurrent import calls pay ~one
+fsync round between them instead of one each. `sync-interval` > 0
+trades the wait away entirely: callers return after the buffered
+write and a background syncer fsyncs on that cadence — an honest,
+bounded crash-loss window (docs/configuration.md "Durability").
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import struct
+import threading
+import time
 import zlib
 from collections import OrderedDict
 from contextlib import contextmanager, nullcontext
-from typing import Dict, Iterator, Tuple
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
-from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 from pilosa_tpu.core.rowstore import RowBits
 
 SNAP_MAGIC = b"PTSNAP01"
@@ -44,6 +58,55 @@ OP_CLEAR = 1
 OP_ROW_WORDS = 2
 
 _REC_HDR = struct.Struct("<IBII")
+
+
+# ---------------------------------------------------------------------------
+# fault injection hook (server/faults.py FaultInjector installs itself
+# here via install_injector — core must not import the server layer).
+# Points: "wal.write" (before the framed bytes land), "wal.rollback"
+# (before a failed append truncates back — failing it too poisons the
+# writer), "wal.fsync" (per-file, inside a commit round), "wal.truncate"
+# (before the post-truncate fsync), "wal.commit.pre_fsync" /
+# "wal.commit.post_fsync"
+# (around a whole group-commit round), "snapshot.pre_truncate"
+# (fragment snapshot written, WAL not yet reset), "merge.install"
+# (merge-barrier delta about to park). The hook may raise (ENOSPC /
+# IO-error simulation), sleep, or SIGKILL the process (crash matrix).
+# ---------------------------------------------------------------------------
+
+_fault_hook: Optional[Callable[[str, str], None]] = None
+
+
+class ShortWriteFault(Exception):
+    """Raised by an injected fault hook to request a torn append: the
+    writer lands a PREFIX of the framed bytes (the kill-9-mid-write
+    artifact replay must tolerate), then fails the call with EIO."""
+
+
+def set_fault_hook(fn: Optional[Callable[[str, str], None]]) -> None:
+    global _fault_hook
+    _fault_hook = fn
+
+
+def fault_point(point: str, path: str = "") -> None:
+    hook = _fault_hook
+    if hook is not None:
+        hook(point, path)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-created (or renamed-into-place) entry
+    survives a crash — fsyncing the file itself does not persist its
+    directory entry. Best-effort: platforms without O_RDONLY directory
+    fds simply skip it."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def write_snapshot_stream(f, shard: int, n_bits: int, rows) -> None:
@@ -99,6 +162,10 @@ def write_snapshot(path: str, shard: int, n_bits: int, rows: Dict[int, RowBits])
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # the rename is only durable once the directory entry is: without
+    # this a crash can lose a just-written snapshot whose WAL was
+    # already truncated against it
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
 
 
 def read_snapshot(path: str) -> Tuple[int, int, Dict[int, RowBits]]:
@@ -153,6 +220,7 @@ class WalWriter:
         self._f = None
         self._pinned = 0  # guarded by _lru_mu; evictor skips pinned fds
         self._closed = False
+        self._poisoned = False  # un-rolled-back torn write: appends refuse
         with WalWriter._lru_mu:
             WalWriter._next_tok += 1
             self._tok = WalWriter._next_tok
@@ -166,6 +234,7 @@ class WalWriter:
         must never close an fd mid-write. Victim fds are closed OUTSIDE
         the lock so eviction I/O never stalls other writers."""
         to_close = []
+        sync_dir = None
         with WalWriter._lru_mu:
             if self._closed:
                 # LRU-evicted fds reopen transparently, but a CLOSED writer
@@ -173,7 +242,14 @@ class WalWriter:
                 # after fragment close/delete would silently recreate it)
                 raise ValueError(f"WalWriter for {self.path} is closed")
             if self._f is None:
+                created = not os.path.exists(self.path)
                 self._f = open(self.path, "ab")
+                if created:
+                    # a brand-new log's directory entry must survive a
+                    # crash: fsync the parent dir once at creation
+                    # (outside the lock, below — dir I/O must not stall
+                    # other writers)
+                    sync_dir = os.path.dirname(os.path.abspath(self.path))
             WalWriter._lru[self._tok] = self
             WalWriter._lru.move_to_end(self._tok)
             self._pinned += 1
@@ -192,6 +268,8 @@ class WalWriter:
                         victim._f = None
                     excess -= 1
             f = self._f
+        if sync_dir is not None:
+            _fsync_dir(sync_dir)
         for fh in to_close:
             fh.close()
         try:
@@ -200,14 +278,61 @@ class WalWriter:
             with WalWriter._lru_mu:
                 self._pinned -= 1
 
-    def append(self, op: int, positions: np.ndarray) -> None:
-        payload = np.asarray(positions, dtype=np.uint64).tobytes()
-        rec = _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))
-        with self._pin() as f:
-            f.write(rec + payload)
-            f.flush()
+    def _write_framed(self, data: bytes) -> Optional[int]:
+        """Buffered write+flush of framed record bytes under the fd pin,
+        then mark this writer dirty with the group committer. Returns
+        the commit token the caller hands to
+        `GROUP_COMMIT.wait_durable` once it is OUTSIDE any fragment
+        lock — the wait is where concurrent appenders coalesce into one
+        fsync round.
 
-    def append_many(self, records) -> None:
+        A failed or torn write (ENOSPC, injected short write) is ROLLED
+        BACK — the file truncates to the pre-append offset — so a later
+        successful append can never land BEYOND an unreplayable tear
+        (replay stops at the first bad record, which would silently
+        discard acked bytes written after it). If the rollback itself
+        fails, the writer POISONS: every subsequent append raises
+        instead of acking bytes replay would drop."""
+        if self._poisoned:
+            raise ValueError(
+                f"WAL {self.path} is poisoned: a torn write could not be "
+                "rolled back, so further appends would be unreplayable"
+            )
+        with self._pin() as f:
+            end0 = f.seek(0, os.SEEK_END)
+            try:
+                try:
+                    fault_point("wal.write", self.path)
+                except ShortWriteFault:
+                    f.write(data[: max(1, len(data) // 2)])
+                    f.flush()
+                    raise OSError(
+                        errno.EIO, "[injected] short write", self.path
+                    ) from None
+                f.write(data)
+                f.flush()
+            except Exception:
+                try:
+                    fault_point("wal.rollback", self.path)
+                    f.truncate(end0)
+                    f.seek(end0)
+                except Exception:  # noqa: BLE001 - poison, re-raise original
+                    self._poisoned = True
+                raise
+        return GROUP_COMMIT.mark_dirty(self)
+
+    def append(self, op: int, positions: np.ndarray) -> Optional[int]:
+        positions = np.asarray(positions, dtype=np.uint64)
+        if not len(positions):
+            # a zero-length record has nothing to replay; framing (and
+            # flushing) it only burned a syscall round-trip per empty
+            # batch and an empty-payload record on disk
+            return None
+        payload = positions.tobytes()
+        rec = _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))
+        return self._write_framed(rec + payload)
+
+    def append_many(self, records) -> Optional[int]:
         """Frame a batch of (op, positions) records and land them with ONE
         write + flush — an import call's set AND clear records hit the
         file together instead of interleaving two syscall round-trips
@@ -216,24 +341,383 @@ class WalWriter:
         or between records)."""
         data = encode_records(records)
         if not data:
+            return None
+        return self._write_framed(data)
+
+    def _fsync(self) -> None:
+        """fsync this writer's file — called by a group-commit round (the
+        leader or the background syncer), never by appenders directly.
+        Reopens transparently after an LRU fd eviction (fsync flushes
+        the inode's data regardless of which fd wrote it); a CLOSED
+        writer is a no-op — close() already synced its tail."""
+        try:
+            with self._pin() as f:
+                fault_point("wal.fsync", self.path)
+                os.fsync(f.fileno())
+        except ValueError:
             return
-        with self._pin() as f:
-            f.write(data)
-            f.flush()
 
     def truncate(self) -> None:
-        """Reset after a snapshot has absorbed all ops."""
+        """Reset after a snapshot has absorbed all ops. The truncation is
+        fsynced HERE, not deferred to a commit round: the caller is
+        about to trust the snapshot as the sole copy, and a crash must
+        not resurrect the pre-snapshot tail from a lazily-persisted
+        length change."""
         with self._pin() as f:
             f.truncate(0)
             f.seek(0)
+            fault_point("wal.truncate", self.path)
+            os.fsync(f.fileno())
+        # pending dirty marks cover bytes the truncation just erased;
+        # their content is durable via the snapshot, so drop the mark
+        # instead of paying a dead fsync in the next round
+        GROUP_COMMIT.forget(self)
 
     def close(self) -> None:
+        GROUP_COMMIT.forget(self)
         with WalWriter._lru_mu:
             self._closed = True
             WalWriter._lru.pop(self._tok, None)
-            if self._f is not None:
-                self._f.close()
-                self._f = None
+            f, self._f = self._f, None
+        # fsync UNCONDITIONALLY, not only when the dirty mark was still
+        # ours: an in-flight commit round may have already claimed the
+        # mark, and once _closed is set its _fsync() skips this writer —
+        # without the sync here that round would ack its waiters with
+        # this file's tail never durably on disk
+        if f is not None:
+            try:
+                f.flush()
+                os.fsync(f.fileno())
+            except OSError:
+                pass  # close is best-effort; open() replay re-checks
+            f.close()
+        elif os.path.exists(self.path):
+            # fd was LRU-evicted, possibly with an unsynced tail: reopen
+            # to sync (existence-guarded so a re-close after fragment
+            # deletion cannot resurrect the removed file)
+            try:
+                with open(self.path, "ab") as f2:
+                    os.fsync(f2.fileno())
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# group commit: the durability half of every append
+# ---------------------------------------------------------------------------
+
+
+class WalSyncError(OSError):
+    """A group-commit fsync round failed (ENOSPC, I/O error): EVERY
+    caller whose append rode that round gets this — the whole commit
+    group fails loudly, no caller is ever acked on a partial sync."""
+
+
+# Cumulative module counters (the bench and the coalescing acceptance
+# test read deltas of these; the same numbers publish as wal.* gauges
+# via NodeServer.publish_cache_gauges). Guarded by GROUP_COMMIT's lock.
+STATS = {"commits": 0, "commit_groups": 0, "fsyncs": 0, "sync_failures": 0}
+
+
+class WalGroupCommit:
+    """Leader/follower group commit across every open WAL writer (the
+    CountBatcher shape, applied to fsync): appenders buffer their framed
+    records (`WalWriter._write_framed` marks the writer dirty and hands
+    back a token), then `wait_durable(token)` — called OUTSIDE any
+    fragment lock — either joins an in-flight round or becomes the
+    leader that fsyncs every dirty file and releases the whole group.
+
+    Modes (`sync-interval`, three-way-synced `[wal]` knob):
+    - 0 (strict): every commit group fsyncs before any caller returns —
+      an acked write is durable.
+    - > 0 (bounded loss): `wait_durable` returns immediately; a
+      background syncer fsyncs on the cadence. A crash loses at most
+      the last `sync-interval` seconds of ACKED writes (the buffered
+      bytes are in the OS page cache, so only a machine/kernel crash
+      loses them — a process kill does not).
+
+    `barrier()` coalesces a bulk call's many per-fragment waits into
+    exactly one round at exit (thread-local deferral): a 100-shard
+    import pays one group fsync, not 100.
+
+    Process-global, like DEVICE_CACHE: WAL files belong to the process,
+    not to one in-process NodeServer."""
+
+    def __init__(self):
+        self._mu = TrackedLock("wal.commit_mu")
+        self._cv = TrackedCondition(self._mu, name="wal.commit_cv")
+        self._dirty: "OrderedDict[int, WalWriter]" = OrderedDict()
+        self._seq = 0  # tokens handed out (appends marked dirty)
+        self._done = 0  # highest token durably resolved by a round
+        self._leading = False  # exactly one round in flight
+        # tokens in (_fail_lo, _fail_seq] rode a FAILED round and raise;
+        # tokens at or below _fail_lo were durably resolved by earlier
+        # successful rounds and must never be failed retroactively
+        self._fail_lo = 0
+        self._fail_seq = 0
+        self._fail_exc: Optional[BaseException] = None
+        self._sync_interval = 0.0
+        self._syncer: Optional[threading.Thread] = None
+        self._syncer_wake = threading.Event()
+        self._oldest_mark: Optional[float] = None  # lag gauge (interval mode)
+        self._defer = threading.local()
+        self.stats = None  # optional StatsClient (NodeServer wires its own)
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, sync_interval: Optional[float] = None) -> None:
+        """Install the server's [wal] knobs. Switching interval -> strict
+        flushes outstanding buffered appends first, so the strict
+        contract holds from this call on."""
+        if sync_interval is None:
+            return
+        with self._mu:
+            old = self._sync_interval
+            self._sync_interval = max(0.0, float(sync_interval))
+            new = self._sync_interval
+        if new > 0:
+            self._ensure_syncer()
+            self._syncer_wake.set()
+        elif old > 0:
+            self._syncer_wake.set()  # syncer sees 0 and exits
+            self.flush()
+
+    def sync_interval(self) -> float:
+        with self._mu:
+            return self._sync_interval
+
+    # -- append-side API ---------------------------------------------------
+
+    def mark_dirty(self, writer: "WalWriter") -> int:
+        with self._mu:
+            self._dirty[writer._tok] = writer
+            self._dirty.move_to_end(writer._tok)
+            self._seq += 1
+            if self._oldest_mark is None:
+                self._oldest_mark = time.monotonic()
+            STATS["commits"] += 1
+            token = self._seq
+            interval = self._sync_interval
+        if interval > 0:
+            self._ensure_syncer()
+        return token
+
+    def forget(self, writer: "WalWriter") -> bool:
+        """Drop a writer's dirty mark (truncate fsynced it explicitly, or
+        close is about to). Returns whether it was dirty. Waiters whose
+        tokens covered this writer still resolve with the next round —
+        their bytes are durable through the explicit fsync."""
+        with self._mu:
+            return self._dirty.pop(writer._tok, None) is not None
+
+    def wait_durable(self, token: Optional[int] = None) -> None:
+        """Block until `token` (None = everything appended so far) is
+        durable — or return immediately in bounded-loss mode. Inside a
+        `barrier()` the wait is deferred to the barrier exit."""
+        if getattr(self._defer, "depth", 0):
+            if token is None:
+                with self._mu:
+                    token = self._seq
+            self._defer.token = max(getattr(self._defer, "token", 0), token)
+            return
+        with self._mu:
+            if token is None:
+                token = self._seq
+            if token <= 0:
+                return
+            if self._sync_interval > 0:
+                # bounded-loss cadence: the caller is acked on the
+                # buffered write; the syncer fsyncs within the interval.
+                # UNLESS the cadence is known-broken: acking while every
+                # background round fails (ENOSPC) would make the
+                # documented loss window unbounded and invisible
+                if self._fail_exc is not None:
+                    raise WalSyncError(
+                        "WAL background sync is failing; refusing to ack "
+                        f"writes on a broken cadence: {self._fail_exc}"
+                    ) from self._fail_exc
+                return
+        self._wait_strict(token)
+
+    @contextmanager
+    def barrier(self):
+        """Coalesce every wait_durable on this thread into ONE group
+        commit at exit (bulk imports: N fragments, one fsync round).
+        Nested barriers fold into the outermost."""
+        d = getattr(self._defer, "depth", 0)
+        self._defer.depth = d + 1
+        try:
+            yield
+        finally:
+            self._defer.depth = d
+            if d == 0:
+                token = getattr(self._defer, "token", 0)
+                self._defer.token = 0
+                if token:
+                    self.wait_durable(token)
+
+    def flush(self) -> None:
+        """Force one commit round covering everything outstanding —
+        including dirty bytes RETAINED by a failed round (shutdown,
+        tests, strict-mode switchover, post-ENOSPC retry). Ignores the
+        interval-mode early return."""
+        with self._mu:
+            while self._leading:
+                self._cv.wait()
+            if not self._dirty:
+                return
+            self._leading = True
+        self._lead_round()
+        with self._mu:
+            self._check_failed_locked(self._done)
+
+    # -- the commit loop ---------------------------------------------------
+
+    def _wait_strict(self, token: int) -> None:
+        with self._mu:
+            while True:
+                if self._done >= token:
+                    # resolved: durably synced, or part of a failed
+                    # round whose failure has not been retried away yet
+                    self._check_failed_locked(token)
+                    return
+                if not self._leading:
+                    self._leading = True
+                    break
+                self._cv.wait()
+        self._lead_round()
+        with self._mu:
+            self._check_failed_locked(token)
+
+    def _check_failed_locked(self, token: int) -> None:
+        # only tokens inside the failed rounds' range raise: a token
+        # already durably resolved by an EARLIER successful round must
+        # not be failed retroactively (its write is on disk and applied)
+        if (
+            self._fail_exc is not None
+            and self._fail_lo < token <= self._fail_seq
+        ):
+            raise WalSyncError(
+                f"WAL group commit failed: {self._fail_exc}"
+            ) from self._fail_exc
+
+    def _lead_round(self) -> None:
+        try:
+            self._sync_round()
+        finally:
+            with self._mu:
+                self._leading = False
+                self._cv.notify_all()
+
+    def _sync_round(self) -> None:
+        with self._mu:
+            batch = list(self._dirty.values())
+            self._dirty.clear()
+            top = self._seq
+            prev_done = self._done
+            oldest = self._oldest_mark
+            self._oldest_mark = None
+            stats = self.stats
+        fault_point("wal.commit.pre_fsync")
+        err: Optional[BaseException] = None
+        n_synced = 0
+        for w in batch:
+            try:
+                w._fsync()
+                n_synced += 1
+            except Exception as e:  # noqa: BLE001 - fails the whole group
+                err = e
+        fault_point("wal.commit.post_fsync")
+        group = top - prev_done
+        with self._mu:
+            self._done = top
+            if err is None:
+                # a successful round re-synced any bytes a FAILED earlier
+                # round retained as dirty: tokens still parked on that
+                # failure are durable now, so the failure state clears —
+                # only waiters who observed it before the retry raised
+                # (correct: their durability genuinely had not happened)
+                self._fail_exc = None
+                self._fail_lo = 0
+                self._fail_seq = 0
+            if err is not None:
+                # the WHOLE group fails loudly: every waiter with a
+                # token in this round raises, and unsynced writers stay
+                # dirty so a later round retries their bytes. Back-to-
+                # back failures WIDEN the range (min) — retained bytes
+                # from the first failure are still unsynced, so their
+                # tokens must keep raising until a round succeeds
+                self._fail_lo = (
+                    min(self._fail_lo, prev_done)
+                    if self._fail_exc is not None
+                    else prev_done
+                )
+                self._fail_seq = top
+                self._fail_exc = err
+                STATS["sync_failures"] += 1
+                for w in batch:
+                    if not w._closed:
+                        self._dirty.setdefault(w._tok, w)
+                if self._dirty and self._oldest_mark is None:
+                    self._oldest_mark = oldest
+            if batch:
+                STATS["commit_groups"] += 1
+                STATS["fsyncs"] += n_synced
+        # emissions OUTSIDE the lock: a statsd push under commit_mu
+        # would serialize every appender behind the network. Only the
+        # per-round distributions emit here — the cumulative
+        # commit_groups/fsyncs totals publish as gauges at scrape time
+        # (NodeServer.publish_cache_gauges), so each renders as exactly
+        # one series
+        if stats is not None and batch:
+            stats.histogram("wal.group_size", float(max(group, 1)))
+            if oldest is not None:
+                stats.timing("wal.sync_lag_ms", time.monotonic() - oldest)
+
+    # -- background syncer (interval mode) ---------------------------------
+
+    def _ensure_syncer(self) -> None:
+        with self._mu:
+            if self._syncer is not None and self._syncer.is_alive():
+                return
+            t = threading.Thread(
+                target=self._syncer_loop,
+                name="pilosa-tpu-wal-sync",
+                daemon=True,
+            )
+            self._syncer = t
+            # started under the lock: a concurrent caller checking
+            # is_alive() on a created-but-unstarted thread would spawn a
+            # duplicate syncer (two competing fsync cadences, one orphan)
+            t.start()
+
+    def _syncer_loop(self) -> None:
+        while True:
+            with self._mu:
+                interval = self._sync_interval
+            if interval <= 0:
+                return
+            self._syncer_wake.wait(interval)
+            self._syncer_wake.clear()
+            with self._mu:
+                if self._sync_interval <= 0:
+                    return
+                if self._leading or not self._dirty:
+                    continue
+                self._leading = True
+            try:
+                self._lead_round()
+            except Exception:  # noqa: BLE001 - keep the cadence alive
+                pass
+
+
+GROUP_COMMIT = WalGroupCommit()
+
+
+def stats_snapshot() -> Dict[str, int]:
+    """wal.* gauge values (NodeServer.publish_cache_gauges)."""
+    with GROUP_COMMIT._mu:
+        return dict(STATS)
 
 
 def encode_records(records) -> bytes:
@@ -241,9 +725,13 @@ def encode_records(records) -> bytes:
     into one byte string. This is also the WIRE format live-resize delta
     shipping uses (core/fragment.py drain_capture -> apply_transfer_records):
     both ends share the on-disk log's CRC framing, so there is exactly one
-    record codec to keep correct."""
+    record codec to keep correct. Zero-length records are skipped — they
+    carry nothing to replay (or to apply on the wire) and an empty SET
+    batch must not cost a framed record."""
     bufs = []
     for op, positions in records:
+        if not len(positions):
+            continue
         payload = np.asarray(positions, dtype=np.uint64).tobytes()
         bufs.append(
             _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))

@@ -552,10 +552,13 @@ class API:
     ) -> dict:
         """Bulk set-bit import; translates keys, groups bits by shard with
         ONE argsort (timestamps ride the same permutation — no per-shard
-        batch rescans) and ships every shard batch to its owner nodes
-        concurrently on the bounded import pool (api.go:963-996). The
-        local share applies as ONE batched field import while the replica
-        RPCs are in flight. Returns an application summary {"applied",
+        batch rescans) and ships the shard batches to their owner nodes
+        BATCHED PER NODE on the bounded import pool (api.go:963-996): the
+        grouping/slicing/encoding all run on pool threads, and each peer
+        receives one frame carrying every shard it owns from this call
+        (fewer, larger RPCs over the retry/breaker plane). The local
+        share applies as ONE batched field import while the node frames
+        are in flight. Returns an application summary {"applied",
         "expected", "errors"} so callers can detect reduced durability
         when a replica was down (r2 advisor: partial application must be
         visible, not silent)."""
@@ -603,16 +606,28 @@ class API:
                     )
                     idx.track_columns(cols[sel])
 
-                def remote_submit(n, g):
-                    return self.server.import_pool.submit(
-                        self.server.client.import_bits,
-                        n.uri, idx.name, f.name, g[0],
-                        rows[g[1]], cols[g[1]], clear,
-                        timestamps=g[2],
+                def ship_node(n, gs):
+                    # ONE frame per node, sliced + encoded on the pool
+                    # thread: cols are absolute, so the receiver's
+                    # local-only apply re-groups the multi-shard frame
+                    # itself
+                    sel = (
+                        gs[0][1]
+                        if len(gs) == 1
+                        else np.concatenate([g[1] for g in gs])
+                    )
+                    ts = (
+                        [t for g in gs for t in g[2]]
+                        if timestamps is not None
+                        else None
+                    )
+                    self.server.client.import_bits(
+                        n.uri, idx.name, f.name, gs[0][0],
+                        rows[sel], cols[sel], clear, timestamps=ts,
                     )
 
                 shard_list, failed, apply_s, route_s = self._import_routed(
-                    idx, shards, timestamps, local_apply, remote_submit,
+                    idx, shards, timestamps, local_apply, ship_node,
                     "import", summary,
                 )
             stats.count("ingest.bits", int(len(cols)))
@@ -633,28 +648,43 @@ class API:
             return summary
 
     def _import_routed(
-        self, idx, shards, timestamps, local_apply, remote_submit, kind,
+        self, idx, shards, timestamps, local_apply, ship_node, kind,
         summary,
     ):
         """Multi-node shard routing shared by import_bits and
-        import_values: one-sort shard grouping, the remote legs shipped
-        concurrently on the bounded import pool (each RPC rides the PR 1
-        retry/breaker plane inside the client call `remote_submit`
-        makes), the local share applied as ONE batch (`local_apply`)
-        while they fly. Fills `summary` with the partial-application
-        accounting — a down replica is an error entry plus pending-repair
-        debt; a shard with NO live owner lands in `failed` for the caller
-        to raise AFTER announcing what did apply. Returns
-        (applied_shard_list, failed[(shard, errors)], apply_s, route_s)."""
+        import_values — the free-threaded ingest path (ISSUE 12): the
+        one-sort shard grouping (argsort + split; numpy releases the
+        GIL for the sort) runs on the bounded import pool instead of
+        the serving thread, and replica legs are BATCHED PER NODE —
+        every shard group bound for one peer ships as ONE frame over
+        the PR 1 retry/breaker plane (`ship_node`, executed on the
+        pool, does its own slicing and wire encoding there too). A
+        replica hiccup therefore costs one bounded retry cycle per
+        node instead of one per shard, and degrades to per-shard
+        pending-repair debt rather than stalling the leader's commit
+        group. The local share applies as ONE batch (`local_apply`)
+        while the node frames fly. Fills `summary` with the
+        partial-application accounting — a down replica is an error
+        entry per shard plus pending-repair debt; a shard with NO live
+        owner lands in `failed` for the caller to raise AFTER
+        announcing what did apply. Returns (applied_shard_list,
+        failed[(shard, errors)], apply_s, route_s)."""
         import time as _time
 
         from pilosa_tpu.server.client import ClientError
 
-        groups = _group_by_shard(shards, timestamps)
+        pool = self.server.import_pool
+        t_route0 = _time.perf_counter()
+        # the grouping rides its own small pool: import_pool's workers
+        # can all be parked in a flapping replica's retry cycle, and the
+        # argsort queued behind them would stall healthy local ingest
+        groups = self.server.route_pool.submit(
+            _group_by_shard, shards, timestamps
+        ).result()
         applied = {g[0]: 0 for g in groups}
         shard_errors = {g[0]: [] for g in groups}
         local_groups = []
-        remote_jobs = []
+        by_node = {}
         for g in groups:
             owners = self.cluster.shard_nodes(idx.name, g[0])
             summary["expected"] += len(owners)
@@ -662,33 +692,38 @@ class API:
                 if n.id == self.server.node.id:
                     local_groups.append(g)
                 else:
-                    remote_jobs.append((n, g))
-        t_route0 = _time.perf_counter()
-        futures = [(n, g, remote_submit(n, g)) for n, g in remote_jobs]
+                    by_node.setdefault(n.id, (n, []))[1].append(g)
+        futures = [
+            (n, gs, pool.submit(ship_node, n, gs))
+            for n, gs in by_node.values()
+        ]
         t0 = _time.perf_counter()
         if local_groups:
             local_apply(np.concatenate([g[1] for g in local_groups]), local_groups)
             for g in local_groups:
                 applied[g[0]] += 1
         apply_s = _time.perf_counter() - t0
-        for n, g, fut in futures:
+        for n, gs, fut in futures:
             try:
                 fut.result()
-                applied[g[0]] += 1
+                for g in gs:
+                    applied[g[0]] += 1
             except ClientError as e:
-                shard_errors[g[0]].append(f"{n.id}: {e}")
                 # replica fan-out is best-effort per owner: a down replica
                 # is repaired by anti-entropy after it returns (the
                 # reference likewise keeps accepting writes in DEGRADED,
                 # api.go:104). Ledger entries only at replica_n>1: with no
                 # second copy AE has nothing to repair from, so an entry
-                # could never drain (the summary carries the error).
-                if self.cluster.replica_n > 1:
-                    self.holder.record_pending_repair(idx.name, g[0], n.id)
-                    self.server.stats.count("write_replica_dropped", 1)
+                # could never drain (the summary carries the error). One
+                # failed node frame books debt for EVERY shard it carried.
+                for g in gs:
+                    shard_errors[g[0]].append(f"{n.id}: {e}")
+                    if self.cluster.replica_n > 1:
+                        self.holder.record_pending_repair(idx.name, g[0], n.id)
+                        self.server.stats.count("write_replica_dropped", 1)
                 self.server.logger(
-                    f"{kind} shard {g[0]} to replica {n.id} failed "
-                    f"(anti-entropy will repair): {e}"
+                    f"{kind} shards {sorted(g[0] for g in gs)} to replica "
+                    f"{n.id} failed (anti-entropy will repair): {e}"
                 )
         route_s = _time.perf_counter() - t_route0
         failed = []
@@ -737,15 +772,18 @@ class API:
                     f.import_values(cols[sel], values[sel])
                     idx.track_columns(cols[sel])
 
-                def remote_submit(n, g):
-                    return self.server.import_pool.submit(
-                        self.server.client.import_values,
-                        n.uri, index, field, g[0],
-                        cols[g[1]], values[g[1]],
+                def ship_node(n, gs):
+                    sel = (
+                        gs[0][1]
+                        if len(gs) == 1
+                        else np.concatenate([g[1] for g in gs])
+                    )
+                    self.server.client.import_values(
+                        n.uri, index, field, gs[0][0], cols[sel], values[sel]
                     )
 
                 shard_list, failed, apply_s, route_s = self._import_routed(
-                    idx, shards, None, local_apply, remote_submit,
+                    idx, shards, None, local_apply, ship_node,
                     "import-value", summary,
                 )
             stats.count("ingest.bits", int(len(cols)))

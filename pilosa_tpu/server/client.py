@@ -558,6 +558,10 @@ class InternalClient:
         clear: bool = False,
         timestamps: Optional[Sequence[Optional[str]]] = None,
     ) -> None:
+        """Ship an import frame to one owner node. `cols` are absolute,
+        so ONE frame may carry bits for MANY shards (the per-node
+        batched replica ship): the receiver re-groups by shard in its
+        local-only apply; `shard` is informational."""
         if timestamps is None:
             # binary data plane: raw u64 arrays instead of JSON number
             # lists (the reference ships protobuf here, http/client.go:319)

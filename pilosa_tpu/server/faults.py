@@ -26,13 +26,17 @@ All clocks/sleeps are injectable so the unit tests need no real sleeps.
 
 from __future__ import annotations
 
+import errno
 import io
+import os
 import random
+import signal
 import time
 import urllib.error
 from typing import Callable, Dict, List, Optional, Tuple
 
 from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.core import wal as walmod
 
 # breaker states (reference naming: closed = healthy, open = fast-fail,
 # half-open = single probe allowed after the cooldown)
@@ -315,15 +319,28 @@ class InjectedTimeout(InjectedFault, TimeoutError):
 
 
 class _Rule:
-    __slots__ = ("kind", "uri", "path", "prob", "times", "delay")
+    __slots__ = ("kind", "uri", "path", "prob", "times", "delay", "skip")
 
-    def __init__(self, kind, uri, path, prob, times, delay):
+    def __init__(self, kind, uri, path, prob, times, delay, skip=0):
         self.kind = kind
         self.uri = uri
         self.path = path
         self.prob = prob
         self.times = times  # None = unlimited; else remaining match count
         self.delay = delay
+        self.skip = skip  # matches ignored before the rule starts firing
+
+
+class _WalRule:
+    __slots__ = ("kind", "point", "path", "times", "delay", "skip")
+
+    def __init__(self, kind, point, path, times, delay, skip):
+        self.kind = kind
+        self.point = point  # prefix match on the fault point name
+        self.path = path  # substring match on the file path
+        self.times = times
+        self.delay = delay
+        self.skip = skip
 
 
 class FaultInjector:
@@ -334,10 +351,27 @@ class FaultInjector:
 
     Kinds: "refuse" (connection refused without dialing), "timeout",
     "http500", "slow" (sleep `delay` then proceed), "partition" (alias
-    of an unlimited refuse; `heal()` lifts it). Install per-client via
+    of an unlimited refuse; `heal()` lifts it), "kill" (SIGKILL this
+    process on the match — the crash-kill matrix's deterministic
+    mid-request death). Install per-client via
     `client.fault_injector = inj` or process-wide via
     `faults.install_injector(inj)` (tests MUST uninstall — conftest
     fails any test that leaks the global).
+
+    Durable-write-path chaos (ISSUE 12): `add_wal_rule` targets the
+    WAL fault points core/wal.py threads through the group-commit
+    loop, fragment snapshots, and the merge-barrier install ("wal.write",
+    "wal.rollback", "wal.fsync", "wal.truncate", "wal.commit.pre_fsync",
+    "wal.commit.post_fsync", "snapshot.pre_truncate", "merge.install";
+    `point` is a prefix match). Kinds: "enospc" (OSError ENOSPC — an
+    ENOSPC during a commit round fails the WHOLE group loudly, no
+    caller is acked), "io-error" (EIO), "short-write" (a prefix of the
+    framed bytes lands, then EIO — the writer rolls the tear back, or
+    poisons itself if the rollback fails too),
+    "slow" (sleep `delay`), "kill" (SIGKILL at the exact point —
+    pre-fsync, post-fsync-pre-ack, pre-truncate, pre-install). The
+    process-wide install (`install_injector`) wires these hooks into
+    core/wal.py; per-client injectors see HTTP traffic only.
 
     Streaming-resize chaos: every transfer leg and the cutover ride
     InternalClient._do, so path-prefix rules target them directly —
@@ -356,6 +390,7 @@ class FaultInjector:
         self._rng = random.Random(seed)
         self._sleep = sleep
         self._rules: List[_Rule] = []
+        self._wal_rules: List[_WalRule] = []
         self.injected: Dict[str, int] = {}
 
     # -- rule management ---------------------------------------------------
@@ -368,12 +403,38 @@ class FaultInjector:
         prob: float = 1.0,
         times: Optional[int] = None,
         delay: float = 0.0,
+        skip: int = 0,
     ) -> "FaultInjector":
-        if kind not in ("refuse", "timeout", "http500", "slow", "partition"):
+        if kind not in ("refuse", "timeout", "http500", "slow", "partition", "kill"):
             raise ValueError(f"unknown fault kind {kind!r}")
         with self._mu:
             self._rules.append(
-                _Rule(kind, uri.rstrip("/") if uri else None, path, prob, times, delay)
+                _Rule(
+                    kind, uri.rstrip("/") if uri else None, path, prob,
+                    times, delay, skip,
+                )
+            )
+        return self
+
+    def add_wal_rule(
+        self,
+        kind: str,
+        point: Optional[str] = None,
+        path: Optional[str] = None,
+        times: Optional[int] = None,
+        delay: float = 0.0,
+        skip: int = 0,
+    ) -> "FaultInjector":
+        """Arm a durable-write-path fault: `point` prefix-matches the WAL
+        fault point name, `path` substring-matches the file, `skip`
+        ignores the first N matches (fire on the K+1th occurrence — the
+        crash matrix's 'kill during the 3rd commit group'), `times`
+        bounds how often it fires after that."""
+        if kind not in ("enospc", "io-error", "short-write", "slow", "kill"):
+            raise ValueError(f"unknown WAL fault kind {kind!r}")
+        with self._mu:
+            self._wal_rules.append(
+                _WalRule(kind, point, path, times, delay, skip)
             )
         return self
 
@@ -383,10 +444,13 @@ class FaultInjector:
         return self.add_rule("partition", uri=uri)
 
     def heal(self, uri: Optional[str] = None) -> None:
-        """Remove partitions for `uri` (or all rules when uri is None)."""
+        """Remove partitions for `uri` (or ALL rules — HTTP and WAL —
+        when uri is None: the disk has space again, the network is
+        whole)."""
         with self._mu:
             if uri is None:
                 self._rules = []
+                self._wal_rules = []
                 return
             key = uri.rstrip("/")
             self._rules = [
@@ -419,6 +483,9 @@ class FaultInjector:
                     continue
                 if r.times is not None and r.times <= 0:
                     continue
+                if r.skip > 0:
+                    r.skip -= 1
+                    continue
                 if r.prob < 1.0 and self._rng.random() >= r.prob:
                     continue
                 if r.times is not None:
@@ -434,6 +501,10 @@ class FaultInjector:
         if fire is None:
             return
         kind, _ = fire
+        if kind == "kill":
+            # crash matrix: die exactly where a real crash would —
+            # mid-request, no cleanup, no flush
+            os.kill(os.getpid(), signal.SIGKILL)
         if kind in ("refuse", "partition"):
             raise urllib.error.URLError(
                 InjectedRefusal(f"[injected] connection refused: {url}")
@@ -445,6 +516,46 @@ class FaultInjector:
                 url, 500, "[injected] internal server error", None,
                 io.BytesIO(b"injected fault"),
             )
+
+    def on_wal(self, point: str, path: str = "") -> None:
+        """The core/wal.py fault hook (installed process-wide by
+        `install_injector`): called at every durable-write-path fault
+        point. Raises the injected failure, sleeps, or SIGKILLs."""
+        delay = 0.0
+        fire: Optional[str] = None
+        with self._mu:
+            for r in self._wal_rules:
+                if r.point is not None and not point.startswith(r.point):
+                    continue
+                if r.path is not None and r.path not in path:
+                    continue
+                if r.times is not None and r.times <= 0:
+                    continue
+                if r.skip > 0:
+                    r.skip -= 1
+                    continue
+                if r.times is not None:
+                    r.times -= 1
+                self.injected[r.kind] = self.injected.get(r.kind, 0) + 1
+                if r.kind == "slow":
+                    delay = max(delay, r.delay)
+                    continue
+                fire = r.kind
+                break
+        if delay > 0:
+            self._sleep(delay)
+        if fire is None:
+            return
+        if fire == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if fire == "enospc":
+            raise OSError(
+                errno.ENOSPC, f"[injected] no space left on device ({point})", path
+            )
+        if fire == "io-error":
+            raise OSError(errno.EIO, f"[injected] I/O error ({point})", path)
+        if fire == "short-write":
+            raise walmod.ShortWriteFault(f"[injected] short write ({point})")
 
 
 # ---------------------------------------------------------------------------
@@ -460,12 +571,17 @@ def install_injector(inj: FaultInjector) -> None:
     global _global_injector
     with _global_mu:
         _global_injector = inj
+    # the process-wide install also arms the durable-write-path hooks
+    # (core/wal.py cannot import the server layer, so the injector is
+    # pushed down rather than pulled up)
+    walmod.set_fault_hook(inj.on_wal)
 
 
 def uninstall_injector() -> None:
     global _global_injector
     with _global_mu:
         _global_injector = None
+    walmod.set_fault_hook(None)
 
 
 def global_injector() -> Optional[FaultInjector]:

@@ -94,6 +94,7 @@ class NodeServer:
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
         merge_device_threshold: Optional[int] = None,  # None = backend AUTO
+        wal_sync_interval: float = 0.0,  # 0 strict; >0 bounded-loss cadence, s
         mesh_group: str = "",  # ICI domain id; "" = no mesh-local execution
         mesh_min_nodes: int = 2,  # group-local owners before the fold engages; 0 off
         mesh_ici_gbps: float = 100.0,  # intra-group collective link (cost model)
@@ -217,6 +218,15 @@ class NodeServer:
         from pilosa_tpu.core import merge as merge_mod
 
         merge_mod.configure(device_threshold=merge_device_threshold)
+        # durable write path (core/wal.py): group-commit fsync cadence.
+        # Process-global for the same reason — WAL files belong to the
+        # process, and all in-process nodes share ONE commit loop (so
+        # concurrent imports coalesce across them); the last-constructed
+        # server's knob and stats sink win.
+        from pilosa_tpu.core import wal as wal_mod
+
+        wal_mod.GROUP_COMMIT.configure(sync_interval=wal_sync_interval)
+        wal_mod.GROUP_COMMIT.stats = self.stats
         self.prefetcher = None
         if hbm_prefetch_depth > 0 and self.scheduler is not None:
             self.prefetcher = hbmmod.Prefetcher(
@@ -230,6 +240,11 @@ class NodeServer:
         self.import_concurrency = max(1, int(import_concurrency))
         self._import_pool = None
         self._import_pool_mu = TrackedLock("node.import_pool_mu")
+        # separate SMALL pool for the routing step (argsort/split): the
+        # import pool's workers can all be parked in replica-ship retry
+        # cycles when a peer is flapping, and grouping queued behind
+        # them would stall healthy LOCAL ingest behind a sick replica
+        self._route_pool = None
         # streaming-resize plane: source-side write captures (keyed by
         # (job, index, field, view, shard), leased) and the destination-
         # side per-job transfer ledger used for crash resume and abort
@@ -574,6 +589,16 @@ class NodeServer:
         self.stats.gauge("ingest.merge_ms", msnap["barrier_ms"])
         self.stats.gauge("ingest.merge_batches", msnap["batches"])
         self.stats.gauge("ingest.merge_device", msnap["device"])
+        # durable write path (core/wal.py group commit): cumulative
+        # commit rounds and file fsyncs — the coalescing ratio operators
+        # watch is fsyncs vs import calls (wal.group_size holds the
+        # per-round histogram, emitted by the commit loop itself)
+        from pilosa_tpu.core import wal as wal_mod
+
+        wsnap = wal_mod.stats_snapshot()
+        self.stats.gauge("wal.commit_groups", wsnap["commit_groups"])
+        self.stats.gauge("wal.fsyncs", wsnap["fsyncs"])
+        self.stats.gauge("wal.sync_failures", wsnap["sync_failures"])
         # mesh-group execution (exec/meshgroup.py): live registered group
         # size plus cumulative shards served mesh-locally and bytes moved
         # by in-program collectives (the observability contract of the
@@ -697,8 +722,32 @@ class NodeServer:
                 )
             return self._import_pool
 
+    @property
+    def route_pool(self):
+        """Lazily created pool for the import ROUTING step (the argsort/
+        split that moved off the serving thread, ISSUE 12). Deliberately
+        separate from import_pool: routing must never queue behind
+        replica-ship frames stuck in a sick peer's retry cycle."""
+        with self._import_pool_mu:
+            if self._route_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._route_pool = ThreadPoolExecutor(
+                    max_workers=min(4, self.import_concurrency),
+                    thread_name_prefix="pilosa-tpu-route",
+                )
+            return self._route_pool
+
     def stop(self) -> None:
         self._closing.set()
+        # sync any buffered WAL tail (bounded-loss mode) before teardown:
+        # a clean stop must not leave the loss window open
+        try:
+            from pilosa_tpu.core import wal as wal_mod
+
+            wal_mod.GROUP_COMMIT.flush()
+        except OSError as e:
+            self.logger(f"wal flush on stop failed: {e}")
         if self.mesh_group_name:
             from pilosa_tpu.parallel.mesh import unregister_group_member
 
@@ -706,8 +755,11 @@ class NodeServer:
         self.profiler.close()  # unblock any open /debug/pprof window
         with self._import_pool_mu:
             pool, self._import_pool = self._import_pool, None
+            rpool, self._route_pool = self._route_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        if rpool is not None:
+            rpool.shutdown(wait=False)
         if self.prefetcher is not None:
             self.prefetcher.stop()  # joins the warm worker before teardown
         if self._httpd is not None:

@@ -101,6 +101,18 @@ STAT_NAMES = frozenset(
         "ingest.merge_ms",
         "ingest.merge_batches",
         "ingest.merge_device",
+        # durable write path (core/wal.py group-commit WAL): commit
+        # rounds, file fsyncs (commit_groups/fsyncs are cumulative
+        # counters published as gauges at scrape/sampler time), appends
+        # coalesced per round (histogram), and — bounded-loss mode —
+        # how long buffered appends waited for their background fsync.
+        # Process-global like the hbm.* gauges: one commit loop per
+        # process.
+        "wal.commit_groups",
+        "wal.fsyncs",
+        "wal.group_size",
+        "wal.sync_lag_ms",
+        "wal.sync_failures",
         # mesh-group execution (exec/meshgroup.py, refreshed at scrape/
         # sampler time): live registered members of this node's ICI
         # domain, cumulative shards answered mesh-locally (no HTTP leg),

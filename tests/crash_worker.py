@@ -1,0 +1,140 @@
+"""Crash-kill worker: a real OS process for the durability kill matrix.
+
+Launched by tests/test_crashkill.py (NOT collected by pytest). The
+worker arms a FaultInjector "kill" rule at one exact durable-write-path
+point — inside a group-commit round (pre-fsync / post-fsync-pre-ack),
+during a replica ship, at the merge-barrier install, or between a
+fragment snapshot and its WAL truncation — then drives the real staged
+import path until the injector SIGKILLs the process mid-write. After
+each import call RETURNS (i.e. is acked to the caller), the batch index
+is appended to the ack log and fsynced, so the parent can counter-assert
+"no acked write is ever lost" against exactly what the killed process
+had acknowledged.
+
+Batches are derived from their index (seeded RNG), so the parent
+regenerates the expected positions without any channel besides the ack
+log surviving the kill.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+# python <path>/crash_worker.py puts tests/ on sys.path, not the repo
+# root the pilosa_tpu package lives in
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def batch_bits(i: int, n_shards: int, n: int = 400):
+    """Deterministic batch `i`: (rows, cols) uint64 arrays. The parent
+    test regenerates these to verify the replayed state."""
+    import numpy as np
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(10_000 + i)
+    rows = rng.integers(0, 8, n).astype(np.uint64)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, n).astype(np.uint64)
+    return rows, cols
+
+
+def _ack(fh, i: int) -> None:
+    # the ack log is the ground truth the parent audits: flushed AND
+    # fsynced per entry, so it is strictly no newer than what the worker
+    # actually acknowledged
+    fh.write(f"{i}\n")
+    fh.flush()
+    os.fsync(fh.fileno())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--point", required=True)
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--ack-log", required=True)
+    ap.add_argument("--sync-interval", type=float, default=0.0)
+    ap.add_argument("--batches", type=int, default=30)
+    ap.add_argument("--kill-after", type=int, default=2)
+    ap.add_argument("--n-shards", type=int, default=4)
+    ap.add_argument("--max-op-n", type=int, default=0)  # 0 = leave default
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from pilosa_tpu.core import wal as walmod
+    from pilosa_tpu.server import faults
+
+    walmod.GROUP_COMMIT.configure(sync_interval=args.sync_interval)
+    inj = faults.FaultInjector(seed=0)
+    point = args.point
+    if point == "replica.ship":
+        # die while a pool thread is shipping a replica frame
+        inj.add_rule("kill", path="/internal/index", skip=args.kill_after)
+    else:
+        wal_point = (
+            "wal." + point if point.startswith("commit.") else point
+        )
+        inj.add_wal_rule("kill", point=wal_point, skip=args.kill_after)
+    faults.install_injector(inj)
+
+    ack = open(args.ack_log, "a")
+
+    if point == "replica.ship":
+        from pilosa_tpu.cluster.topology import Node
+        from pilosa_tpu.server.node import NodeServer
+
+        a = NodeServer(os.path.join(args.data_dir, "a"), "ck-a")
+        b = NodeServer(os.path.join(args.data_dir, "b"), "ck-b")
+        a.start()
+        b.start()
+        members = [
+            Node(id=a.node.id, uri=a.node.uri, is_coordinator=True),
+            Node(id=b.node.id, uri=b.node.uri),
+        ]
+        a.set_topology(members, replica_n=2)
+        b.set_topology(members, replica_n=2)
+        api = a.api
+        api.create_index("ck")
+        api.create_field("ck", "f", {"type": "set"})
+        for i in range(args.batches):
+            rows, cols = batch_bits(i, args.n_shards)
+            api.import_bits("ck", "f", rows, cols)
+            _ack(ack, i)
+        print("COMPLETED", flush=True)
+        a.stop()
+        b.stop()
+        return 0
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(args.data_dir).open()
+    idx = h.create_index_if_not_exists("ck")
+    f = idx.create_field_if_not_exists("f", FieldOptions())
+    for i in range(args.batches):
+        rows, cols = batch_bits(i, args.n_shards)
+        f.import_bits(rows, cols)
+        if point == "merge.install" and i % 2 == 1:
+            # trigger the cross-fragment merge barrier (the read-side
+            # install the kill rule targets)
+            f.view("standard").sync_pending()
+        if args.max_op_n:
+            # lower the snapshot trigger on every fragment the import
+            # just created, so the op-count snapshot (and its
+            # pre-truncate kill point) fires within a few batches
+            for fr in f.view("standard").fragments.values():
+                fr.max_op_n = args.max_op_n
+        _ack(ack, i)
+        if args.sync_interval > 0:
+            # bounded-loss mode: pace the batches so background syncer
+            # rounds (and the kill point riding them) fire MID-RUN —
+            # un-paced, all batches land before the first cadence tick
+            time.sleep(args.sync_interval / 5)
+    print("COMPLETED", flush=True)
+    h.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

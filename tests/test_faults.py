@@ -545,6 +545,255 @@ def test_breaker_half_open_recovery_end_to_end():
         assert c[0].breakers.state(c[2].node.uri) == faults.CLOSED
 
 
+# ---------------------------------------------------------------------------
+# durable-write-path fault hooks (ISSUE 12): the WAL rules
+# ---------------------------------------------------------------------------
+
+
+class TestWalFaults:
+    def _field(self, tmp_path):
+        from pilosa_tpu.core.field import FieldOptions
+        from pilosa_tpu.core.holder import Holder
+
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("wf")
+        return h, idx.create_field("f", FieldOptions())
+
+    def test_enospc_fails_whole_commit_group_no_partial_ack(self, tmp_path):
+        """An ENOSPC inside a group-commit fsync round fails EVERY caller
+        whose append rode that round — nobody is acked on a partial
+        sync — and once space returns the retained dirty bytes sync on
+        the next round."""
+        import threading
+
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        h, f = self._field(tmp_path)
+        try:
+            f.import_bits(np.array([0], np.uint64), np.array([0], np.uint64))
+            inj = faults.FaultInjector(seed=0)
+            # every fsync attempt hits the full disk until healed; the
+            # slow rule widens the round so both writers share one group
+            inj.add_wal_rule("slow", point="wal.commit.pre_fsync", delay=0.01)
+            inj.add_wal_rule("enospc", point="wal.fsync")
+            faults.install_injector(inj)
+            results = {}
+
+            def writer(t):
+                rng = np.random.default_rng(t)
+                cols = rng.integers(0, 2 * SHARD_WIDTH, 100).astype(np.uint64)
+                try:
+                    f.import_bits(np.zeros(100, np.uint64), cols)
+                    results[t] = "acked"
+                except OSError as e:
+                    results[t] = e
+
+            threads = [
+                threading.Thread(target=writer, args=(t,)) for t in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # the WHOLE group failed loudly: no caller was acked
+            assert all(isinstance(r, OSError) for r in results.values()), results
+            assert all(
+                isinstance(r, walmod.WalSyncError) for r in results.values()
+            ), results
+            assert inj.count("enospc") >= 1
+            # disk space returns: a fresh import succeeds AND the retained
+            # dirty bytes from the failed rounds sync with it
+            inj.heal()
+            f.import_bits(np.array([1], np.uint64), np.array([7], np.uint64))
+        finally:
+            faults.uninstall_injector()
+            h.close()
+
+    def test_short_write_rolls_back_to_record_boundary(self, tmp_path):
+        """An injected short write lands a PREFIX of the framed bytes and
+        fails the append — and the writer ROLLS THE TEAR BACK to the
+        previous record boundary, because replay stops at a torn
+        mid-file record and would silently discard anything acked after
+        it. The file stays clean and the writer stays usable."""
+        import os
+
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+
+        p = str(tmp_path / "sw.wal")
+        w = walmod.WalWriter(p)
+        good = np.array([3, 5, 8], np.uint64)
+        tok = w.append(walmod.OP_SET, good)
+        walmod.GROUP_COMMIT.wait_durable(tok)
+        size_before = os.path.getsize(p)
+        inj = faults.FaultInjector(seed=0).add_wal_rule(
+            "short-write", point="wal.write", times=1
+        )
+        faults.install_injector(inj)
+        try:
+            with pytest.raises(OSError):
+                w.append(walmod.OP_SET, np.arange(40, dtype=np.uint64))
+        finally:
+            faults.uninstall_injector()
+        assert os.path.getsize(p) == size_before
+        n_ops, status, _ = walmod.check_wal(p)
+        assert (n_ops, status) == (1, "ok")
+        # the rolled-back writer keeps appending; a later record lands
+        # at the clean boundary and both replay
+        after = np.array([11], np.uint64)
+        tok = w.append(walmod.OP_SET, after)
+        walmod.GROUP_COMMIT.wait_durable(tok)
+        replayed = list(walmod.replay_wal(p))
+        assert len(replayed) == 2
+        np.testing.assert_array_equal(replayed[0][1], good)
+        np.testing.assert_array_equal(replayed[1][1], after)
+        w.close()
+
+    def test_failed_rollback_poisons_writer(self, tmp_path):
+        """If the post-tear rollback ALSO fails, the writer poisons:
+        further appends refuse instead of landing beyond a tear replay
+        would stop at (acked-but-unreplayable bytes)."""
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+
+        p = str(tmp_path / "poison.wal")
+        w = walmod.WalWriter(p)
+        inj = (
+            faults.FaultInjector(seed=0)
+            .add_wal_rule("short-write", point="wal.write", times=1)
+            .add_wal_rule("io-error", point="wal.rollback", times=1)
+        )
+        faults.install_injector(inj)
+        try:
+            with pytest.raises(OSError):
+                w.append(walmod.OP_SET, np.arange(40, dtype=np.uint64))
+        finally:
+            faults.uninstall_injector()
+        # poisoned even with the disk healthy again: the tear is on disk
+        with pytest.raises(ValueError, match="poisoned"):
+            w.append(walmod.OP_SET, np.array([1], np.uint64))
+        # the torn tail is exactly what replay tolerates: prefix only
+        n_ops, status, _ = walmod.check_wal(p)
+        assert (n_ops, status) == (0, "torn")
+        w.close()
+
+    def test_io_error_on_fsync_raises_wal_sync_error(self, tmp_path):
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+
+        p = str(tmp_path / "io.wal")
+        w = walmod.WalWriter(p)
+        inj = faults.FaultInjector(seed=0).add_wal_rule(
+            "io-error", point="wal.fsync", times=1
+        )
+        faults.install_injector(inj)
+        try:
+            tok = w.append(walmod.OP_SET, np.array([1], np.uint64))
+            with pytest.raises(walmod.WalSyncError):
+                walmod.GROUP_COMMIT.wait_durable(tok)
+        finally:
+            faults.uninstall_injector()
+        # the dirty mark was retained: the next round retries and succeeds
+        walmod.GROUP_COMMIT.flush()
+        w.close()
+
+    def test_failed_round_spares_already_durable_tokens(self, tmp_path):
+        """A failed round must only fail the tokens that rode it — a
+        token already resolved by an EARLIER successful round is on
+        disk and applied, and failing it retroactively would make a
+        client retry (or abort) a write that succeeded."""
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+
+        w1 = walmod.WalWriter(str(tmp_path / "a.wal"))
+        w2 = walmod.WalWriter(str(tmp_path / "b.wal"))
+        tok1 = w1.append(walmod.OP_SET, np.array([1], np.uint64))
+        walmod.GROUP_COMMIT.wait_durable(tok1)  # durably resolved
+        inj = faults.FaultInjector(seed=0).add_wal_rule(
+            "io-error", point="wal.fsync", times=1
+        )
+        faults.install_injector(inj)
+        try:
+            tok2 = w2.append(walmod.OP_SET, np.array([2], np.uint64))
+            with pytest.raises(walmod.WalSyncError):
+                walmod.GROUP_COMMIT.wait_durable(tok2)
+            # the earlier durable token still resolves cleanly
+            walmod.GROUP_COMMIT.wait_durable(tok1)
+        finally:
+            faults.uninstall_injector()
+        walmod.GROUP_COMMIT.flush()  # retained dirty bytes sync now
+        w1.close()
+        w2.close()
+
+    def test_bounded_loss_refuses_acks_while_cadence_broken(self, tmp_path):
+        """sync-interval > 0 defers fsyncs — but once a background round
+        FAILS, new acks are refused until a round succeeds: silently
+        acking onto a broken cadence would make the documented loss
+        window unbounded and invisible."""
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+
+        w = walmod.WalWriter(str(tmp_path / "bl.wal"))
+        walmod.GROUP_COMMIT.configure(sync_interval=30.0)  # rounds manual
+        try:
+            tok = w.append(walmod.OP_SET, np.array([1], np.uint64))
+            walmod.GROUP_COMMIT.wait_durable(tok)  # acked, deferred sync
+            inj = faults.FaultInjector(seed=0).add_wal_rule(
+                "io-error", point="wal.fsync"
+            )
+            faults.install_injector(inj)
+            try:
+                with pytest.raises(walmod.WalSyncError):
+                    walmod.GROUP_COMMIT.flush()  # the cadence breaks
+                tok = w.append(walmod.OP_SET, np.array([2], np.uint64))
+                with pytest.raises(walmod.WalSyncError, match="cadence"):
+                    walmod.GROUP_COMMIT.wait_durable(tok)
+                assert walmod.stats_snapshot()["sync_failures"] >= 1
+            finally:
+                faults.uninstall_injector()
+            # disk healthy again: one successful round restores acks
+            walmod.GROUP_COMMIT.flush()
+            tok = w.append(walmod.OP_SET, np.array([3], np.uint64))
+            walmod.GROUP_COMMIT.wait_durable(tok)  # acks flow again
+        finally:
+            walmod.GROUP_COMMIT.configure(sync_interval=0.0)
+            w.close()
+
+    def test_wal_rule_skip_and_times(self, tmp_path):
+        """skip ignores the first K matches, times bounds firings after
+        that — the knobs the kill matrix aims with."""
+        import numpy as np
+
+        from pilosa_tpu.core import wal as walmod
+
+        p = str(tmp_path / "sk.wal")
+        w = walmod.WalWriter(p)
+        inj = faults.FaultInjector(seed=0).add_wal_rule(
+            "io-error", point="wal.write", skip=2, times=1
+        )
+        faults.install_injector(inj)
+        try:
+            for i in range(2):  # skipped matches: no fault
+                w.append(walmod.OP_SET, np.array([i], np.uint64))
+            with pytest.raises(OSError):
+                w.append(walmod.OP_SET, np.array([9], np.uint64))
+            # times exhausted: appends flow again
+            w.append(walmod.OP_SET, np.array([10], np.uint64))
+            walmod.GROUP_COMMIT.wait_durable()
+        finally:
+            faults.uninstall_injector()
+        assert inj.count("io-error") == 1
+        w.close()
+
+
 @pytest.mark.slow
 def test_chaos_soak_seeded_flakiness_stays_correct():
     """Long probabilistic soak (tier-2): 30 queries under sustained
