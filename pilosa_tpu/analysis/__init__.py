@@ -30,6 +30,7 @@ from pilosa_tpu.analysis.framework import (
     run_gate,
     run_passes,
 )
+from pilosa_tpu.analysis.guarded_by import GuardedByPass
 from pilosa_tpu.analysis.jax_purity import JaxPurityPass
 from pilosa_tpu.analysis.lock_hygiene import LockHygienePass
 
@@ -39,6 +40,7 @@ __all__ = [
     "BaselineEntry",
     "Finding",
     "GateResult",
+    "GuardedByPass",
     "JaxPurityPass",
     "LockHygienePass",
     "Module",
@@ -54,7 +56,12 @@ __all__ = [
 
 def default_passes() -> List[Pass]:
     """The gate's pass registry, in execution order."""
-    return [LockHygienePass(), JaxPurityPass(), ApiInvariantsPass()]
+    return [
+        LockHygienePass(),
+        GuardedByPass(),
+        JaxPurityPass(),
+        ApiInvariantsPass(),
+    ]
 
 
 def check(

@@ -98,6 +98,10 @@ def _stats_receiver(call: ast.Call) -> bool:
 
 class ApiInvariantsPass(Pass):
     name = "api-invariants"
+    rules = (
+        "API001", "API002", "API003", "API004", "API005", "API006",
+        "API007", "API008",
+    )
 
     def __init__(self, docs_path: Optional[str] = None):
         # resolved lazily against the module set's repo root when None

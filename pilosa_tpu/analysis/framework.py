@@ -23,7 +23,7 @@ from __future__ import annotations
 import ast
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 try:  # tomllib is stdlib only from 3.11; 3.10 environments carry tomli
     import tomllib
@@ -41,6 +41,7 @@ __all__ = [
     "load_source_module",
     "run_passes",
     "run_gate",
+    "validate_baseline",
 ]
 
 
@@ -68,10 +69,17 @@ class Finding:
 
 
 class Pass:
-    """Base class for analysis passes. Subclasses set `name` and
-    implement run() over the full module set."""
+    """Base class for analysis passes. Subclasses set `name`, declare
+    the rule codes they can emit in `rules`, and implement run() over
+    the full module set. The `rules` declaration is load-bearing:
+    baseline entries name their pass (`rule = "<pass name>"`) and the
+    gate rejects an entry whose pass or code no longer exists — a
+    renamed/removed rule must take its suppressions with it instead of
+    leaving them to silently shadow an unrelated future rule that
+    reuses the code."""
 
     name = "unnamed"
+    rules: Tuple[str, ...] = ()
 
     def run(self, modules: Sequence[Module]) -> List[Finding]:
         raise NotImplementedError
@@ -83,6 +91,7 @@ class BaselineEntry:
     path: str
     match: str  # substring of the finding message; "" matches any
     reason: str
+    rule: str = ""  # owning pass name; validated against Pass.rules
 
     def covers(self, f: Finding) -> bool:
         return (
@@ -102,11 +111,12 @@ class Baseline:
             data = tomllib.load(fh)
         entries: List[BaselineEntry] = []
         for i, raw in enumerate(data.get("allow", [])):
-            for req in ("code", "path", "reason"):
+            for req in ("code", "path", "reason", "rule"):
                 if not raw.get(req):
                     raise ValueError(
                         f"{path}: allow[{i}] is missing required key "
-                        f"{req!r} — every baseline entry must be justified"
+                        f"{req!r} — every baseline entry must be "
+                        "justified and name the pass that owns its rule"
                     )
             entries.append(
                 BaselineEntry(
@@ -114,6 +124,7 @@ class Baseline:
                     path=str(raw["path"]),
                     match=str(raw.get("match", "")),
                     reason=str(raw["reason"]),
+                    rule=str(raw["rule"]),
                 )
             )
         return cls(entries)
@@ -121,16 +132,22 @@ class Baseline:
 
 @dataclass
 class GateResult:
-    """Outcome of one gate run: what fired, what the baseline ate, and
-    which baseline entries matched nothing (stale)."""
+    """Outcome of one gate run: what fired, what the baseline ate,
+    which baseline entries matched nothing (stale), and which name a
+    pass/rule that no longer exists (invalid)."""
 
     findings: List[Finding] = field(default_factory=list)  # unsuppressed
     suppressed: List[Finding] = field(default_factory=list)
     stale_entries: List[BaselineEntry] = field(default_factory=list)
+    invalid_entries: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
-        return not self.findings and not self.stale_entries
+        return (
+            not self.findings
+            and not self.stale_entries
+            and not self.invalid_entries
+        )
 
     def render(self) -> str:
         out: List[str] = []
@@ -144,6 +161,7 @@ class GateResult:
                 f"(match={e.match!r}) no longer matches any finding — "
                 "delete it"
             )
+        out.extend(self.invalid_entries)
         if not out:
             out.append("analysis: clean")
         return "\n".join(out)
@@ -185,16 +203,45 @@ def run_passes(
     return findings
 
 
+def validate_baseline(
+    passes: Sequence[Pass], baseline: Baseline
+) -> List[str]:
+    """Reject entries naming a pass or rule code that no longer exists.
+    Without this, renaming LOCKNNN (or retiring a pass) leaves its
+    suppressions behind to silently cover whatever future rule reuses
+    the code — the baseline must shrink with the rule set."""
+    by_name = {p.name: p for p in passes}
+    problems: List[str] = []
+    for e in baseline.entries:
+        p = by_name.get(e.rule)
+        if p is None:
+            problems.append(
+                f"{e.path}: INVALID baseline entry {e.code}: rule pass "
+                f"{e.rule!r} is not registered "
+                f"(known: {', '.join(sorted(by_name))}) — the pass was "
+                "renamed or removed; update or delete the entry"
+            )
+        elif e.code not in p.rules:
+            problems.append(
+                f"{e.path}: INVALID baseline entry {e.code}: pass "
+                f"{e.rule!r} declares no such rule "
+                f"(its rules: {', '.join(p.rules) or 'none'}) — the rule "
+                "was renamed or removed; update or delete the entry"
+            )
+    return problems
+
+
 def run_gate(
     passes: Sequence[Pass],
     modules: Sequence[Module],
     baseline: Optional[Baseline] = None,
 ) -> GateResult:
     """Run passes, partition findings against the baseline, and report
-    stale baseline entries."""
+    stale or invalid baseline entries."""
     all_findings = run_passes(passes, modules)
     if baseline is None:
         return GateResult(findings=all_findings)
+    invalid = validate_baseline(passes, baseline)
     used: Dict[int, bool] = {i: False for i in range(len(baseline.entries))}
     kept: List[Finding] = []
     suppressed: List[Finding] = []
@@ -206,7 +253,12 @@ def run_gate(
                 hit = True
         (suppressed if hit else kept).append(f)
     stale = [e for i, e in enumerate(baseline.entries) if not used[i]]
-    return GateResult(findings=kept, suppressed=suppressed, stale_entries=stale)
+    return GateResult(
+        findings=kept,
+        suppressed=suppressed,
+        stale_entries=stale,
+        invalid_entries=invalid,
+    )
 
 
 # -- shared AST helpers used by the concrete passes -------------------------

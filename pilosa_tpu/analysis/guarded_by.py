@@ -1,0 +1,467 @@
+"""Guarded-by inference: which `self._x` attributes does each lock protect?
+
+Per class, the pass discovers the tracked locks (`self._mu = TrackedLock(...)`
+/ `TrackedRLock` / `TrackedCondition` constructions, plus any `self.<name>`
+used as `with self.<name>:` whose terminal matches the repo's lock naming
+convention) and then classifies every `self._attr` access site by the set
+of class locks lexically held around it. From that it infers, per private
+attribute, the lock that CONSISTENTLY guards its writes — and flags mixed
+access:
+
+* **LOCK004** — an attribute written both under and outside its guard.
+  Inference claims a guard only when at least `MIN_GUARDED_WRITES` write
+  sites hold the same lock and guarded writes are not outnumbered by
+  unguarded ones; a `# guarded-by:` annotation claims it unconditionally.
+* **LOCK005** — a read of a guarded attribute with NO lock held, in a
+  method that elsewhere takes that very lock: the author demonstrably
+  knows the lock matters here, so the bare read is either a bug or an
+  intentional racy snapshot that must say so.
+
+What inference cannot see, annotations declare (trailing comments, read
+from the source text):
+
+    self._rows = {}            # guarded-by: _mu
+    self.version = 0           # lock-free: monotonic int; GIL-atomic reads
+    def _evict(self):          # guarded-by: _mu   (callback: caller holds it)
+
+* `# guarded-by: <lock>` on an attribute's assignment pins its guard (the
+  pass then enforces, never re-infers). On a `def` line it declares the
+  whole method runs with the lock held (callbacks, `*_locked` helpers in
+  classes with several locks).
+* `# lock-free: <reason>` exempts the attribute entirely — init-before-
+  publish handoffs, GIL-atomic counters read by gauge snapshots, versions
+  validated elsewhere. The reason is mandatory (an empty one is itself a
+  finding).
+
+Conventions honored without annotation:
+
+* `__init__` (and `__new__`) accesses are exempt: the constructor runs
+  before the object is published.
+* methods named `*_locked` are treated as holding the class's PRIMARY
+  lock (the lock most often used in `with self.<lock>:` across the
+  class) — the repo-wide convention for "caller holds the mutex".
+* a `TrackedCondition(self._mu, ...)` attribute is an ALIAS of its lock:
+  `with self._cv:` acquires `_mu`.
+* nested functions/lambdas are skipped (they run later, like the
+  lock-hygiene closure rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from pilosa_tpu.analysis.framework import (
+    Finding,
+    Module,
+    Pass,
+    dotted_name,
+)
+from pilosa_tpu.analysis.lock_hygiene import LOCKISH_RE
+
+__all__ = ["GuardedByPass", "MIN_GUARDED_WRITES"]
+
+# inference claims a guard only from this many agreeing write sites —
+# single-assignment attributes carry too little signal to accuse anyone
+MIN_GUARDED_WRITES = 2
+
+_ANNOT_RE = re.compile(
+    r"#\s*(?P<kind>guarded-by|lock-free)\s*:\s*(?P<arg>[^#\n]*)"
+)
+
+_LOCK_CTORS = {"TrackedLock", "TrackedRLock", "TrackedCondition"}
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    lineno: int
+    locks: Set[str] = field(default_factory=set)
+    # condition attr -> underlying lock attr (TrackedCondition(self._mu))
+    aliases: Dict[str, str] = field(default_factory=dict)
+    # attr -> ("guarded-by", lock) | ("lock-free", reason)
+    annotations: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    method_names: Set[str] = field(default_factory=set)
+    class_attrs: Set[str] = field(default_factory=set)
+    # method name -> declared held lock (def-line guarded-by annotation)
+    method_guards: Dict[str, str] = field(default_factory=dict)
+    # methods exempted wholesale (def-line `# lock-free: <reason>` —
+    # init-before-publish phases like open()/replay)
+    exempt_methods: Set[str] = field(default_factory=set)
+    # attr -> list of (line, frozenset(held locks), method, is_write)
+    accesses: Dict[str, List[Tuple[int, FrozenSet[str], str, bool]]] = field(
+        default_factory=dict
+    )
+    with_counts: Dict[str, int] = field(default_factory=dict)
+    # method name -> class locks it takes via `with` (LOCK005's "a
+    # method that elsewhere takes the lock")
+    method_with_locks: Dict[str, Set[str]] = field(default_factory=dict)
+    bad_annotations: List[Tuple[int, str]] = field(default_factory=list)
+
+
+def _line_annotations(source: str) -> Dict[int, Tuple[str, str]]:
+    """lineno -> (kind, argument) for every guarded-by / lock-free
+    trailing comment in the file."""
+    out: Dict[int, Tuple[str, str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _ANNOT_RE.search(line)
+        if m:
+            out[i] = (m.group("kind"), m.group("arg").strip())
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is `self.x` (or `cls.x`), else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return node.attr
+    return None
+
+
+def _canon_lock(name: str) -> str:
+    """First token of the annotation argument: `# guarded-by: _mu (why)`
+    names lock `_mu`; the parenthetical is commentary for the reader."""
+    return name.split()[0] if name.split() else ""
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Walk one method body, tracking which class locks are lexically
+    held, recording every `self._attr` access site."""
+
+    def __init__(
+        self, info: _ClassInfo, method: str, base_held: FrozenSet[str]
+    ):
+        self.info = info
+        self.method = method
+        self.held: Set[str] = set(base_held)
+
+    # deferred bodies: the lock context at the def site is meaningless
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        return
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is None:
+            # class-level lock used via the class name
+            # (e.g. `with WalWriter._lru_mu:`): take the terminal
+            name = dotted_name(expr)
+            if name is None:
+                return None
+            attr = name.rsplit(".", 1)[-1]
+        if attr in self.info.locks or attr in self.info.aliases:
+            return self.info.aliases.get(attr, attr)
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        for item in node.items:
+            lock = self._resolve_lock(item.context_expr)
+            if lock is not None:
+                entered.append(lock)
+                self.info.with_counts[lock] = (
+                    self.info.with_counts.get(lock, 0) + 1
+                )
+                self.info.method_with_locks.setdefault(
+                    self.method, set()
+                ).add(lock)
+        newly = [lk for lk in entered if lk not in self.held]
+        self.held.update(newly)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lk in newly:
+            self.held.discard(lk)
+
+    def _record(self, attr: str, lineno: int, is_write: bool) -> None:
+        info = self.info
+        if not attr.startswith("_") or attr.startswith("__"):
+            return
+        if attr in info.locks or attr in info.aliases:
+            return
+        if attr in info.method_names or attr in info.class_attrs:
+            return
+        info.accesses.setdefault(attr, []).append(
+            (lineno, frozenset(self.held), self.method, is_write)
+        )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(
+                attr, node.lineno, isinstance(node.ctx, (ast.Store, ast.Del))
+            )
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # `self._pins[key] = n` / `del self._cache[k]`: a store through a
+        # subscript MUTATES the container the attribute references —
+        # that is a write for guarding purposes even though the
+        # attribute itself is only Loaded
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr(node.value)
+            if attr is not None:
+                self._record(attr, node.lineno, True)
+                self.visit(node.slice)
+                return
+        self.generic_visit(node)
+
+
+class GuardedByPass(Pass):
+    name = "guarded-by"
+    rules = ("LOCK004", "LOCK005")
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        findings: List[Finding] = []
+        for m in modules:
+            annots = _line_annotations(m.source)
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    info = self._collect(node, annots)
+                    if info.locks:
+                        self._report(m, info, findings)
+        return findings
+
+    # -- collection --------------------------------------------------------
+
+    def _collect(
+        self, cls: ast.ClassDef, annots: Dict[int, Tuple[str, str]]
+    ) -> _ClassInfo:
+        info = _ClassInfo(name=cls.name, lineno=cls.lineno)
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.method_names.add(stmt.name)
+                ann = annots.get(stmt.lineno)
+                if ann and ann[0] == "guarded-by" and ann[1]:
+                    info.method_guards[stmt.name] = _canon_lock(ann[1])
+                elif ann and ann[0] == "lock-free":
+                    if not ann[1]:
+                        info.bad_annotations.append(
+                            (
+                                stmt.lineno,
+                                f"`# lock-free:` on {cls.name}."
+                                f"{stmt.name}() has no reason — say WHY "
+                                "this method may touch guarded state "
+                                "without the lock",
+                            )
+                        )
+                    else:
+                        info.exempt_methods.add(stmt.name)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        info.class_attrs.add(t.id)
+        # lock attrs + attribute annotations from every method body
+        for fn in [
+            s
+            for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    ctor = dotted_name(node.value.func)
+                    ctor = ctor.rsplit(".", 1)[-1] if ctor else None
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        if ctor in _LOCK_CTORS:
+                            info.locks.add(attr)
+                            if ctor == "TrackedCondition" and node.value.args:
+                                under = _self_attr(node.value.args[0])
+                                if under is not None:
+                                    info.aliases[attr] = under
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    ann = annots.get(node.lineno)
+                    if ann:
+                        targets = (
+                            node.targets
+                            if isinstance(node, ast.Assign)
+                            else [node.target]
+                        )
+                        for t in targets:
+                            attr = _self_attr(t)
+                            if attr is None:
+                                continue
+                            kind, arg = ann
+                            if kind == "lock-free" and not arg:
+                                info.bad_annotations.append(
+                                    (
+                                        node.lineno,
+                                        f"`# lock-free:` on {cls.name}."
+                                        f"{attr} has no reason — say WHY "
+                                        "the lock-free access is safe",
+                                    )
+                                )
+                                continue
+                            info.annotations[attr] = (kind, _canon_lock(arg))
+        # class-body lock attrs (e.g. WalWriter._lru_mu at class level)
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call
+            ):
+                ctor = dotted_name(stmt.value.func)
+                ctor = ctor.rsplit(".", 1)[-1] if ctor else None
+                if ctor in _LOCK_CTORS:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            info.locks.add(t.id)
+                            info.class_attrs.discard(t.id)
+        # conventionally-named `with self.<x>:` targets count as locks
+        # even without a visible ctor (e.g. assigned via a factory)
+        for fn in [
+            s
+            for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if (
+                            attr is not None
+                            and LOCKISH_RE.search(attr)
+                            and attr not in info.aliases
+                        ):
+                            info.locks.add(attr)
+        # alias targets that are not otherwise locks still resolve
+        info.locks.update(info.aliases)
+        # scan method bodies
+        primary = self._primary_lock(cls, info)
+        for fn in [
+            s
+            for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            if fn.name in ("__init__", "__new__"):
+                continue
+            if fn.name in info.exempt_methods:
+                continue
+            base: Set[str] = set()
+            declared = info.method_guards.get(fn.name)
+            if declared is not None:
+                base.add(info.aliases.get(declared, declared))
+            elif fn.name.endswith("_locked") and primary is not None:
+                base.add(primary)
+            scanner = _MethodScanner(info, fn.name, frozenset(base))
+            for stmt in fn.body:
+                scanner.visit(stmt)
+        return info
+
+    def _primary_lock(self, cls: ast.ClassDef, info: _ClassInfo) -> Optional[str]:
+        counts: Dict[str, int] = {}
+        for fn in [
+            s
+            for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr is None:
+                            continue
+                        lock = info.aliases.get(attr, attr)
+                        if lock in info.locks or attr in info.locks:
+                            counts[lock] = counts.get(lock, 0) + 1
+        if not counts:
+            return None
+        return max(counts.items(), key=lambda kv: kv[1])[0]
+
+    # -- reporting ---------------------------------------------------------
+
+    def _report(
+        self, m: Module, info: _ClassInfo, findings: List[Finding]
+    ) -> None:
+        for lineno, msg in info.bad_annotations:
+            findings.append(
+                Finding(code="LOCK004", path=m.rel, line=lineno, message=msg)
+            )
+        for attr, sites in sorted(info.accesses.items()):
+            ann = info.annotations.get(attr)
+            if ann is not None and ann[0] == "lock-free":
+                continue
+            declared: Optional[str] = None
+            if ann is not None and ann[0] == "guarded-by":
+                declared = info.aliases.get(ann[1], ann[1])
+            guard = declared or self._infer(sites)
+            if guard is None:
+                continue
+            writes = [s for s in sites if s[3]]
+            unguarded_writes = [s for s in writes if guard not in s[1]]
+            for lineno, _held, method, _w in unguarded_writes:
+                findings.append(
+                    Finding(
+                        code="LOCK004",
+                        path=m.rel,
+                        line=lineno,
+                        message=(
+                            f"{info.name}.{attr} written without "
+                            f"{guard!r} in {method}() but its other "
+                            "writes hold it — guard the write, or "
+                            "annotate the attribute `# lock-free: "
+                            "<reason>` / `# guarded-by: <lock>`"
+                            + (
+                                " (guard declared by annotation)"
+                                if declared
+                                else " (guard inferred)"
+                            )
+                        ),
+                    )
+                )
+            # LOCK005: bare read in a method that elsewhere takes the lock
+            for lineno, held, method, is_write in sites:
+                if is_write or held:
+                    continue
+                if guard not in info.method_with_locks.get(method, ()):
+                    continue
+                findings.append(
+                    Finding(
+                        code="LOCK005",
+                        path=m.rel,
+                        line=lineno,
+                        message=(
+                            f"{info.name}.{attr} read with no lock held "
+                            f"in {method}(), which takes {guard!r} "
+                            "elsewhere — move the read under the lock, "
+                            "or annotate `# lock-free: <reason>`"
+                        ),
+                    )
+                )
+
+    def _infer(
+        self, sites: List[Tuple[int, FrozenSet[str], str, bool]]
+    ) -> Optional[str]:
+        writes = [s for s in sites if s[3]]
+        if not writes:
+            return None
+        counts: Dict[str, int] = {}
+        for _ln, held, _m, _w in writes:
+            for lock in held:
+                counts[lock] = counts.get(lock, 0) + 1
+        if not counts:
+            return None
+        guard, guarded = max(counts.items(), key=lambda kv: kv[1])
+        unguarded = sum(1 for s in writes if guard not in s[1])
+        if guarded < MIN_GUARDED_WRITES or guarded < unguarded:
+            return None
+        return guard

@@ -117,6 +117,7 @@ class _TracedBody:
 
 class JaxPurityPass(Pass):
     name = "jax-purity"
+    rules = ("JAX001", "JAX002", "JAX003", "JAX004", "JAX005", "JAX006")
 
     def run(self, modules: Sequence[Module]) -> List[Finding]:
         findings: List[Finding] = []

@@ -17,6 +17,27 @@ Rules (codes):
   HBM latency; where that is *intentional* (exec/plan.py serializes the
   whole mesh dispatch by design) the site is baselined with a reason,
   not rewritten.
+* LOCK006 — dispatch discipline (the PR-10 deadlock class): in
+  `pilosa_tpu/exec/`, `pilosa_tpu/ops/` and `pilosa_tpu/hbm/`, a call
+  to a `jax.jit`-compiled function (discovered across the whole module
+  set) or a `.block_until_ready()` wait must be lexically inside
+  `with <dispatch mutex>:` (`plan._DISPATCH_MU` / `plan.dispatch_mutex()`)
+  or inside a closure handed to `plan.run_serialized(...)`. Concurrent
+  entry into collective-bearing compiled programs parks XLA-CPU's
+  rendezvous when virtual devices outnumber cores — PR 1 fixed it for
+  plans, PR 10 re-fixed it for tally/aggregate dispatches; this rule is
+  the machine-checked form of that convention. Calls inside OTHER
+  traced bodies are exempt (jit-of-jit inlines into one program).
+* LOCK007 — durability waits under a fragment-class lock (the PR-11
+  convention): in `pilosa_tpu/core/`, `os.fsync` / `.fsync()` /
+  `GROUP_COMMIT.wait_durable()` / `GROUP_COMMIT.flush()` /
+  `write_snapshot()` / `<wal>.truncate()` must not run lexically inside
+  a `with self.<lock>:` body — a strict-mode fsync round under
+  `fragment.mu` serializes every reader and writer of that fragment
+  behind disk latency AND defeats cross-caller group-commit
+  coalescing. Commit tokens are returned past the lock and waited
+  there (`import_positions` / `stage_positions`); the snapshot path's
+  fsyncs are the designed exception, baselined with the reason.
 
 Scope notes: bodies of functions *defined* under a `with` are skipped
 (closures run later, lock not necessarily held); lock detection is
@@ -28,7 +49,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from pilosa_tpu.analysis.framework import (
     Finding,
@@ -69,7 +90,58 @@ _DEVICE_SYNC_ORIGINS = (
     "jax.block_until_ready",
 )
 
-_ALLOWED_RAW_IN = "pilosa_tpu/utils/locks.py"
+# raw threading primitives are permitted in the checker substrate itself:
+# locks.py IS the tracked factory, and race.py's internal bookkeeping
+# mutexes must stay invisible to the lockset they are computing (a
+# tracked tracker lock would appear in every access's held set)
+_ALLOWED_RAW_IN = (
+    "pilosa_tpu/utils/locks.py",
+    "pilosa_tpu/utils/race.py",
+)
+
+# -- LOCK006: dispatch discipline -------------------------------------------
+
+# modules where compiled dispatches live and the one-program-at-a-time
+# rule applies (the PR-10 deadlock class)
+_DISPATCH_SCOPE = (
+    "pilosa_tpu/exec/",
+    "pilosa_tpu/ops/",
+    "pilosa_tpu/hbm/",
+)
+
+# a with-context satisfying the discipline: the dispatch mutex itself
+# (by its conventional names) or anything acquired via dispatch_mutex()
+_DISPATCH_MUTEX_RE = re.compile(r"dispatch_*(mu|mutex)$", re.IGNORECASE)
+
+_RUN_SERIALIZED_NAMES = ("run_serialized",)
+
+# `# dispatch-ok: <reason>` annotation: on a call line it exempts that
+# call, on a `def` line the whole function body. For the three shapes
+# lexical analysis cannot prove safe: trace-time helpers (called only
+# during jit tracing, inlined into the one program), forwarding wrappers
+# (ops/ functions whose job IS the compiled call — their callers
+# serialize), and single-device paths with no collectives to rendezvous.
+# The reason is mandatory; an empty one is itself a LOCK006 finding.
+_DISPATCH_OK_RE = re.compile(r"#\s*dispatch-ok\s*:\s*(?P<arg>[^#\n]*)")
+
+
+def _dispatch_ok_lines(source: str) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _DISPATCH_OK_RE.search(line)
+        if m:
+            out[i] = m.group("arg").strip()
+    return out
+
+# -- LOCK007: durability waits under a fragment-class lock ------------------
+
+_FRAGMENT_LOCK_SCOPE = "pilosa_tpu/core/"
+
+# call shapes that fsync or block on a WAL commit round
+_DURABILITY_ORIGINS = ("os.fsync",)
+_DURABILITY_ATTRS = ("fsync", "_fsync", "wait_durable")
+# helpers known to fsync internally (file + directory)
+_DURABILITY_HELPERS = ("write_snapshot",)
 
 
 def _lockish(expr: ast.AST) -> Optional[str]:
@@ -165,11 +237,267 @@ class _UnderLockScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _jitted_names(modules: Sequence[Module]) -> Dict[str, Set[str]]:
+    """module rel -> set of function names compiled by jax.jit in that
+    module (decorator or `X = jax.jit(fn)` forms). The caller resolves
+    cross-module calls by mapping a call origin's dotted module prefix
+    back to a rel path."""
+    out: Dict[str, Set[str]] = {}
+    for m in modules:
+        aliases = import_aliases(m.tree)
+        names: Set[str] = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if _is_jit_decorator(dec, aliases):
+                        names.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if resolve_call(node.value, aliases) == "jax.jit":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+        out[m.rel] = names
+    return out
+
+
+def _is_jit_decorator(dec: ast.AST, aliases: Dict[str, str]) -> bool:
+    """@jax.jit, @jit, @partial(jax.jit, ...), @functools.partial(jax.jit,
+    ...), @jax.jit(...)."""
+
+    def is_jit(node: ast.AST) -> bool:
+        name = dotted_name(node)
+        if name is None:
+            return False
+        head, _, rest = name.partition(".")
+        origin = aliases.get(head, head)
+        return (f"{origin}.{rest}" if rest else origin) == "jax.jit"
+
+    if is_jit(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        origin = resolve_call(dec, aliases)
+        if origin in ("functools.partial", "partial"):
+            return bool(dec.args) and is_jit(dec.args[0])
+        return is_jit(dec.func)
+    return False
+
+
+def _rel_to_dotted(rel: str) -> str:
+    return rel[: -len(".py")].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def _is_dispatch_mutex_ctx(expr: ast.AST) -> bool:
+    """`with _DISPATCH_MU:` / `with plan.dispatch_mutex():` — the
+    contexts that satisfy LOCK006."""
+    target = expr.func if isinstance(expr, ast.Call) else expr
+    name = dotted_name(target)
+    if name is None:
+        return False
+    return bool(_DISPATCH_MUTEX_RE.search(name.rsplit(".", 1)[-1]))
+
+
+class _DispatchScanner(ast.NodeVisitor):
+    """LOCK006 walker for one exec/ops/hbm module: flags compiled calls
+    and block_until_ready waits outside a dispatch-mutex context.
+    Deferred bodies (closures, lambdas) are scanned only when they are
+    arguments to run_serialized — where they are exempt by definition —
+    otherwise skipped like every other hygiene rule."""
+
+    def __init__(
+        self,
+        m: Module,
+        aliases: Dict[str, str],
+        local_jitted: Set[str],
+        jitted_by_dotted: Dict[str, Set[str]],
+        findings: List[Finding],
+    ):
+        self.m = m
+        self.aliases = aliases
+        self.local_jitted = local_jitted
+        self.jitted_by_dotted = jitted_by_dotted
+        self.findings = findings
+        self.ok_lines = _dispatch_ok_lines(m.source)
+
+    def _annotated_ok(self, lineno: int) -> bool:
+        reason = self.ok_lines.get(lineno)
+        if reason is None:
+            return False
+        if not reason:
+            self.findings.append(
+                Finding(
+                    code="LOCK006",
+                    path=self.m.rel,
+                    line=lineno,
+                    message=(
+                        "`# dispatch-ok:` annotation has no reason — say "
+                        "WHY this compiled call is safe outside the "
+                        "dispatch mutex"
+                    ),
+                )
+            )
+        return True
+
+    # traced bodies: a jit-compiled function calling another jitted
+    # function inlines it into one program — no separate dispatch
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node.name in self.local_jitted:
+            return
+        if any(_is_jit_decorator(d, self.aliases) for d in node.decorator_list):
+            return
+        if self._annotated_ok(node.lineno):
+            return
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_With(self, node: ast.With) -> None:
+        if any(_is_dispatch_mutex_ctx(i.context_expr) for i in node.items):
+            return  # everything under the dispatch mutex is disciplined
+        self.generic_visit(node)
+
+    def _is_compiled_call(self, node: ast.Call) -> Optional[str]:
+        origin = resolve_call(node, self.aliases)
+        if origin is None:
+            return None
+        head, _, tail = origin.rpartition(".")
+        if not head:
+            # bare local name
+            return origin if origin in self.local_jitted else None
+        if head in self.jitted_by_dotted and tail in self.jitted_by_dotted[head]:
+            return origin
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # run_serialized(fn)/run_serialized(lambda: ...): DEFERRED
+        # callables (lambdas, named function refs) run under the
+        # dispatch mutex by construction and are exempt — but any other
+        # argument expression evaluates EAGERLY on the calling thread
+        # before run_serialized runs, so run_serialized(_tally(x)) is
+        # exactly the PR-10 bug wearing the fix's clothes: keep scanning
+        # those.
+        callee = dotted_name(node.func)
+        if callee is not None and callee.rsplit(".", 1)[-1] in _RUN_SERIALIZED_NAMES:
+            for arg in node.args:
+                if not isinstance(arg, (ast.Lambda, ast.Name)):
+                    self.visit(arg)
+            for kw in node.keywords:
+                if not isinstance(kw.value, (ast.Lambda, ast.Name)):
+                    self.visit(kw.value)
+            return
+        if self._annotated_ok(node.lineno):
+            self.generic_visit(node)
+            return
+        origin = resolve_call(node, self.aliases)
+        compiled = self._is_compiled_call(node)
+        if compiled is not None:
+            self.findings.append(
+                Finding(
+                    code="LOCK006",
+                    path=self.m.rel,
+                    line=node.lineno,
+                    message=(
+                        f"compiled dispatch {compiled}() outside "
+                        "plan.run_serialized/dispatch_mutex — concurrent "
+                        "collective-bearing programs deadlock the XLA "
+                        "rendezvous (the PR-10 class); route it through "
+                        "run_serialized or hold dispatch_mutex()"
+                    ),
+                )
+            )
+        elif origin == "jax.block_until_ready" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            self.findings.append(
+                Finding(
+                    code="LOCK006",
+                    path=self.m.rel,
+                    line=node.lineno,
+                    message=(
+                        "block_until_ready() outside plan.run_serialized/"
+                        "dispatch_mutex — a compiled program's completion "
+                        "wait must stay under the one-program-at-a-time "
+                        "mutex (the PR-10 class)"
+                    ),
+                )
+            )
+        self.generic_visit(node)
+
+
+class _FragmentLockScanner(ast.NodeVisitor):
+    """LOCK007 walker over a `with self.<lock>:` body in core/: flags
+    fsync / commit-wait calls made while the lock is held. Deferred
+    bodies are skipped (same closure rule as LOCK002/003)."""
+
+    def __init__(self, m: Module, aliases: Dict[str, str],
+                 lock_name: str, findings: List[Finding]):
+        self.m = m
+        self.aliases = aliases
+        self.lock_name = lock_name
+        self.findings = findings
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_Call(self, node: ast.Call) -> None:
+        origin = resolve_call(node, self.aliases)
+        flagged: Optional[str] = None
+        if origin in _DURABILITY_ORIGINS:
+            flagged = origin
+        elif origin is not None and origin.rsplit(".", 1)[-1] in _DURABILITY_HELPERS:
+            flagged = origin
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = dotted_name(node.func.value) or ""
+            if attr in _DURABILITY_ATTRS:
+                flagged = f"{recv}.{attr}" if recv else attr
+            elif attr in ("truncate", "flush") and recv.rsplit(".", 1)[
+                -1
+            ].lower().lstrip("_").startswith(("wal", "group_commit")):
+                flagged = f"{recv}.{attr}"
+        if flagged is not None:
+            self.findings.append(
+                Finding(
+                    code="LOCK007",
+                    path=self.m.rel,
+                    line=node.lineno,
+                    message=(
+                        f"durability call {flagged}() inside "
+                        f"`with {self.lock_name}:` — fsync/commit waits "
+                        "under a fragment-class lock serialize readers "
+                        "behind disk latency and defeat group-commit "
+                        "coalescing (the PR-11 convention: return the "
+                        "commit token past the lock and wait there)"
+                    ),
+                )
+            )
+        self.generic_visit(node)
+
+
 class LockHygienePass(Pass):
     name = "lock-hygiene"
+    rules = (
+        "LOCK001", "LOCK002", "LOCK003", "LOCK006", "LOCK007",
+    )
 
     def run(self, modules: Sequence[Module]) -> List[Finding]:
         findings: List[Finding] = []
+        jitted = _jitted_names(modules)
+        jitted_by_dotted = {
+            _rel_to_dotted(rel): names for rel, names in jitted.items() if names
+        }
         for m in modules:
             aliases = import_aliases(m.tree)
             for node in ast.walk(m.tree):
@@ -177,6 +505,12 @@ class LockHygienePass(Pass):
                     self._check_raw_ctor(m, node, aliases, findings)
                 elif isinstance(node, ast.With):
                     self._check_with(m, node, aliases, findings)
+            if m.rel.startswith(_DISPATCH_SCOPE):
+                scanner = _DispatchScanner(
+                    m, aliases, jitted.get(m.rel, set()),
+                    jitted_by_dotted, findings,
+                )
+                scanner.visit(m.tree)
         return findings
 
     def _check_raw_ctor(
@@ -224,3 +558,16 @@ class LockHygienePass(Pass):
         )
         for stmt in node.body:
             scanner.visit(stmt)
+        # LOCK007: in core/, a `with self.<lock>:` body (the
+        # fragment-class lock convention) must not fsync or wait on a
+        # commit round
+        if m.rel.startswith(_FRAGMENT_LOCK_SCOPE):
+            self_locks = [
+                n for n in lock_names if n.startswith("self.")
+            ]
+            if self_locks:
+                frag_scanner = _FragmentLockScanner(
+                    m, aliases, self_locks[0], findings
+                )
+                for stmt in node.body:
+                    frag_scanner.visit(stmt)

@@ -47,6 +47,7 @@ from contextlib import contextmanager
 from typing import Callable, Dict, Hashable, Iterable, Optional, Set, Tuple
 
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+from pilosa_tpu.utils.race import race_checked
 
 _DEFAULT_BUDGET_MB = 4096
 
@@ -81,6 +82,20 @@ def _nbytes(arr) -> int:
     return int(np.asarray(arr).nbytes)
 
 
+@race_checked(exclude=(
+    # budget_bytes / pin_timeout are operator knobs written by
+    # set_budget()/NodeServer configuration and read inside _mu holds;
+    # a torn read is impossible (int/float) and a stale one only delays
+    # an eviction by one pass. The stats counters are read lock-free by
+    # gauge snapshots on purpose (monotonic, GIL-atomic int adds).
+    "budget_bytes",
+    "pin_timeout",
+    "hits",
+    "misses",
+    "evictions",
+    "evicted_extent_bytes",
+    "stale_pin_reclaims",
+))
 class DeviceCache:
     """LRU key -> device array map with a byte budget.
 
@@ -469,7 +484,12 @@ class DeviceCache:
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        # under the ledger lock: the bare read was the race detector's
+        # first true positive (a torn view during a replace/evict pass
+        # could report bytes that never existed); one uncontended
+        # acquire per gauge scrape is free
+        with self._mu:
+            return self._bytes
 
     def index_resident_bytes(self) -> Dict[str, int]:
         """Resident device bytes grouped by owning INDEX (the per-tenant

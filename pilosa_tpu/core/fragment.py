@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from pilosa_tpu.utils.locks import TrackedRLock
+from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.core import cache as cachemod
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
@@ -218,6 +219,25 @@ class _LazyRows:
         _, _, self._index = walmod.read_snapshot_index(path)
 
 
+@race_checked(exclude=(
+    # version is read lock-free by design across the codebase: extent/
+    # stack cache keys are version-salted, and a torn read only yields a
+    # stale key that the next barrier invalidates (monotonic int, GIL-
+    # atomic). on_mutate is installed once by the owning View at
+    # registration, before concurrent writers exist for that view.
+    "version",
+    "on_mutate",
+    # staged-delta counters are SNAPSHOT-read lock-free by design: the
+    # merge barrier's phase-1 peek (core/merge.py merge_barrier), the
+    # admission cost estimator's staged surcharge (sched/cost.py) and
+    # holder.staged_position_count() all read these GIL-atomic ints
+    # without the fragment lock — every consumer that ACTS on them
+    # revalidates under the lock via the pending_snapshot/_pending_gen
+    # handshake, so a stale peek costs one wasted pass, never a wrong
+    # answer. Writes stay under _mu (LOCK004 enforces that statically).
+    "_pending_n",
+    "_premerged_n",
+))
 class Fragment:
     """One shard of one view of one field.
 
@@ -449,7 +469,7 @@ class Fragment:
                     (row_id, rb.count()) for row_id, rb in self._rows.items()
                 )
 
-    def _rebuild_mutex_map(self) -> None:
+    def _rebuild_mutex_map(self) -> None:  # guarded-by: _mu
         self._mutex_map = {}
         for row_id, rb in self._rows.items():
             for p in rb.to_positions():
@@ -725,13 +745,20 @@ class Fragment:
 
         Mutex fields cannot take this path (last-write-wins needs the
         mutex vector consulted at apply time)."""
-        if self._mutex_map is not None:
-            raise ValueError("stage_positions is not supported on mutex fields")
         positions = np.asarray(positions, dtype=np.uint64)
         n = len(positions)
-        if not n:
-            return 0
         with self._mu:
+            # mutex-ness never changes after construction, but the map
+            # itself is guarded state: check under the lock (LOCK005) —
+            # and BEFORE the empty-batch return, so misrouting a mutex
+            # field through the staging path raises on every call, not
+            # only on non-empty batches
+            if self._mutex_map is not None:
+                raise ValueError(
+                    "stage_positions is not supported on mutex fields"
+                )
+            if not n:
+                return 0
             self._check_write_block_locked()
             tok = self._wal_append(walmod.OP_SET, positions)
             self._capture_record(walmod.OP_SET, positions)
@@ -863,7 +890,9 @@ class Fragment:
                 self._sync_locked()  # bound the parked-layer debt
             return self.version
 
-    def _apply_positions(self, to_set: np.ndarray, to_clear: np.ndarray) -> Tuple[int, int]:
+    def _apply_positions(  # guarded-by: _mu (every mutation funnel holds it)
+        self, to_set: np.ndarray, to_clear: np.ndarray
+    ) -> Tuple[int, int]:
         # The single EXACT mutation funnel: every write path (including WAL
         # replay, clears from Store/ClearRow, bulk clear imports) flows
         # through here or through _sync_locked, so the mutex vector and the
@@ -926,7 +955,7 @@ class Fragment:
                 self.on_mutate()
         return n_set, n_clear
 
-    def _bulk_set_sparse(self, to_set: np.ndarray, touched: set) -> int:
+    def _bulk_set_sparse(self, to_set: np.ndarray, touched: set) -> int:  # guarded-by: _mu
         """Set a batch of keyed positions (row*SHARD_WIDTH + col) with ONE
         merge for all sparse-rep rows: their stored position arrays and
         the incoming batch are re-keyed into the same row-major space, so
@@ -990,7 +1019,7 @@ class Fragment:
         n += len(merged) - before
         return n
 
-    def _bulk_clear_sparse(self, to_clear: np.ndarray, touched: set) -> int:
+    def _bulk_clear_sparse(self, to_clear: np.ndarray, touched: set) -> int:  # guarded-by: _mu
         """Clear a batch of keyed positions with ONE merged membership test
         for all sparse-rep rows (the clear-side mirror of _bulk_set_sparse):
         stored position arrays and the incoming batch are re-keyed into the
@@ -1057,8 +1086,6 @@ class Fragment:
         ImportRoaringBits unioning a shipped bitmap in place): callers ship
         the row's dense uint32[W] words and they are OR'd into the store in
         one vector op. Returns how many bits were newly set."""
-        if self._mutex_map is not None:
-            raise ValueError("word-level import is not supported on mutex fields")
         words = np.ascontiguousarray(words, dtype=np.uint32)
         if words.shape != (SHARD_WIDTH // 32,):
             raise ValueError(
@@ -1066,6 +1093,11 @@ class Fragment:
             )
         tok = None
         with self._mu:
+            # see stage_positions: the mutex vector is guarded state
+            if self._mutex_map is not None:
+                raise ValueError(
+                    "word-level import is not supported on mutex fields"
+                )
             self._check_write_block_locked()
             self._sync_locked()
             if self._wal is not None or self._captures:
@@ -1084,7 +1116,7 @@ class Fragment:
             walmod.GROUP_COMMIT.wait_durable(tok)
         return added
 
-    def _apply_row_words(self, row_id: int, words: np.ndarray) -> int:
+    def _apply_row_words(self, row_id: int, words: np.ndarray) -> int:  # guarded-by: _mu
         rb = self._rows.get(row_id)
         if rb is None:
             rb = self._rows[row_id] = RowBits(SHARD_WIDTH)
@@ -1100,7 +1132,7 @@ class Fragment:
             self._paranoia_check({row_id})
         return added
 
-    def _paranoia_check(self, touched) -> None:
+    def _paranoia_check(self, touched) -> None:  # guarded-by: _mu
         """Opt-in invariant pass after every mutation (the reference's
         roaringparanoia tag, roaring/roaring_paranoia.go:15): rowstore
         structural checks plus cache/rowstore count coherence for the
@@ -1537,7 +1569,7 @@ class Fragment:
             "resize cutover in progress, retry"
         )
 
-    def _capture_record(self, op: int, positions: np.ndarray) -> None:
+    def _capture_record(self, op: int, positions: np.ndarray) -> None:  # guarded-by: _mu
         # called under self._mu by every mutation funnel
         if not self._captures:
             return

@@ -127,7 +127,9 @@ def _decode_pilosa(data: bytes) -> np.ndarray:
         raise RoaringError("container keys not strictly increasing")
     out: List[np.ndarray] = []
     for i in range(n_keys):
-        lows = _decode_container(data, int(types[i]), int(offsets[i]), int(cards[i]), runs_as_last=True)
+        lows = _decode_container(
+            data, int(types[i]), int(offsets[i]), int(cards[i]), runs_as_last=True
+        )
         out.append((keys[i] << np.uint64(16)) | lows.astype(np.uint64))
     return np.concatenate(out) if out else np.empty(0, dtype=np.uint64)
 

@@ -74,7 +74,7 @@ class TranslateStore:
             self._fh.close()
             self._fh = None
 
-    def _replay(self) -> None:
+    def _replay(self) -> None:  # lock-free: open()-time replay, pre-publication
         with open(self.path, "rb") as f:
             data = f.read()
         off = 0

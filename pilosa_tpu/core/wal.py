@@ -42,11 +42,12 @@ import time
 import zlib
 from collections import OrderedDict
 from contextlib import contextmanager, nullcontext
-from typing import Callable, Dict, Iterator, Optional, Tuple
+from typing import IO, Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.core.rowstore import RowBits
 
 SNAP_MAGIC = b"PTSNAP01"
@@ -109,7 +110,7 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def write_snapshot_stream(f, shard: int, n_bits: int, rows) -> None:
+def write_snapshot_stream(f: IO[bytes], shard: int, n_bits: int, rows: Any) -> None:
     """Write the snapshot record stream to an open binary file object.
 
     Single codec shared by on-disk snapshots and resize/backup streaming
@@ -131,7 +132,7 @@ def write_snapshot_stream(f, shard: int, n_bits: int, rows) -> None:
             f.write(payload.astype(np.uint32, copy=False).tobytes())
 
 
-def _read_exact(f, n: int) -> bytes:
+def _read_exact(f: IO[bytes], n: int) -> bytes:
     """Read exactly n bytes or raise — a truncated stream (torn network
     transfer, partial write) must fail loudly, never parse short."""
     data = f.read(n)
@@ -140,7 +141,7 @@ def _read_exact(f, n: int) -> bytes:
     return data
 
 
-def read_snapshot_stream(f) -> Tuple[int, int, Dict[int, RowBits]]:
+def read_snapshot_stream(f: IO[bytes]) -> Tuple[int, int, Dict[int, RowBits]]:
     """Inverse of write_snapshot_stream; returns (shard, n_bits, rows)."""
     magic = _read_exact(f, 8)
     if magic != SNAP_MAGIC:
@@ -206,6 +207,17 @@ def read_snapshot_index(path: str) -> Tuple[int, int, Dict[int, Tuple[int, int, 
 _MAX_OPEN_WALS = max(8, int(os.environ.get("PILOSA_TPU_MAX_OPEN_FILES", "256")))
 
 
+@race_checked(exclude=(
+    # _closed is written under _lru_mu and read by a commit round under
+    # commit_mu: a formally lock-free pair, made benign by the PR-11
+    # close() fix (close fsyncs UNCONDITIONALLY, so a round that reads a
+    # stale False and skips this writer can never ack unsynced bytes) —
+    # tests/test_race.py reproduces the pre-fix ack race seeded-style.
+    # _poisoned is single-writer state: fragment.mu serializes all
+    # appends to one WAL, so only the owning writer thread reads/sets it.
+    "_closed",
+    "_poisoned",
+))
 class WalWriter:
     """Append-only op log. One writer per fragment (single-writer, like the
     reference's per-fragment storage lock); file handles are pooled under
@@ -228,7 +240,7 @@ class WalWriter:
             pass
 
     @contextmanager
-    def _pin(self):
+    def _pin(self) -> Iterator[IO[bytes]]:
         """Open (or touch) this writer's fd and hold it safe from LRU
         eviction for the duration — a concurrent writer's eviction pass
         must never close an fd mid-write. Victim fds are closed OUTSIDE
@@ -332,7 +344,9 @@ class WalWriter:
         rec = _REC_HDR.pack(WAL_MAGIC, op, len(positions), zlib.crc32(payload))
         return self._write_framed(rec + payload)
 
-    def append_many(self, records) -> Optional[int]:
+    def append_many(
+        self, records: Iterable[Tuple[int, np.ndarray]]
+    ) -> Optional[int]:
         """Frame a batch of (op, positions) records and land them with ONE
         write + flush — an import call's set AND clear records hit the
         file together instead of interleaving two syscall round-trips
@@ -419,6 +433,15 @@ class WalSyncError(OSError):
 STATS = {"commits": 0, "commit_groups": 0, "fsyncs": 0, "sync_failures": 0}
 
 
+@race_checked(exclude=(
+    # stats is wired once by NodeServer between construction and traffic
+    # (init-before-publish); _syncer_wake is a threading.Event (its own
+    # internal lock); _defer is a threading.local (per-thread by
+    # construction — the barrier deferral is thread-confined state)
+    "stats",
+    "_syncer_wake",
+    "_defer",
+))
 class WalGroupCommit:
     """Leader/follower group commit across every open WAL writer (the
     CountBatcher shape, applied to fsync): appenders buffer their framed
@@ -443,7 +466,7 @@ class WalGroupCommit:
     Process-global, like DEVICE_CACHE: WAL files belong to the process,
     not to one in-process NodeServer."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._mu = TrackedLock("wal.commit_mu")
         self._cv = TrackedCondition(self._mu, name="wal.commit_cv")
         self._dirty: "OrderedDict[int, WalWriter]" = OrderedDict()
@@ -461,7 +484,7 @@ class WalGroupCommit:
         self._syncer_wake = threading.Event()
         self._oldest_mark: Optional[float] = None  # lag gauge (interval mode)
         self._defer = threading.local()
-        self.stats = None  # optional StatsClient (NodeServer wires its own)
+        self.stats: Any = None  # optional StatsClient (NodeServer wires its own)
 
     # -- configuration -----------------------------------------------------
 
@@ -540,7 +563,7 @@ class WalGroupCommit:
         self._wait_strict(token)
 
     @contextmanager
-    def barrier(self):
+    def barrier(self) -> Iterator[None]:
         """Coalesce every wait_durable on this thread into ONE group
         commit at exit (bulk imports: N fragments, one fsync round).
         Nested barriers fold into the outermost."""
@@ -720,7 +743,7 @@ def stats_snapshot() -> Dict[str, int]:
         return dict(STATS)
 
 
-def encode_records(records) -> bytes:
+def encode_records(records: Iterable[Tuple[int, np.ndarray]]) -> bytes:
     """Frame a batch of (op, positions) records with the WAL record codec
     into one byte string. This is also the WIRE format live-resize delta
     shipping uses (core/fragment.py drain_capture -> apply_transfer_records):

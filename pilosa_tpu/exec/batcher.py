@@ -28,6 +28,7 @@ from typing import Callable, Deque, Dict, List, Optional
 
 from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.pql import Call, Query
 
 
@@ -95,6 +96,14 @@ class _Waiter:
         self.cls = cls
 
 
+@race_checked(exclude=(
+    # wired once by NodeServer between construction and serving (init-
+    # before-publish handoff); hold_timeout is a test/operator knob
+    "load_hint",
+    "hold_timeout",
+    "stats",
+    "classify",
+))
 class CountBatcher:
     """Per-index group-commit batcher. `execute` is called with a merged
     Query and must return one result per call (the api layer binds it to

@@ -546,7 +546,10 @@ class DistributedExecutor(Executor):
 
     def _reduce(self, name: str, c: Call, partials: List[Any]) -> Any:
         partials = [p for p in partials if p is not None]
-        if name in ("Row", "Union", "Intersect", "Difference", "Xor", "Not", "Shift", "Range", "All"):
+        if name in (
+            "Row", "Union", "Intersect", "Difference", "Xor", "Not",
+            "Shift", "Range", "All",
+        ):
             return self._reduce_rows(partials)
         if name == "Count":
             return sum(int(p) for p in partials)

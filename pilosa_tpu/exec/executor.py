@@ -1040,7 +1040,12 @@ class Executor:
             memo[(id(c), shard)] = words
         return words
 
-    def _bitmap_call_shard_uncached(self, idx: Index, c: Call, shard: int, memo=None):
+    # dispatch-ok escapes below: per-shard fallback path — single-device
+    # row arrays (fragment.row_device), no mesh sharding, no collectives
+    # to rendezvous
+    def _bitmap_call_shard_uncached(  # dispatch-ok: per-shard path, single-device
+        self, idx: Index, c: Call, shard: int, memo=None
+    ):
         name = c.name
         if name in ("Row", "Range"):
             return self._row_shard(idx, c, shard)
@@ -1077,7 +1082,9 @@ class Executor:
             return self._existence_words(idx, shard)
         raise ExecError(f"unknown call: {name}")
 
-    def _nary_shard(self, idx: Index, c: Call, shard: int, op: str, memo=None):
+    def _nary_shard(  # dispatch-ok: per-shard path, single-device
+        self, idx: Index, c: Call, shard: int, op: str, memo=None
+    ):
         if not c.children:
             if op == "intersect":
                 raise ExecError("empty Intersect query is currently not supported")
@@ -1117,7 +1124,9 @@ class Executor:
             return out
         raise AssertionError(op)
 
-    def _not_shard(self, idx: Index, c: Call, shard: int, memo=None):
+    def _not_shard(  # dispatch-ok: per-shard path, single-device
+        self, idx: Index, c: Call, shard: int, memo=None
+    ):
         """Not via the existence field (executor.go:1734 executeNot)."""
         if not idx.track_existence:
             raise ExecError("Not() query requires existence tracking to be enabled")
@@ -1149,7 +1158,9 @@ class Executor:
             raise NotFoundError(f"field not found: {name}")
         return f
 
-    def _row_shard(self, idx: Index, c: Call, shard: int):
+    def _row_shard(  # dispatch-ok: per-shard path, single-device
+        self, idx: Index, c: Call, shard: int
+    ):
         if c.has_conditions():
             return self._row_bsi_shard(idx, c, shard)
         field_name = self._field_arg_name(c)
@@ -2687,7 +2698,7 @@ class Executor:
         with planmod.dispatch_mutex():
             return qgb.group_by_device(planes_list, child_rows, filt)
 
-    def _group_by_shard(
+    def _group_by_shard(  # dispatch-ok: per-shard path, single-device
         self, idx, child_fields, child_rows, filter_words, shard, merged
     ) -> None:
         """Nested cross-product with zero-count pruning (the reference's

@@ -116,7 +116,10 @@ def _cross_expand(acc, planes):
 _ONESHOT_READ_BYTES = 64 << 20
 
 
-def group_by_device(
+# dispatch-ok escapes below: the CALLER holds the mutex —
+# executor._group_by_stacked wraps the whole cross-tally pipeline in
+# plan.dispatch_mutex() (operands staged before entry)
+def group_by_device(  # dispatch-ok: caller holds dispatch_mutex
     planes_list: Sequence[jax.Array],
     row_lists: Sequence[Sequence[int]],
     filt: Optional[jax.Array] = None,
@@ -173,7 +176,7 @@ def group_by_device(
     return merged
 
 
-def _group_by_oneshot(
+def _group_by_oneshot(  # dispatch-ok: caller holds dispatch_mutex
     planes_list: Sequence[jax.Array],
     row_lists: Sequence[Sequence[int]],
     filt: Optional[jax.Array],
@@ -207,7 +210,7 @@ def _group_by_oneshot(
     return merged
 
 
-def _descend(
+def _descend(  # dispatch-ok: caller holds dispatch_mutex
     depth: int,
     acc: jax.Array,
     prefixes: List[Tuple[int, ...]],

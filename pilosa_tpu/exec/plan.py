@@ -198,7 +198,9 @@ class PZero(PNode):
 # ---------------------------------------------------------------------------
 
 
-def _eval_node(node: PNode, operands, scalars, shape, memo) -> jax.Array:
+def _eval_node(  # dispatch-ok: trace-time helper; inlines into _eval_jit's one program
+    node: PNode, operands, scalars, shape, memo
+) -> jax.Array:
     hit = memo.get(id(node))
     if hit is not None:
         return hit

@@ -26,8 +26,15 @@ from typing import Callable, Deque, Optional
 
 from pilosa_tpu.hbm import residency
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+from pilosa_tpu.utils.race import race_checked
 
 
+@race_checked(exclude=(
+    # offered/dropped are observability counters read lock-free by
+    # tests/gauges (GIL-atomic int adds under _mu on the write side)
+    "offered",
+    "dropped",
+))
 class Prefetcher:
     def __init__(self, depth: int = 4, logger: Optional[Callable] = None):
         if depth < 1:
@@ -47,10 +54,13 @@ class Prefetcher:
             if self._thread is not None:
                 return self
             self._closing = False
-            self._thread = threading.Thread(
+            # start via the local ref, not a re-read of self._thread
+            # outside the lock: a concurrent stop() could null the
+            # attribute between release and start (found by LOCK005)
+            t = self._thread = threading.Thread(
                 target=self._run, name="hbm-prefetch", daemon=True
             )
-        self._thread.start()
+        t.start()
         return self
 
     def stop(self) -> None:

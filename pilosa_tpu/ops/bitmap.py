@@ -57,7 +57,11 @@ def pack_positions(positions, n_bits: int = SHARD_WIDTH) -> np.ndarray:
         p = np.asarray(positions, dtype=np.uint64)
         if p.size and (p.max() >= n_bits):
             raise ValueError(f"position {p.max()} out of range for {n_bits} bits")
-        np.bitwise_or.at(words, (p >> 5).astype(np.int64), np.uint32(1) << (p & np.uint64(31)).astype(np.uint32))
+        np.bitwise_or.at(
+            words,
+            (p >> 5).astype(np.int64),
+            np.uint32(1) << (p & np.uint64(31)).astype(np.uint32),
+        )
     return words
 
 
@@ -103,7 +107,8 @@ def b_not(a, exists):
 
 
 # Count convention: one (row, shard) holds at most SHARD_WIDTH <= 2^30 bits
-# (shardwidth.py caps the exponent), so a per-row popcount always fits uint32. Cross-row / cross-shard totals can
+# (shardwidth.py caps the exponent), so a per-row popcount always fits
+# uint32. Cross-row / cross-shard totals can
 # exceed 2^32; the *_rows variants below are therefore the query-path API — the
 # executor reduces the per-row partials host-side in exact Python ints
 # (mirroring the reference's reduceFn merges, executor.go:2489), and the mesh
@@ -117,7 +122,7 @@ def _popcount_jnp(words) -> jnp.ndarray:
     return jnp.sum(lax_popcount_u32(words), dtype=jnp.uint32)
 
 
-def popcount(words) -> jnp.ndarray:
+def popcount(words) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     """Total set bits over ALL axes (uint32 scalar; wraps above 2^32 — use
     popcount_rows + host reduce for large stacks)."""
     if _USE_PALLAS:
@@ -130,7 +135,7 @@ def _popcount_rows_jnp(words) -> jnp.ndarray:
     return jnp.sum(lax_popcount_u32(words), axis=-1, dtype=jnp.uint32)
 
 
-def popcount_rows(words) -> jnp.ndarray:
+def popcount_rows(words) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     """Set bits per row: sums over the trailing word axis only."""
     if _USE_PALLAS and words.ndim == 2:
         return _pallas().popcount_rows(words)
@@ -146,7 +151,7 @@ def _count_and_jnp(a, b) -> jnp.ndarray:
     return jnp.sum(jax.lax.population_count(jnp.bitwise_and(a, b)), dtype=jnp.uint32)
 
 
-def count_and(a, b) -> jnp.ndarray:
+def count_and(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     """Fused popcount(a & b) — Count(Intersect(...)) without materializing
     the intersection (reference: intersectionCount, roaring.go:3121).
     All-axes uint32 sum; see count convention above."""
@@ -164,7 +169,7 @@ def _count_and_rows_jnp(a, b) -> jnp.ndarray:
     )
 
 
-def count_and_rows(a, b) -> jnp.ndarray:
+def count_and_rows(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     """Fused per-row intersection count (trailing axis reduced only)."""
     if _USE_PALLAS and a.ndim == 2 and getattr(b, "ndim", 1) == 1:
         return _pallas().count_and_rows(a, b)
@@ -202,7 +207,7 @@ def _count_andnot_jnp(a, b) -> jnp.ndarray:
     )
 
 
-def count_andnot(a, b) -> jnp.ndarray:
+def count_andnot(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     if _USE_PALLAS and getattr(a, "shape", None) == getattr(b, "shape", None):
         return _pallas().count_andnot(a, b)
     return _count_andnot_jnp(a, b)

@@ -99,20 +99,20 @@ def _count2(a, b, opname: str):
     return out[0, 0].astype(jnp.uint32)
 
 
-def count_and(a, b) -> jnp.ndarray:
+def count_and(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     """Fused popcount(a & b): Count(Intersect) in one HBM pass."""
     return _count2(a, b, "and")
 
 
-def count_or(a, b) -> jnp.ndarray:
+def count_or(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     return _count2(a, b, "or")
 
 
-def count_xor(a, b) -> jnp.ndarray:
+def count_xor(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     return _count2(a, b, "xor")
 
 
-def count_andnot(a, b) -> jnp.ndarray:
+def count_andnot(a, b) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     return _count2(a, b, "andnot")
 
 
@@ -185,12 +185,14 @@ def _rows_counts(stack, filt, masked: bool):
     return out[:r, 0].astype(jnp.uint32)
 
 
-def popcount_rows(stack) -> jnp.ndarray:
+def popcount_rows(stack) -> jnp.ndarray:  # dispatch-ok: wrapper; callers serialize (run_serialized)
     """Per-row set-bit counts for a [rows, W] stack."""
     return _rows_counts(stack, None, False)
 
 
-def count_and_rows(stack, filter_words) -> jnp.ndarray:
+def count_and_rows(  # dispatch-ok: wrapper; callers serialize (run_serialized)
+    stack, filter_words
+) -> jnp.ndarray:
     """Per-row popcount(row & filter): the TopN tally against a filter row."""
     return _rows_counts(stack, filter_words, True)
 

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.ops import bitmap as ob
 from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.race import race_checked
 
 # jax.shard_map graduated from jax.experimental in newer releases; support
 # both so the mesh step runs on the 0.4.x line this image ships.
@@ -136,63 +137,86 @@ def activate_default_mesh() -> Optional[Mesh]:
 # processes, other ICI domains — keep riding HTTP/DCN.
 # ---------------------------------------------------------------------------
 
-_GROUP_MU = TrackedLock("mesh.group_mu")
-_GROUP_MEMBERS: dict = {}  # group -> node_id -> holder
-_GROUP_GEN = 0  # bumps on every (un)register; group-index caches key on it
+@race_checked
+class MeshGroupRegistry:
+    """Process-local mesh-group membership: group -> node_id -> holder,
+    plus a generation counter caches key on. One instance per process
+    (module-global, like DEVICE_CACHE); every access goes through
+    `self._mu` — the registry is read on the query hot path by every
+    fan-out and written by NodeServer start/stop and topology installs,
+    concurrently, so it is one of the race detector's designated
+    shared objects."""
+
+    def __init__(self) -> None:
+        self._mu = TrackedLock("mesh.group_mu")
+        self._members: dict = {}  # group -> node_id -> holder
+        self._gen = 0  # bumps on every (un)register
+
+    def register(self, group: str, node_id: str, holder) -> None:
+        if not group:
+            return
+        with self._mu:
+            self._members.setdefault(group, {})[node_id] = holder
+            self._gen += 1
+
+    def unregister(self, group: str, node_id: str) -> None:
+        if not group:
+            return
+        with self._mu:
+            members = self._members.get(group)
+            if members is not None and members.pop(node_id, None) is not None:
+                self._gen += 1
+                if not members:
+                    del self._members[group]
+
+    def members(self, group: str) -> dict:
+        if not group:
+            return {}
+        with self._mu:
+            return dict(self._members.get(group, {}))
+
+    def group_of(self, node_id: str) -> str:
+        with self._mu:
+            for group, members in self._members.items():
+                if node_id in members:
+                    return group
+        return ""
+
+    def generation(self) -> int:
+        with self._mu:
+            return self._gen
 
 
-def _group_mu() -> TrackedLock:
-    return _GROUP_MU
+_GROUP_REGISTRY = MeshGroupRegistry()
 
 
 def register_group_member(group: str, node_id: str, holder) -> None:
     """Announce that `node_id`'s shards are reachable in-process through
     `holder` for mesh-group execution (NodeServer.start)."""
-    global _GROUP_GEN
-    if not group:
-        return
-    with _group_mu():
-        _GROUP_MEMBERS.setdefault(group, {})[node_id] = holder
-        _GROUP_GEN += 1
+    _GROUP_REGISTRY.register(group, node_id, holder)
 
 
 def unregister_group_member(group: str, node_id: str) -> None:
-    global _GROUP_GEN
-    if not group:
-        return
-    with _group_mu():
-        members = _GROUP_MEMBERS.get(group)
-        if members is not None and members.pop(node_id, None) is not None:
-            _GROUP_GEN += 1
-            if not members:
-                del _GROUP_MEMBERS[group]
+    _GROUP_REGISTRY.unregister(group, node_id)
 
 
 def group_members(group: str) -> dict:
     """node_id -> holder for every registered member of `group` (copy)."""
-    if not group:
-        return {}
-    with _group_mu():
-        return dict(_GROUP_MEMBERS.get(group, {}))
+    return _GROUP_REGISTRY.members(group)
 
 
 def registered_group_of(node_id: str) -> str:
     """The group `node_id` registered under in THIS process, or "" — used
     to enrich topology installs that predate a member's group config
     (server/node.py set_topology)."""
-    with _group_mu():
-        for group, members in _GROUP_MEMBERS.items():
-            if node_id in members:
-                return group
-    return ""
+    return _GROUP_REGISTRY.group_of(node_id)
 
 
 def group_generation() -> int:
     """Bumps whenever group membership changes; mesh-group operand caches
     (exec/meshgroup.py) key on it so a restarted member's stale holder is
     never read through a cached adapter."""
-    with _group_mu():
-        return _GROUP_GEN
+    return _GROUP_REGISTRY.generation()
 
 
 def stack_sharding(ndim: int) -> Optional[NamedSharding]:

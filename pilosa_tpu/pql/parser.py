@@ -368,11 +368,17 @@ class _Parser:
         # e.g. `5 < f <= 10`
         low = self._int64(self.regex(_COND_INT_RE))
         self.sp()
-        op1 = "<=" if self.try_lit("<=") else ("<" if self.try_lit("<") else self.fail("expected <"))
+        op1 = (
+            "<=" if self.try_lit("<=")
+            else ("<" if self.try_lit("<") else self.fail("expected <"))
+        )
         self.sp()
         fld = self.regex(_FIELD_RE)
         self.sp()
-        op2 = "<=" if self.try_lit("<=") else ("<" if self.try_lit("<") else self.fail("expected <"))
+        op2 = (
+            "<=" if self.try_lit("<=")
+            else ("<" if self.try_lit("<") else self.fail("expected <"))
+        )
         self.sp()
         high = self._int64(self.regex(_COND_INT_RE))
         self.sp()

@@ -29,10 +29,11 @@ from __future__ import annotations
 import time
 import weakref
 from collections import deque
-from typing import Callable, Deque, Dict, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from pilosa_tpu.sched.cost import QueryCost, ZERO_COST
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.utils.stats import Histogram
 
 # Request headers understood by the query routes. Priority selects the
@@ -59,9 +60,9 @@ CLASS_WEIGHTS: Dict[str, float] = {
 _live_controllers: "weakref.WeakSet[AdmissionController]" = weakref.WeakSet()
 
 
-def leaked_state() -> list:
+def leaked_state() -> List[Tuple[int, int, int]]:
     """(controller-id, queued, inflight) for every non-idle controller."""
-    out = []
+    out: List[Tuple[int, int, int]] = []
     for ctl in list(_live_controllers):
         queued, inflight = ctl.pending()
         if queued or inflight:
@@ -156,6 +157,12 @@ class _Entry:
         self.shed = False
 
 
+@race_checked(exclude=(
+    # wired once by NodeServer between construction and serving (init-
+    # before-publish handoff); never rebound under load
+    "prefetcher",
+    "stats",
+))
 class AdmissionController:
     def __init__(
         self,
@@ -164,7 +171,7 @@ class AdmissionController:
         byte_budget: int = 0,  # 0 = follow devcache's HBM budget
         default_class: str = CLASS_INTERACTIVE,
         retry_after: float = 1.0,
-        stats=None,
+        stats: Any = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if max_concurrent < 1:
@@ -450,7 +457,7 @@ class AdmissionController:
         batchable: bool,
         index: Optional[str],
         t0: float,
-        gauges: tuple,
+        gauges: Tuple[int, int, int, Dict[str, int]],
         leg: bool = False,
     ) -> Ticket:
         # stats I/O happens OUTSIDE the lock: with the statsd backend
@@ -612,7 +619,7 @@ class AdmissionController:
                 self.max_concurrent,
             )
 
-    def pending(self) -> tuple:
+    def pending(self) -> Tuple[int, int]:
         """(queued, inflight) across BOTH lanes (leak-guard surface)."""
         with self._cv:
             return (
@@ -620,7 +627,7 @@ class AdmissionController:
                 self._inflight + self._inflight_leg,
             )
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> Dict[str, Any]:
         with self._cv:
             return {
                 "inflight": self._inflight,
@@ -838,7 +845,9 @@ class AdmissionController:
                 for k, v in self._inflight_bytes_index.items()
             }
 
-    def _gauge_values_locked(self, index: Optional[str]) -> tuple:
+    def _gauge_values_locked(
+        self, index: Optional[str]
+    ) -> Tuple[int, int, int, Dict[str, int]]:
         # gauges cover BOTH lanes (like pending()): a node shedding legs
         # with "internal-leg queue full" must not look idle on /metrics.
         # The per-index slot carries ONLY the event's index — the one
@@ -848,7 +857,7 @@ class AdmissionController:
         # move other indexes' bytes too; each of those is emitted by its
         # own query's release, and the telemetry sampler publishes the
         # full map every tick regardless.
-        per_index = {}
+        per_index: Dict[str, int] = {}
         cur = self._inflight_bytes_index.get(index)
         if cur is not None:
             per_index[index if index is not None else "-"] = cur
@@ -865,7 +874,9 @@ class AdmissionController:
             per_index,
         )
 
-    def _emit_gauges(self, vals: tuple) -> None:
+    def _emit_gauges(
+        self, vals: Tuple[int, int, int, Dict[str, int]]
+    ) -> None:
         """Called WITHOUT the lock held (statsd emission is a syscall)."""
         if self.stats is None:
             return

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Any, Dict, Optional, Sequence, Set
 
 from pilosa_tpu.pql import Call, Query
 
@@ -125,7 +125,7 @@ class QueryCost:
 ZERO_COST = QueryCost()
 
 
-def _bsi_planes(idx, field_name: Optional[str]) -> int:
+def _bsi_planes(idx: Any, field_name: Optional[str]) -> int:
     """Plane stacks a BSI reference to `field_name` materializes:
     bit_depth magnitude planes + sign + existence."""
     if idx is not None and field_name:
@@ -136,7 +136,7 @@ def _bsi_planes(idx, field_name: Optional[str]) -> int:
     return _DEFAULT_BSI_PLANES
 
 
-def _call_rows(idx, c: Call) -> float:
+def _call_rows(idx: Any, c: Call) -> float:
     """Row-stack equivalents the call's operand set occupies."""
     if c.name in _WRITE_CALLS:
         return 0.0
@@ -164,7 +164,7 @@ def _call_rows(idx, c: Call) -> float:
     return rows
 
 
-def _referenced_fields(c: Call, out: set) -> None:
+def _referenced_fields(c: Call, out: Set[str]) -> None:
     """Field names a call tree touches (same extraction rules as the
     executor's _field_arg_name / condition args), for scoping the
     residency discount to views this query can actually reuse."""
@@ -181,7 +181,7 @@ def _referenced_fields(c: Call, out: set) -> None:
             _referenced_fields(v, out)
 
 
-def resident_bytes(idx, field_names: Optional[set] = None) -> int:
+def resident_bytes(idx: Any, field_names: Optional[Set[str]] = None) -> int:
     """Device bytes currently cached for `idx`'s views (row stacks, BSI
     plane extents, per-row arrays), summed by owner token — restricted
     to `field_names` when given, so a query is only discounted for views
@@ -205,7 +205,7 @@ def resident_bytes(idx, field_names: Optional[set] = None) -> int:
     return total
 
 
-def staged_merge_bytes(idx, field_names: Optional[set] = None) -> int:
+def staged_merge_bytes(idx: Any, field_names: Optional[Set[str]] = None) -> int:
     """Bytes of staged-but-unmaterialized ingest delta the next read
     barrier of this query's fields may have to merge (8-byte position
     keys, the merge working set — core/merge.py): raw pending buffers
@@ -231,7 +231,7 @@ def staged_merge_bytes(idx, field_names: Optional[set] = None) -> int:
     return total
 
 
-def _shard_count(idx, shards: Optional[Sequence[int]]) -> int:
+def _shard_count(idx: Any, shards: Optional[Sequence[int]]) -> int:
     if shards is not None:
         return max(1, len(shards))
     if idx is not None:
@@ -248,7 +248,7 @@ _ROW_RESULT_CALLS = frozenset(
 )
 
 
-def _transport_estimate(calls, transport: dict) -> float:
+def _transport_estimate(calls: Sequence[Call], transport: Dict[str, Any]) -> float:
     """Price a query's transport from the executor's fan-out split
     (exec/distributed.py transport_profile): mesh-local shards fold into
     an ICI collective, cross-group legs ship partials over DCN. A
@@ -279,11 +279,11 @@ def _transport_estimate(calls, transport: dict) -> float:
 
 
 def estimate(
-    idx,
-    query,
+    idx: Any,
+    query: Any,
     shards: Optional[Sequence[int]] = None,
     shard_count: Optional[int] = None,
-    transport: Optional[dict] = None,
+    transport: Optional[Dict[str, Any]] = None,
 ) -> QueryCost:
     """Estimate `query` (a parsed Query/Call, or raw PQL text) against
     index object `idx` (may be None — e.g. not created yet).
@@ -327,7 +327,7 @@ def estimate(
             # cached-resident discount: operands already in HBM stage for
             # free, so don't charge the byte account for them twice —
             # scoped to the fields THIS query references
-            touched: set = set()
+            touched: Set[str] = set()
             for c in calls:
                 _referenced_fields(c, touched)
             if touched:

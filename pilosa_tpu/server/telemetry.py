@@ -39,6 +39,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.utils.stats import Registry
 
 # peer stats/timeline fetches are interactive-dashboard traffic: fail
@@ -59,6 +60,7 @@ def _fan_out(members, fn) -> list:
         return list(pool.map(fn, members))
 
 
+@race_checked
 class TimelineSampler:
     """Bounded ring of periodic utilization snapshots for ONE node.
 

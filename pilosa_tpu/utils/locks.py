@@ -47,6 +47,7 @@ __all__ = [
     "checking_enabled",
     "enable_checking",
     "disable_checking",
+    "held_info",
     "violations",
     "warnings",
     "reset",
@@ -297,6 +298,17 @@ def _current_stack() -> str:
 
 def checking_enabled() -> bool:
     return _enabled
+
+
+def held_info() -> Tuple[Tuple[int, str], ...]:
+    """(lock-instance id, lock-class name) for every tracked lock the
+    CURRENT thread holds, outermost first. This is the lockset feed for
+    the Eraser-style race detector (utils/race.py): instance ids — not
+    class names — because two threads holding two different instances
+    of "fragment.mu" share no mutual exclusion. Empty when checking is
+    disabled (raw passthrough locks are invisible by design)."""
+    held = _state.held()
+    return tuple((id(h.lock), h.name) for h in held)
 
 
 def enable_checking() -> None:

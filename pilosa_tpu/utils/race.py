@@ -1,0 +1,345 @@
+"""Runtime lockset race detector (Eraser-style) for designated shared objects.
+
+The static guarded-by pass (pilosa_tpu/analysis/guarded_by.py) checks what
+the AST can see; this module checks what actually HAPPENS: instances of
+`@race_checked` classes have their attribute reads/writes fed through the
+classic Eraser state machine [Savage et al., SOSP '97]:
+
+    virgin -> exclusive(first thread) -> shared -> shared-modified
+
+with a per-(instance, attribute) candidate lockset C(v). Once a second
+thread touches an attribute, every access intersects C(v) with the set of
+tracked locks the accessing thread holds (utils/locks.py `held_info` — by
+lock INSTANCE, so two fragments' separate "fragment.mu" locks do not
+mutually exclude). An access that finds C(v) empty while the attribute is
+in the shared-modified state is a CANDIDATE RACE: no lock consistently
+protected an attribute that at least two threads access with at least one
+writer. The report carries BOTH stacks — the last conflicting access from
+another thread and the access that emptied the set.
+
+Refinements over textbook Eraser (tuned to this codebase's conventions):
+
+* **ownership transfer**: the write that FIRST moves an attribute out of
+  the exclusive state does not itself report — init-in-thread-A, publish,
+  configure-in-thread-B is the standard NodeServer boot shape. The
+  detector arms at that write; any LATER lock-free access conflicts.
+* **read-only sharing never reports** (state `shared`): a config attr
+  written before publish and read forever after is correct without locks.
+* one report per (instance, attribute): the first candidate is the
+  evidence; repeats would bury it.
+
+Zero overhead when off: `@race_checked` returns the class untouched
+unless `PILOSA_TPU_RACE_CHECK=1` was set at import (the same pattern as
+`PILOSA_TPU_LOCK_CHECK`). The dedicated CI job runs the concurrency-heavy
+test subset with both flags on; tests/conftest.py carries an autouse
+guard that fails any test recording a candidate race (and the lockset
+feed REQUIRES the lock checker: raw passthrough locks are invisible, so
+race.py enables lock checking when the race flag is on).
+
+Escapes: `@race_checked(exclude=("attr", ...))` exempts attributes whose
+lock-free access is by design (GIL-atomic counters snapshotted by gauges,
+flags made benign by an ordering argument). Every exclude in the tree
+carries a comment saying WHY — the runtime mirror of the static pass's
+`# lock-free: <reason>` annotation (docs/development.md "Concurrency
+contracts").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from pilosa_tpu.utils import locks
+
+__all__ = [
+    "race_checked",
+    "RaceReport",
+    "enabled",
+    "reports",
+    "drain",
+    "reset",
+    "format_report",
+    "instrument_class",
+]
+
+_STACK_LIMIT = 14
+
+# states of the per-(instance, attribute) tracker
+VIRGIN = 0
+EXCLUSIVE = 1
+SHARED = 2
+SHARED_MODIFIED = 3
+
+_STATE_NAMES = {
+    VIRGIN: "virgin",
+    EXCLUSIVE: "exclusive",
+    SHARED: "shared",
+    SHARED_MODIFIED: "shared-modified",
+}
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PILOSA_TPU_RACE_CHECK", "") == "1"
+
+
+_enabled = _env_enabled()
+
+if _enabled:
+    # the lockset feed is the lock checker's per-thread held list; with
+    # checking off every lock is a raw passthrough and every lockset
+    # would be empty — i.e. everything would look like a race
+    locks.enable_checking()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@dataclass(frozen=True)
+class RaceReport:
+    """One candidate race: `attr` of a `cls` instance reached the
+    shared-modified state with an empty candidate lockset."""
+
+    cls: str
+    attr: str
+    message: str
+    stack_a: str  # last access from a conflicting thread
+    stack_b: str  # the access that emptied the lockset
+    thread_a: str
+    thread_b: str
+
+    def render(self) -> str:
+        out = [f"[candidate-race] {self.message}"]
+        if self.stack_a:
+            out.append(f"--- prior access (thread {self.thread_a!r}) ---")
+            out.append(self.stack_a.rstrip())
+        if self.stack_b:
+            out.append(f"--- conflicting access (thread {self.thread_b!r}) ---")
+            out.append(self.stack_b.rstrip())
+        return "\n".join(out)
+
+
+@dataclass
+class _AttrState:
+    state: int = VIRGIN
+    owner: Optional[int] = None  # thread ident while exclusive
+    lockset: Optional[FrozenSet[int]] = None
+    lock_names: Tuple[str, ...] = ()
+    # last access by ANY thread: (thread name, ident, was_write, stack)
+    last: Optional[Tuple[str, int, bool, str]] = None
+    reported: bool = False
+    # the shared-modified transition access itself is exempt (ownership
+    # transfer); armed becomes True once shared-modified state existed
+    # BEFORE the current access
+    armed: bool = False
+
+
+class _Log:
+    def __init__(self) -> None:
+        self.mu = threading.Lock()  # internal; never user-visible
+        self.reports: List[RaceReport] = []
+
+
+_log = _Log()
+
+
+def reports() -> List[RaceReport]:
+    with _log.mu:
+        return list(_log.reports)
+
+
+def drain() -> List[RaceReport]:
+    """Return AND clear the recorded reports (seeded-violation tests use
+    this so their intentional races don't trip the conftest guard)."""
+    with _log.mu:
+        out = list(_log.reports)
+        _log.reports.clear()
+        return out
+
+
+def reset() -> None:
+    with _log.mu:
+        _log.reports.clear()
+
+
+def format_report() -> str:
+    rs = reports()
+    if not rs:
+        return "race check: clean"
+    return "\n\n".join(r.render() for r in rs)
+
+
+def _current_stack() -> str:
+    frames = traceback.extract_stack(limit=_STACK_LIMIT + 4)
+    while frames and frames[-1].filename == __file__:
+        frames.pop()
+    return "".join(traceback.format_list(frames[-_STACK_LIMIT:]))
+
+
+def _record(report: RaceReport) -> None:
+    with _log.mu:
+        _log.reports.append(report)
+
+
+class _Tracker:
+    """Per-instance attribute state table. Lives on the instance under a
+    name the instrumentation skips; its own mutex is internal (never part
+    of any lockset)."""
+
+    __slots__ = ("mu", "attrs", "cls_name")
+
+    def __init__(self, cls_name: str) -> None:
+        self.mu = threading.Lock()
+        self.attrs: Dict[str, _AttrState] = {}
+        self.cls_name = cls_name
+
+    def access(self, attr: str, is_write: bool) -> None:
+        ident = threading.get_ident()
+        held = locks.held_info()
+        lock_ids = frozenset(i for i, _n in held)
+        with self.mu:
+            st = self.attrs.get(attr)
+            if st is None:
+                st = self.attrs[attr] = _AttrState()
+            if st.state == VIRGIN:
+                st.state = EXCLUSIVE
+                st.owner = ident
+                st.last = (
+                    threading.current_thread().name, ident, is_write, "",
+                )
+                return
+            if st.state == EXCLUSIVE:
+                if st.owner == ident:
+                    st.last = (
+                        threading.current_thread().name, ident, is_write, "",
+                    )
+                    return
+                # second thread: leave exclusive; candidate lockset
+                # initializes from THIS access's held set
+                st.lockset = lock_ids
+                st.lock_names = tuple(n for _i, n in held)
+                if is_write:
+                    # ownership transfer: don't report the handoff write
+                    # itself — arm, and let any later access conflict
+                    st.state = SHARED_MODIFIED
+                else:
+                    st.state = SHARED
+                st.last = (
+                    threading.current_thread().name, ident, is_write,
+                    _current_stack(),
+                )
+                return
+            # shared / shared-modified: intersect and maybe report
+            was_armed = st.state == SHARED_MODIFIED
+            assert st.lockset is not None
+            st.lockset = st.lockset & lock_ids
+            if is_write:
+                st.state = SHARED_MODIFIED
+            prior = st.last
+            st.last = (
+                threading.current_thread().name, ident, is_write,
+                _current_stack(),
+            )
+            if (
+                not st.reported
+                and not st.lockset
+                and st.state == SHARED_MODIFIED
+                and (was_armed or is_write)
+                and prior is not None
+                and prior[1] != ident
+            ):
+                st.reported = True
+                kind = "write" if is_write else "read"
+                _record_outside = RaceReport(
+                    cls=self.cls_name,
+                    attr=attr,
+                    message=(
+                        f"{self.cls_name}.{attr}: {kind} with no "
+                        "consistently-held lock while the attribute is "
+                        f"{_STATE_NAMES[st.state]} (accessed by at least "
+                        "two threads with at least one writer; candidate "
+                        "lockset is empty)"
+                    ),
+                    stack_a=prior[3],
+                    stack_b=st.last[3],
+                    thread_a=prior[0],
+                    thread_b=st.last[0],
+                )
+            else:
+                return
+        _record(_record_outside)
+
+
+_TRACKER_ATTR = "__race_tracker__"
+
+
+def _instrumented(cls: type, exclude: FrozenSet[str]) -> type:
+    """Install get/set instrumentation on `cls` in place and return it.
+    Special names (dunders, the tracker slot, lock-ish attributes) and
+    `exclude` are skipped. Methods resolved through the class are reads
+    of code, not state — skipped via a class-attribute probe."""
+    skip = set(exclude)
+    skip.add(_TRACKER_ATTR)
+    orig_getattribute = cls.__getattribute__
+    orig_setattr = cls.__setattr__
+
+    # names that resolve on the CLASS (methods, class attrs, properties,
+    # slots descriptors) are not per-instance shared state; per-instance
+    # data attrs shadow none of them in the hot classes we instrument
+    def _is_state_attr(name: str) -> bool:
+        if name.startswith("__") or name in skip:
+            return False
+        # lock/condition attributes are the synchronization fabric
+        # itself: reading self._mu to acquire it is not a data access
+        if name.endswith(("_mu", "_cv", "_lock", "_cond", "mu", "lock")):
+            return False
+        return True
+
+    def _tracker(self: object) -> _Tracker:
+        try:
+            return object.__getattribute__(self, _TRACKER_ATTR)
+        except AttributeError:
+            t = _Tracker(cls.__name__)
+            object.__setattr__(self, _TRACKER_ATTR, t)
+            return t
+
+    def __getattribute__(self: object, name: str):  # noqa: N807
+        if _is_state_attr(name) and name not in type(self).__dict__:
+            _tracker(self).access(name, is_write=False)
+        return orig_getattribute(self, name)
+
+    def __setattr__(self: object, name: str, value: object) -> None:  # noqa: N807
+        if _is_state_attr(name):
+            _tracker(self).access(name, is_write=True)
+        orig_setattr(self, name, value)
+
+    cls.__getattribute__ = __getattribute__  # type: ignore[method-assign]
+    cls.__setattr__ = __setattr__  # type: ignore[method-assign]
+    return cls
+
+
+def instrument_class(cls: type, exclude: Tuple[str, ...] = ()) -> type:
+    """Force-instrument `cls` regardless of the env flag (unit tests).
+    Production code uses `@race_checked`, which is a no-op unless
+    PILOSA_TPU_RACE_CHECK=1."""
+    return _instrumented(cls, frozenset(exclude))
+
+
+def race_checked(cls: Optional[type] = None, *, exclude: Tuple[str, ...] = ()):
+    """Class decorator marking a designated shared object for lockset
+    race checking. Bare (`@race_checked`) or parameterized
+    (`@race_checked(exclude=("hits",))`). Returns the class UNCHANGED
+    when checking is off — zero steady-state overhead, like the
+    TrackedLock factories."""
+
+    def wrap(c: type) -> type:
+        if not _enabled:
+            return c
+        return _instrumented(c, frozenset(exclude))
+
+    if cls is not None:
+        return wrap(cls)
+    return wrap

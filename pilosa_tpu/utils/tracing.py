@@ -38,6 +38,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
 from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.race import race_checked
 
 # ---------------------------------------------------------------------------
 # Span-name registry. Every span name the package starts MUST be declared
@@ -181,6 +182,14 @@ class Span:
         return s
 
 
+@race_checked(exclude=(
+    # sample_rate/keep/node are set at construction (or by tests before
+    # traffic); the rng is only touched for ROOT sampling decisions and
+    # python's Random is internally locked
+    "sample_rate",
+    "keep",
+    "node",
+))
 class Tracer:
     """In-memory ring-buffer tracer (the default).
 

@@ -28,6 +28,7 @@ import numpy as np
 import pytest
 
 from pilosa_tpu.utils import locks
+from pilosa_tpu.utils import race
 
 
 @pytest.fixture
@@ -50,6 +51,28 @@ def _lock_discipline_guard():
         report = "\n\n".join(v.render() for v in vs)
         pytest.fail(
             f"lock discipline violated ({len(vs)} finding(s)):\n{report}",
+            pytrace=False,
+        )
+
+
+@pytest.fixture(autouse=True)
+def _race_guard():
+    """Fail any test whose execution recorded a candidate data race on a
+    @race_checked class (Eraser lockset state machine, utils/race.py).
+    Active only under PILOSA_TPU_RACE_CHECK=1 — the dedicated CI job
+    runs the concurrency-heavy subset with it; plain tier-1 pays zero
+    overhead. Tests that seed races on purpose drain() them before
+    returning."""
+    if not race.enabled():
+        yield
+        return
+    before = len(race.reports())
+    yield
+    rs = race.reports()[before:]
+    if rs:
+        report = "\n\n".join(r.render() for r in rs)
+        pytest.fail(
+            f"candidate data race(s) recorded ({len(rs)}):\n{report}",
             pytrace=False,
         )
 

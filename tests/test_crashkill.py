@@ -359,6 +359,13 @@ def test_group_commit_coalesces_concurrent_imports(tmp_path):
         h.close()
 
 
+@pytest.mark.skipif(
+    os.environ.get("PILOSA_TPU_RACE_CHECK") == "1",
+    reason="latency-budget assertion: the race checker's attribute "
+    "instrumentation adds per-access overhead that blows the 2x-bare-"
+    "fsync bound by design; the functional fsync-count assertions are "
+    "covered by the rest of the matrix under the checker",
+)
 def test_solo_writer_strict_no_hold_window(tmp_path):
     """A solo strict-mode writer pays exactly one fsync round per import
     (the leader fires immediately — group commit adds no hold window)

@@ -3,7 +3,13 @@
 import numpy as np
 import pytest
 
-from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_TIME, FieldOptions
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_TIME,
+    FieldOptions,
+)
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.exec import ExecOptions, Executor
 from pilosa_tpu.exec.executor import ExecError, GroupCount, Pair, ValCount

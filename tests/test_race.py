@@ -1,0 +1,445 @@
+"""Runtime lockset race detector (pilosa_tpu/utils/race.py) units +
+the two historical-incident regressions.
+
+The unit half drives the Eraser state machine deterministically with
+events (never sleeps, no timing dependence): virgin -> exclusive ->
+shared -> shared-modified transitions, the candidate-lockset
+intersection, both-stack reports, annotation escapes, and the
+zero-overhead passthrough contract.
+
+The regression half reproduces, seeded-violation style, the two
+concurrency incidents this gate exists to re-prevent:
+
+* **PR 10** — the unserialized tally dispatch: the TopN tally called the
+  compiled cross-counts program directly from fan-out leg threads; with
+  mesh-sharded operands the program carries collectives and concurrent
+  entry parked XLA-CPU's rendezvous. The fix routed every non-plan
+  compiled dispatch through `plan.run_serialized`. Here the PRE-fix call
+  shape is seeded into an exec/-scoped module and the static LOCK006
+  rule must flag it; the POST-fix shape must pass.
+* **PR 11** — the close-vs-commit-round ack race: `WalWriter.close()`
+  sets `_closed` under the LRU lock while an in-flight commit round
+  reads it under the commit lock — no common lock, so a round could
+  observe a stale value, skip the writer, and ack bytes never fsynced.
+  The fix made close() fsync UNCONDITIONALLY, which keeps the lock-free
+  flag read but makes it harmless (the real `WalWriter` carries a
+  race-check exclude citing exactly that). Here the PRE-fix decision
+  structure is modeled and the runtime detector must record the race;
+  the common-lock (race-free) structure must stay silent.
+"""
+
+import ast
+import textwrap
+import threading
+
+import pytest
+
+from pilosa_tpu import analysis
+from pilosa_tpu.analysis.framework import Module
+from pilosa_tpu.utils import locks, race
+
+
+def _seeded(rel: str, src: str) -> Module:
+    src = textwrap.dedent(src)
+    return Module(path="/tmp/" + rel, rel=rel, source=src, tree=ast.parse(src))
+
+
+def _drain():
+    return race.drain()
+
+
+def _fresh_class():
+    cls = type("Shared", (), {})
+    return race.instrument_class(cls)
+
+
+def _run(thread_fn, name="peer"):
+    t = threading.Thread(target=thread_fn, name=name)
+    t.start()
+    t.join(5.0)
+    assert not t.is_alive()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+
+class TestStateMachine:
+    def test_single_thread_stays_exclusive_and_silent(self):
+        cls = _fresh_class()
+        o = cls()
+        for i in range(5):
+            o.x = i
+            _ = o.x
+        assert _drain() == []
+
+    def test_read_only_sharing_never_reports(self):
+        cls = _fresh_class()
+        o = cls()
+        o.x = 1  # exclusive in this thread
+
+        def reader():
+            for _ in range(3):
+                _ = o.x  # shared, read-only: empty lockset is fine
+
+        _run(reader)
+        _ = o.x
+        assert _drain() == []
+
+    def test_write_write_no_common_lock_reports(self):
+        cls = _fresh_class()
+        o = cls()
+        mu_b = locks.TrackedLock("race_test.mu_b")
+        o.x = 1  # virgin -> exclusive(main)
+
+        def writer_b():
+            with mu_b:
+                o.x = 2  # exclusive -> shared-modified, lockset={mu_b}
+
+        _run(writer_b, name="writer-b")
+        o.x = 3  # no lock: lockset empties in shared-modified -> report
+        reports = _drain()
+        assert len(reports) == 1
+        r = reports[0]
+        assert r.attr == "x"
+        assert "shared-modified" in r.message
+
+    def test_common_lock_keeps_lockset_nonempty(self):
+        cls = _fresh_class()
+        o = cls()
+        mu = locks.TrackedLock("race_test.mu_common")
+        with mu:
+            o.x = 1
+
+        def writer_b():
+            with mu:
+                o.x = 2
+
+        _run(writer_b)
+        with mu:
+            o.x = 3
+            _ = o.x
+        assert _drain() == []
+
+    def test_ownership_transfer_write_does_not_itself_report(self):
+        # init in thread A, configure once in thread B (the NodeServer
+        # boot shape): the handoff write alone must not fire
+        cls = _fresh_class()
+        o = cls()
+        o.x = 1
+
+        def configure():
+            o.x = 2  # lock-free handoff: arms, does not report
+
+        _run(configure)
+        assert _drain() == []
+
+    def test_read_after_armed_conflict_reports(self):
+        cls = _fresh_class()
+        o = cls()
+        mu_b = locks.TrackedLock("race_test.mu_read")
+        o.x = 1
+
+        def writer_b():
+            with mu_b:
+                o.x = 2
+
+        _run(writer_b, name="armed-writer")
+        _ = o.x  # bare READ against a shared-modified attr -> report
+        reports = _drain()
+        assert len(reports) == 1
+        assert "read with no consistently-held lock" in reports[0].message
+
+    def test_one_report_per_attribute(self):
+        cls = _fresh_class()
+        o = cls()
+        mu_b = locks.TrackedLock("race_test.mu_once")
+        o.x = 1
+
+        def writer_b():
+            with mu_b:
+                o.x = 2
+
+        _run(writer_b)
+        for i in range(4):
+            o.x = 10 + i
+        assert len(_drain()) == 1
+
+
+# ---------------------------------------------------------------------------
+# reports carry both stacks
+# ---------------------------------------------------------------------------
+
+
+class TestReports:
+    def test_both_conflicting_stacks_recorded(self):
+        cls = _fresh_class()
+        o = cls()
+        mu_b = locks.TrackedLock("race_test.mu_stacks")
+        o.x = 1
+
+        def the_armed_writer_site():
+            with mu_b:
+                o.x = 2
+
+        def peer():
+            the_armed_writer_site()
+
+        _run(peer, name="stack-peer")
+
+        def the_conflicting_site():
+            o.x = 3
+
+        the_conflicting_site()
+        (r,) = _drain()
+        assert "the_armed_writer_site" in r.stack_a
+        assert "the_conflicting_site" in r.stack_b
+        assert r.thread_a == "stack-peer"
+        assert r.thread_b != r.thread_a
+
+    def test_format_report_renders_both_sites(self):
+        cls = _fresh_class()
+        o = cls()
+        o.x = 1
+
+        def w():
+            o.x = 2
+
+        _run(w)
+        o.x = 3
+        try:
+            txt = race.format_report()
+            assert "candidate-race" in txt
+            assert "prior access" in txt and "conflicting access" in txt
+        finally:
+            _drain()
+
+
+# ---------------------------------------------------------------------------
+# annotation escapes + passthrough
+# ---------------------------------------------------------------------------
+
+
+class TestEscapesAndOverhead:
+    def test_exclude_exempts_attribute(self):
+        cls = race.instrument_class(type("Excl", (), {}), exclude=("x",))
+        o = cls()
+        o.x = 1
+        o.y = 1
+
+        def w():
+            o.x = 2
+            o.y = 2
+
+        _run(w)
+        o.x = 3
+        o.y = 3
+        reports = _drain()
+        assert [r.attr for r in reports] == ["y"]  # x escaped, y caught
+
+    def test_lockish_attributes_never_tracked(self):
+        cls = _fresh_class()
+        o = cls()
+        o._mu = locks.TrackedLock("race_test.self_mu")
+
+        def w():
+            _ = o._mu  # reading the lock attribute is not a data access
+
+        _run(w)
+        o._mu = locks.TrackedLock("race_test.self_mu2")
+        assert _drain() == []
+
+    @pytest.mark.skipif(
+        race.enabled(), reason="passthrough contract only observable off"
+    )
+    def test_decorator_is_passthrough_when_disabled(self):
+        class C:
+            pass
+
+        assert race.race_checked(C) is C
+        assert "__getattribute__" not in C.__dict__
+        assert "__setattr__" not in C.__dict__
+
+        class D:
+            pass
+
+        assert race.race_checked(exclude=("x",))(D) is D
+        assert "__getattribute__" not in D.__dict__
+
+    def test_drain_clears_the_log(self):
+        cls = _fresh_class()
+        o = cls()
+        o.x = 1
+
+        def w():
+            o.x = 2
+
+        _run(w)
+        o.x = 3
+        assert len(race.drain()) == 1
+        assert race.reports() == []
+        assert race.format_report() == "race check: clean"
+
+
+# ---------------------------------------------------------------------------
+# historical regression: PR 11 close-vs-commit-round ack race
+# ---------------------------------------------------------------------------
+
+
+class _ModelWalWriter:
+    """Structural model of the PR-11 incident: `_closed` written by
+    close() under the LRU lock, read by the commit round under the
+    commit lock. Pre-fix, the round's stale read decided whether acked
+    bytes were ever fsynced."""
+
+    def __init__(self):
+        self.closed_flag = False
+        self.acked_unsynced = False
+
+
+def _drive_close_vs_commit(writer_cls, close_lock, commit_lock):
+    """Deterministic interleaving: round reads -> close writes -> round
+    re-reads (the commit loop re-checks every round)."""
+    w = writer_cls()
+    round_saw = threading.Event()
+    closed = threading.Event()
+    done = threading.Event()
+
+    def commit_round():
+        with commit_lock:
+            _ = w.closed_flag  # round 1: writer looks open
+        round_saw.set()
+        closed.wait(5.0)
+        with commit_lock:
+            if not w.closed_flag:  # round 2: the racy skip decision
+                w.acked_unsynced = True
+        done.set()
+
+    t = threading.Thread(target=commit_round, name="commit-round")
+    t.start()
+    assert round_saw.wait(5.0)
+    with close_lock:
+        w.closed_flag = True  # close(): the conflicting write
+    closed.set()
+    assert done.wait(5.0)
+    t.join(5.0)
+
+
+class TestPR11CloseVsCommitAckRace:
+    def test_reverted_fix_is_caught_by_the_detector(self):
+        """The pre-fix structure — close under lru_mu, round under
+        commit_mu, NO common lock — must record a candidate race on the
+        flag that gates the ack."""
+        cls = race.instrument_class(
+            type("ModelWalWriterReverted", (_ModelWalWriter,), {}),
+        )
+        _drive_close_vs_commit(
+            cls,
+            close_lock=locks.TrackedLock("race_test.wal.lru_mu"),
+            commit_lock=locks.TrackedLock("race_test.wal.commit_mu"),
+        )
+        reports = _drain()
+        assert any(r.attr == "closed_flag" for r in reports), reports
+
+    def test_fixed_structure_is_silent(self):
+        """With the decision taken under ONE mutex (the semantic effect
+        of the real fix: close() fsyncs unconditionally, so the ack no
+        longer depends on a cross-lock read), the detector stays quiet."""
+        one_mu = locks.TrackedLock("race_test.wal.one_mu")
+        cls = race.instrument_class(
+            type("ModelWalWriterFixed", (_ModelWalWriter,), {}),
+        )
+        _drive_close_vs_commit(cls, close_lock=one_mu, commit_lock=one_mu)
+        assert _drain() == []
+
+    def test_real_walwriter_documents_the_benign_race(self):
+        """The real WalWriter must carry the `_closed` race exclude —
+        deleting it without re-proving the close() fix would let the
+        CI race job miss a regression of this exact incident."""
+        import inspect
+
+        from pilosa_tpu.core import wal
+
+        src = inspect.getsource(wal)
+        deco = src.split("class WalWriter", 1)[0].rsplit("@race_checked", 1)[1]
+        assert '"_closed"' in deco
+
+
+# ---------------------------------------------------------------------------
+# historical regression: PR 10 unserialized tally dispatch (static LOCK006)
+# ---------------------------------------------------------------------------
+
+
+_PRE_FIX_TALLY = """
+    import jax
+
+    @jax.jit
+    def _counts_cross(src, planes):
+        return src
+
+    def tally(parts, src, planes, n, n_present):
+        # PR-10 incident shape: compiled tally dispatched directly from a
+        # fan-out leg thread, no run_serialized, no dispatch mutex
+        parts.append(_counts_cross(src[None], planes)[0][:n, :n_present])
+"""
+
+_POST_FIX_TALLY = """
+    import jax
+    from pilosa_tpu.exec import plan as planmod
+
+    @jax.jit
+    def _counts_cross(src, planes):
+        return src
+
+    def tally(parts, src, planes, n, n_present):
+        parts.append(
+            planmod.run_serialized(
+                lambda: _counts_cross(src[None], planes)[0][:n, :n_present]
+            )
+        )
+"""
+
+
+class TestPR10UnserializedTallyDispatch:
+    def _lock006(self, src: str):
+        m = _seeded("pilosa_tpu/exec/_seeded_tally.py", src)
+        fs = analysis.run_passes([analysis.LockHygienePass()], [m])
+        return [f for f in fs if f.code == "LOCK006"]
+
+    def test_reverted_fix_is_caught_by_lock006(self):
+        found = self._lock006(_PRE_FIX_TALLY)
+        assert found, "the PR-10 incident shape must fail the gate"
+        assert "_counts_cross" in found[0].message
+        assert "PR-10" in found[0].message
+
+    def test_fix_restored_passes(self):
+        assert self._lock006(_POST_FIX_TALLY) == []
+
+    def test_cross_module_revert_is_caught_too(self):
+        """The same revert expressed against the REAL groupby module:
+        a seeded exec/ caller invoking groupby's jitted cross-counts
+        directly is flagged via cross-module jit discovery."""
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        gb = analysis.load_source_module(
+            os.path.join(repo, "pilosa_tpu", "exec", "groupby.py"),
+            rel="pilosa_tpu/exec/groupby.py",
+        )
+        caller = _seeded(
+            "pilosa_tpu/exec/_seeded_caller.py",
+            """
+            from pilosa_tpu.exec import groupby as gb
+
+            def tally(src, planes):
+                return gb._counts_cross(src[None], planes)
+            """,
+        )
+        fs = analysis.run_passes([analysis.LockHygienePass()], [gb, caller])
+        assert any(
+            f.code == "LOCK006"
+            and f.path == "pilosa_tpu/exec/_seeded_caller.py"
+            for f in fs
+        )

@@ -168,6 +168,534 @@ class TestLockHygieneSeeded:
 
 
 # ---------------------------------------------------------------------------
+# guarded-by inference (LOCK004/LOCK005) on seeded violations
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedBySeeded:
+    def test_mixed_write_fires_lock004(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._items = {}
+
+                def add(self, k, v):
+                    with self._mu:
+                        self._items[k] = v
+
+                def replace(self, items):
+                    with self._mu:
+                        self._items = dict(items)
+
+                def rogue(self):
+                    self._items = {}   # line 18: bare write
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK004"]
+        assert f.line == 18
+        assert "C._items" in f.message and "'_mu'" in f.message
+
+    def test_init_writes_are_exempt(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._a = {}
+                    self._a["k"] = 1   # constructor: pre-publication
+
+                def w1(self, v):
+                    with self._mu:
+                        self._a["x"] = v
+
+                def w2(self, v):
+                    with self._mu:
+                        self._a["y"] = v
+            """
+        )
+        assert not [f for f in fs if f.code in ("LOCK004", "LOCK005")]
+
+    def test_single_write_site_claims_no_guard(self):
+        # MIN_GUARDED_WRITES: one agreeing site is too little signal
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+
+                def a(self, v):
+                    with self._mu:
+                        self._x = v
+
+                def b(self, v):
+                    self._x = v
+            """
+        )
+        assert not [f for f in fs if f.code == "LOCK004"]
+
+    def test_bare_read_in_lock_taking_method_fires_lock005(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._n = 0
+
+                def bump(self):
+                    with self._mu:
+                        self._n += 1
+
+                def bump2(self):
+                    with self._mu:
+                        self._n += 1
+
+                def peek_then_lock(self):
+                    n = self._n        # line 18: bare read...
+                    with self._mu:     # ...in a method that takes _mu
+                        self._n += 1
+                    return n
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK005"]
+        assert f.line == 18
+        assert "peek_then_lock" in f.message
+
+    def test_bare_read_in_lockless_method_not_flagged(self):
+        # LOCK005 scopes to methods that ELSEWHERE take the lock: a
+        # gauge-snapshot method that never does is inference-silent
+        # (the runtime race detector owns that territory)
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._n = 0
+
+                def bump(self):
+                    with self._mu:
+                        self._n += 1
+
+                def bump2(self):
+                    with self._mu:
+                        self._n += 1
+
+                def snapshot(self):
+                    return self._n
+            """
+        )
+        assert not [f for f in fs if f.code == "LOCK005"]
+
+    def test_guarded_by_annotation_enforces_single_write(self):
+        # a declared guard fires on ANY bare write, even below the
+        # inference threshold
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._x = 0   # guarded-by: _mu
+
+                def locked_write(self, v):
+                    with self._mu:
+                        self._x = v
+
+                def rogue(self, v):
+                    self._x = v
+            """
+        )
+        (f,) = [f for f in fs if f.code == "LOCK004"]
+        assert "guard declared by annotation" in f.message
+
+    def test_lock_free_annotation_exempts_attribute(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._x = 0   # lock-free: monotonic int, GIL-atomic reads
+
+                def a(self, v):
+                    with self._mu:
+                        self._x = v
+
+                def b(self, v):
+                    with self._mu:
+                        self._x = v
+
+                def rogue(self, v):
+                    self._x = v
+            """
+        )
+        assert not [f for f in fs if f.code in ("LOCK004", "LOCK005")]
+
+    def test_lock_free_annotation_without_reason_is_a_finding(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._x = 0   # lock-free:
+
+                def a(self, v):
+                    with self._mu:
+                        self._x = v
+            """
+        )
+        assert any(
+            f.code == "LOCK004" and "no reason" in f.message for f in fs
+        )
+
+    def test_locked_suffix_methods_assume_primary_lock(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._x = 0
+
+                def a(self, v):
+                    with self._mu:
+                        self._set_locked(v)
+
+                def b(self, v):
+                    with self._mu:
+                        self._set_locked(v)
+
+                def _set_locked(self, v):
+                    self._x = v   # convention: caller holds _mu
+            """
+        )
+        assert not [f for f in fs if f.code == "LOCK004"]
+
+    def test_def_level_guarded_by_annotation(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._x = 0
+
+                def a(self, v):
+                    with self._mu:
+                        self._apply(v)
+
+                def b(self, v):
+                    with self._mu:
+                        self._apply(v)
+
+                def _apply(self, v):  # guarded-by: _mu (callers hold it)
+                    self._x = v
+            """
+        )
+        assert not [f for f in fs if f.code == "LOCK004"]
+
+    def test_condition_aliases_its_lock(self):
+        # `with self._cv:` acquires the underlying _mu — one guard
+        fs = findings_for(
+            """
+            from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
+
+            class C:
+                def __init__(self):
+                    self._mu = TrackedLock("c.mu")
+                    self._cv = TrackedCondition(self._mu, name="c.cv")
+                    self._x = 0
+
+                def a(self, v):
+                    with self._cv:
+                        self._x = v
+
+                def b(self, v):
+                    with self._mu:
+                        self._x = v
+            """
+        )
+        assert not [f for f in fs if f.code == "LOCK004"]
+
+
+# ---------------------------------------------------------------------------
+# dispatch discipline (LOCK006) on seeded violations
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchDisciplineSeeded:
+    REL = "pilosa_tpu/exec/_seeded.py"
+
+    def test_direct_jit_call_flagged(self):
+        fs = findings_for(
+            """
+            import jax
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):
+                return _tally(x)
+            """,
+            rel=self.REL,
+        )
+        (f,) = [f for f in fs if f.code == "LOCK006"]
+        assert "_tally" in f.message and "PR-10" in f.message
+
+    def test_block_until_ready_flagged(self):
+        fs = findings_for(
+            """
+            def leg(arr):
+                return arr.block_until_ready()
+            """,
+            rel=self.REL,
+        )
+        (f,) = [f for f in fs if f.code == "LOCK006"]
+        assert "block_until_ready" in f.message
+
+    def test_run_serialized_argument_exempt(self):
+        fs = findings_for(
+            """
+            import jax
+            from pilosa_tpu.exec.plan import run_serialized
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):
+                return run_serialized(lambda: _tally(x))
+            """,
+            rel=self.REL,
+        )
+        assert not [f for f in fs if f.code == "LOCK006"]
+
+    def test_run_serialized_eager_argument_still_flagged(self):
+        # run_serialized(_tally(x)) evaluates the compiled call EAGERLY
+        # on the calling thread before run_serialized runs — the PR-10
+        # bug wearing the fix's clothes; only deferred callables
+        # (lambda / function reference) are exempt
+        fs = findings_for(
+            """
+            import jax
+            from pilosa_tpu.exec.plan import run_serialized
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):
+                return run_serialized(_tally(x))
+            """,
+            rel=self.REL,
+        )
+        (f,) = [f for f in fs if f.code == "LOCK006"]
+        assert "_tally" in f.message
+
+    def test_run_serialized_function_reference_exempt(self):
+        fs = findings_for(
+            """
+            import jax
+            from pilosa_tpu.exec.plan import run_serialized
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):
+                return run_serialized(_tally)
+            """,
+            rel=self.REL,
+        )
+        assert not [f for f in fs if f.code == "LOCK006"]
+
+    def test_dispatch_mutex_with_block_exempt(self):
+        fs = findings_for(
+            """
+            import jax
+            from pilosa_tpu.exec.plan import dispatch_mutex
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):
+                with dispatch_mutex():
+                    return _tally(x).block_until_ready()
+            """,
+            rel=self.REL,
+        )
+        assert not [f for f in fs if f.code == "LOCK006"]
+
+    def test_jit_body_calls_are_traced_not_dispatched(self):
+        fs = findings_for(
+            """
+            import jax
+
+            @jax.jit
+            def _inner(x):
+                return x
+
+            @jax.jit
+            def _outer(x):
+                return _inner(x)   # inlined into one program
+            """,
+            rel=self.REL,
+        )
+        assert not [f for f in fs if f.code == "LOCK006"]
+
+    def test_out_of_scope_modules_not_checked(self):
+        fs = findings_for(
+            """
+            import jax
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):
+                return _tally(x)
+            """,
+            rel="pilosa_tpu/server/_seeded.py",
+        )
+        assert not [f for f in fs if f.code == "LOCK006"]
+
+    def test_dispatch_ok_annotation_exempts_with_reason(self):
+        fs = findings_for(
+            """
+            import jax
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):  # dispatch-ok: single-device, no collectives
+                return _tally(x)
+            """,
+            rel=self.REL,
+        )
+        assert not [f for f in fs if f.code == "LOCK006"]
+
+    def test_dispatch_ok_without_reason_is_a_finding(self):
+        fs = findings_for(
+            """
+            import jax
+
+            @jax.jit
+            def _tally(x):
+                return x
+
+            def leg(x):  # dispatch-ok:
+                return _tally(x)
+            """,
+            rel=self.REL,
+        )
+        assert any(
+            f.code == "LOCK006" and "no reason" in f.message for f in fs
+        )
+
+
+# ---------------------------------------------------------------------------
+# fragment-lock durability discipline (LOCK007) on seeded violations
+# ---------------------------------------------------------------------------
+
+
+class TestFragmentLockDurabilitySeeded:
+    REL = "pilosa_tpu/core/_seeded.py"
+
+    def test_os_fsync_under_fragment_lock(self):
+        fs = findings_for(
+            """
+            import os
+
+            class F:
+                def write(self, fd):
+                    with self._mu:
+                        os.fsync(fd)
+            """,
+            rel=self.REL,
+        )
+        (f,) = [f for f in fs if f.code == "LOCK007"]
+        assert "os.fsync" in f.message and "PR-11" in f.message
+
+    def test_wait_durable_under_fragment_lock(self):
+        fs = findings_for(
+            """
+            from pilosa_tpu.core import wal as walmod
+
+            class F:
+                def write(self, tok):
+                    with self._mu:
+                        walmod.GROUP_COMMIT.wait_durable(tok)
+            """,
+            rel=self.REL,
+        )
+        (f,) = [f for f in fs if f.code == "LOCK007"]
+        assert "wait_durable" in f.message
+
+    def test_wal_truncate_under_fragment_lock(self):
+        fs = findings_for(
+            """
+            class F:
+                def snap(self):
+                    with self._mu:
+                        self._wal.truncate()
+            """,
+            rel=self.REL,
+        )
+        assert [f for f in fs if f.code == "LOCK007"]
+
+    def test_commit_token_past_the_lock_passes(self):
+        # the PR-11 convention itself: token returned past the lock
+        fs = findings_for(
+            """
+            from pilosa_tpu.core import wal as walmod
+
+            class F:
+                def write(self, positions):
+                    with self._mu:
+                        tok = self._wal.append(0, positions)
+                    if tok is not None:
+                        walmod.GROUP_COMMIT.wait_durable(tok)
+            """,
+            rel=self.REL,
+        )
+        assert not [f for f in fs if f.code == "LOCK007"]
+
+    def test_out_of_scope_modules_not_checked(self):
+        fs = findings_for(
+            """
+            import os
+
+            class F:
+                def write(self, fd):
+                    with self._mu:
+                        os.fsync(fd)
+            """,
+            rel="pilosa_tpu/server/_seeded2.py",
+        )
+        assert not [f for f in fs if f.code == "LOCK007"]
+
+
+# ---------------------------------------------------------------------------
 # jax purity on seeded violations
 # ---------------------------------------------------------------------------
 
@@ -523,6 +1051,7 @@ class TestBaseline:
                     path="pilosa_tpu/nowhere.py",
                     match="",
                     reason="entry that matches nothing",
+                    rule="lock-hygiene",
                 )
             ]
         )
@@ -550,6 +1079,7 @@ class TestBaseline:
                     path="pilosa_tpu/_seeded.py",
                     match="time.sleep",
                     reason="seeded on purpose for this test",
+                    rule="lock-hygiene",
                 )
             ]
         )
@@ -561,9 +1091,69 @@ class TestBaseline:
         p = tmp_path / "baseline.toml"
         p.write_text(
             '[[allow]]\ncode = "LOCK002"\npath = "x.py"\nmatch = ""\n'
+            'rule = "lock-hygiene"\n'
         )
         with pytest.raises(ValueError, match="reason"):
             Baseline.load(str(p))
+
+    def test_entry_without_rule_rejected_at_load(self, tmp_path):
+        p = tmp_path / "baseline.toml"
+        p.write_text(
+            '[[allow]]\ncode = "LOCK002"\npath = "x.py"\nmatch = ""\n'
+            'reason = "justified but unowned"\n'
+        )
+        with pytest.raises(ValueError, match="rule"):
+            Baseline.load(str(p))
+
+    def test_entry_naming_removed_pass_fails_gate(self):
+        # a renamed/retired pass must take its suppressions with it
+        baseline = Baseline(
+            entries=[
+                BaselineEntry(
+                    code="LOCK002",
+                    path="pilosa_tpu/x.py",
+                    match="",
+                    reason="suppression owned by a pass that is gone",
+                    rule="lock-hygiene-v1",
+                )
+            ]
+        )
+        result = run_gate(analysis.default_passes(), [], baseline)
+        assert not result.ok
+        assert result.invalid_entries
+        assert "lock-hygiene-v1" in result.render()
+
+    def test_entry_naming_removed_rule_code_fails_gate(self):
+        baseline = Baseline(
+            entries=[
+                BaselineEntry(
+                    code="LOCK099",
+                    path="pilosa_tpu/x.py",
+                    match="",
+                    reason="suppression for a rule code that is gone",
+                    rule="lock-hygiene",
+                )
+            ]
+        )
+        result = run_gate(analysis.default_passes(), [], baseline)
+        assert not result.ok
+        assert result.invalid_entries
+        assert "LOCK099" in result.render()
+
+    def test_every_pass_declares_its_rules(self):
+        # the validation above is only as good as the declarations: a
+        # pass emitting codes it never declared would let its baseline
+        # entries be rejected as invalid (or worse, never validated)
+        for p in analysis.default_passes():
+            assert p.rules, f"pass {p.name} declares no rules"
+            for code in p.rules:
+                assert code[:3] in ("LOC", "JAX", "API"), code
+
+    def test_committed_baseline_entries_all_name_live_rules(self):
+        from pilosa_tpu.analysis.framework import validate_baseline
+
+        b = Baseline.load(BASELINE)
+        assert validate_baseline(analysis.default_passes(), b) == []
 
     def test_gate_failure_carries_file_line_evidence(self):
         m = seeded_module(

@@ -8,7 +8,13 @@ from datetime import datetime
 import numpy as np
 import pytest
 
-from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_TIME, FieldOptions
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_TIME,
+    FieldOptions,
+)
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core import timeq

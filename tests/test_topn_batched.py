@@ -178,7 +178,11 @@ class TestDispatchCounts:
         n_shards = 40
         bits = []
         for row in range(12):
-            bits += [(row, s * SHARD_WIDTH + row * 13 + i) for s in range(n_shards) for i in range(3)]
+            bits += [
+                (row, s * SHARD_WIDTH + row * 13 + i)
+                for s in range(n_shards)
+                for i in range(3)
+            ]
         src = [(0, s * SHARD_WIDTH + i) for s in range(n_shards) for i in range(200)]
         h, ex = _mk(bits, src_bits=src)
         ex.execute("i", "TopN(f, Row(g=0), n=5)")  # warm

@@ -98,7 +98,16 @@ def main(argv: List[str] | None = None) -> int:
     rc = 0
     if args.all:
         rc |= run_tool("ruff", ["check", "pilosa_tpu", "tools", "tests"])
-        rc |= run_tool("mypy", ["pilosa_tpu/analysis", "pilosa_tpu/utils/locks.py"])
+        rc |= run_tool(
+            "mypy",
+            [
+                "pilosa_tpu/analysis",
+                "pilosa_tpu/utils/locks.py",
+                "pilosa_tpu/utils/race.py",
+                "pilosa_tpu/sched",
+                "pilosa_tpu/core/wal.py",
+            ],
+        )
     rc |= run_ast_passes(baseline=not args.no_baseline)
     if rc == 0:
         print("check: OK")
