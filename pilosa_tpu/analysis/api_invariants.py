@@ -67,6 +67,7 @@ _SECTION_CLASSES = {
     "IngestConfig": "ingest",
     "WalConfig": "wal",
     "MeshConfig": "mesh",
+    "CacheConfig": "cache",
     "ResizeConfig": "resize",
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",

@@ -105,6 +105,18 @@ class MeshConfig:
 
 
 @dataclass
+class CacheConfig:
+    # versioned result cache (core/resultcache.py; docs/configuration.md
+    # "Result cache"): Count/TopN/GroupBy results cached keyed on the
+    # exact fragment-version vector the plan read — repeats serve from
+    # host memory with zero compiled dispatches after a cheap
+    # revalidation, and cached Counts are patched in place from the
+    # merge barrier's word deltas after set-only staged bursts.
+    result_mb: int = 64  # LRU byte budget, MB; 0 disables the cache
+    count_repair: bool = True  # incremental Count repair on staged bursts
+
+
+@dataclass
 class ResizeConfig:
     # live elastic resize (streaming resharding under traffic;
     # docs/configuration.md "Elastic resize"): moving fragments stream as
@@ -183,6 +195,7 @@ class Config:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     wal: WalConfig = field(default_factory=WalConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
     resize: ResizeConfig = field(default_factory=ResizeConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -262,6 +275,7 @@ class Config:
             ("ingest", self.ingest),
             ("wal", self.wal),
             ("mesh", self.mesh),
+            ("cache", self.cache),
             ("resize", self.resize),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),

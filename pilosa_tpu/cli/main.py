@@ -20,6 +20,21 @@ from typing import List, Optional
 from pilosa_tpu.cli.config import Config, parse_hosts
 
 
+def _bool_flag(v: str) -> bool:
+    """Explicit true/false flag value (for default-True knobs, where
+    store_true could never express an override back to False). Anything
+    unrecognized is a usage error — silently coercing a typo like
+    'ture' to False would disable the knob with no diagnostic."""
+    s = v.strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(
+        f"expected true/false, got {v!r}"
+    )
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pilosa-tpu", description="TPU-native distributed bitmap index"
@@ -170,6 +185,19 @@ def _build_parser() -> argparse.ArgumentParser:
         "instead of per-node HTTP legs (empty disables)",
     )
     sp.add_argument(
+        "--cache-result-mb", type=int,
+        help="versioned result cache LRU byte budget in MB — repeat "
+        "Count/TopN/GroupBy queries revalidate against fragment "
+        "versions and serve from host memory with zero dispatches "
+        "(0 disables)",
+    )
+    sp.add_argument(
+        "--cache-count-repair", type=_bool_flag,
+        help="patch cached Counts in place from the merge barrier's "
+        "word deltas after set-only staged bursts instead of "
+        "recomputing (true/false)",
+    )
+    sp.add_argument(
         "--mesh-min-nodes", type=int,
         help="group-local owner nodes a fan-out must span before the "
         "mesh-group fold engages (0 disables mesh-local execution)",
@@ -283,6 +311,8 @@ _FLAG_KNOBS = {
     "wal_sync_interval": ("wal", "sync_interval"),
     "mesh_group": ("mesh", "group"),
     "mesh_min_nodes": ("mesh", "min_nodes"),
+    "cache_result_mb": ("cache", "result_mb"),
+    "cache_count_repair": ("cache", "count_repair"),
     "mesh_ici_gbps": ("mesh", "ici_gbps"),
     "mesh_dcn_gbps": ("mesh", "dcn_gbps"),
     "resize_transfer_concurrency": ("resize", "transfer_concurrency"),
@@ -437,6 +467,8 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         mesh_min_nodes=cfg.mesh.min_nodes,
         mesh_ici_gbps=cfg.mesh.ici_gbps,
         mesh_dcn_gbps=cfg.mesh.dcn_gbps,
+        cache_result_mb=cfg.cache.result_mb,
+        cache_count_repair=cfg.cache.count_repair,
         import_concurrency=cfg.import_concurrency,
         resize_transfer_concurrency=cfg.resize.transfer_concurrency,
         resize_cutover_timeout=cfg.resize.cutover_timeout,

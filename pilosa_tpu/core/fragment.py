@@ -512,6 +512,35 @@ class Fragment:
             rb = self._rows.get(row_id)
             return rb.to_positions() if rb is not None else np.empty(0, np.uint32)
 
+    def premerge_row_words(self, row_id: int) -> np.ndarray:
+        """Host words of one row at the STAGED-BASE version: the raw row
+        store plus parked pre-merged layers, with pending parts excluded
+        (no read barrier runs — this is NOT a host read). The merge
+        barrier calls it just before parking a burst's delta layer so
+        the result cache's count repair has `old_words` for
+        count(new) = count(old) + popcount(delta & ~old_words), which is
+        only exact against content at the burst's base version."""
+        with self._mu:
+            rb = self._rows.get(row_id)
+            words = np.array(
+                rb.to_words() if rb is not None else ob.empty_row(),
+                dtype=np.uint32,
+                copy=True,
+            )
+            lo = np.uint64(row_id) * np.uint64(SHARD_WIDTH)
+            for layer in self._premerged:
+                s, e = np.searchsorted(
+                    layer, (lo, lo + np.uint64(SHARD_WIDTH))
+                )
+                if e > s:
+                    cols = (layer[s:e] - lo).astype(np.uint32)
+                    np.bitwise_or.at(
+                        words,
+                        cols >> np.uint32(5),
+                        np.left_shift(np.uint32(1), cols & np.uint32(31)),
+                    )
+            return words
+
     def rows_sparse_concat(self, row_ids) -> Tuple[np.ndarray, np.ndarray]:
         """One-lock bulk sparse read for the TopN tally: concatenated
         sorted bit positions of the listed rows plus per-row lengths;

@@ -154,8 +154,15 @@ class Holder:
     def recalculate_caches(self) -> None:
         """Rebuild every fragment's rank cache from exact row counts
         (reference: api.go RecalculateCaches / recalculate-caches message)."""
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
         for frag in self.fragments():
             frag.recalculate_cache()
+        # a rank-cache rebuild can reorder TopN with NO fragment-version
+        # change, so version-keyed cached results are not protected by
+        # revalidation here — drop every index's entries explicitly
+        for idx in self.indexes():
+            RESULT_CACHE.drop_scope(idx._cache_scope)
 
     def schema(self) -> List[dict]:
         """Schema description (reference: holder Schema / http /schema)."""

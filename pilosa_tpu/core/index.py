@@ -39,6 +39,14 @@ class Index:
         self.track_existence = track_existence
         self._mu = TrackedRLock("index.mu")
         self._fields: Dict[str, Field] = {}
+        # result-cache key scope (core/resultcache.py): a process-unique
+        # token per Index INSTANCE, so in-process peers holding a
+        # same-named index — or a deleted-and-recreated one — can never
+        # serve each other's cached results (fragment version counters
+        # are per-instance and would collide under a name-based key)
+        from pilosa_tpu.core.devcache import new_owner_token
+
+        self._cache_scope = new_owner_token()
         # per-column attributes (reference: index.go columnAttrStore)
         from pilosa_tpu.core.attrs import AttrStore
 

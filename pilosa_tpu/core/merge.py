@@ -170,6 +170,7 @@ class FragMerge:
         "cum",
         "starts",
         "ends",
+        "old_words",
     )
 
     def __init__(self, frag, rows, cols, cum, starts, ends):
@@ -184,6 +185,10 @@ class FragMerge:
         self.cum = cum
         self.starts = starts
         self.ends = ends
+        # row id -> host words at base_version, captured BEFORE the
+        # delta layer parked — only for rows the result cache registered
+        # interest in (core/resultcache.py count repair)
+        self.old_words: Dict[int, np.ndarray] = {}
 
     def word_delta(self, row_id: int):
         """(word_idx, word_val) arrays of this row's merged delta, for
@@ -191,6 +196,17 @@ class FragMerge:
         i = self.rows.index(row_id)
         s, e = self.starts[i], self.ends[i]
         return ops_merge.word_or_from_sorted(self.cols[s:e], self.cum[s:e])
+
+
+def _repair_interest(frag) -> set:
+    """Rows of this fragment's (index, field, view) that repairable
+    cached Counts are watching (core/resultcache.py). Lazy import: the
+    cache module is light, but core/merge must stay importable without
+    it mid-bootstrap; the common path is one dict lookup returning
+    empty."""
+    from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+    return RESULT_CACHE.interest_rows(frag.index, frag.field, frag.view)
 
 
 def merge_barrier(frags) -> List[FragMerge]:
@@ -299,6 +315,18 @@ def merge_barrier(frags) -> List[FragMerge]:
             f, rows_i, cols_g, cum, starts_l[rlo:rhi], ends_l[rlo:rhi]
         )
         fm.base_version = base_version
+        # count-repair old-words capture: for rows a cached Count is
+        # watching, read the row's host words at base_version NOW —
+        # after the apply below the fragment's content has moved past
+        # the base and popcount(delta & ~old) is no longer computable.
+        # A concurrent _sync_locked between this read and the apply
+        # bumps the generation, the apply returns None, and the capture
+        # is discarded with the failed FragMerge — never applied stale.
+        want = _repair_interest(f)
+        if want:
+            for rid in rows_i:
+                if rid in want:
+                    fm.old_words[rid] = f.premerge_row_words(rid)
         # the layer is COPIED out of the shared burst buffer: a view
         # would pin the whole round's merged array until the last
         # fragment's host read materializes it

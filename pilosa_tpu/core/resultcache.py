@@ -1,0 +1,606 @@
+"""Versioned result cache with incremental count repair.
+
+The canonical Pilosa workload (PAPER.md §L2) is a dashboard fleet
+re-issuing the same segmentation queries every few seconds; every layer
+below already speaks fragment versions (version-salted extent keys,
+version-salted mesh tally bundles, the merge barrier's per-fragment word
+deltas). This module lifts that one level: it caches query RESULTS —
+Count scalars, TopN tallies, GroupBy matrices — keyed on the canonical
+query text plus the exact fragment-version vector the plan read, with
+two freshness paths:
+
+- **revalidation**: a repeat query re-collects the current version
+  vector (lock-free monotonic reads — every mutation funnel bumps
+  `Fragment.version`); an unchanged vector means the stored result is
+  bit-identical to what a recompute would produce, so it is served from
+  host memory with zero compiled dispatches and zero device reads.
+- **incremental repair** (Counts over a single row): the merge
+  barrier's `FragMerge.word_delta` is exactly the information needed to
+  patch a cached popcount without re-staging any operand —
+  `count(new) = count(old) + popcount(delta & ~old_words)` for a
+  set-only staged burst, where `old_words` is the row's host words at
+  the burst's base version (captured by the barrier BEFORE the delta
+  layer parks, core/merge.py). Clears, mutex writes and version gaps
+  make the delta non-monotone; those entries fall back to recompute.
+
+Scoping: one process-global RESULT_CACHE serves every in-process node
+(the multi-node test harnesses run several NodeServers in one process).
+Keys carry the owning Index's `_cache_scope` token and version-vector
+elements carry per-View `_stack_token`s, so two nodes holding
+same-named indexes can never serve each other's entries — version
+counters are per-fragment-instance and would otherwise collide.
+
+Invalidation rides the existing funnels: `Fragment.on_mutate` (via the
+owning View) reports the mutated shard — non-repairable entries
+covering it drop eagerly, repairable Count entries stay for the repair
+window; `View.sync_pending` reports the barrier's merges — Count
+entries patch in place (or re-key when the burst missed their row),
+everything else stale-drops. Entries a hook never reaches are still
+safe: revalidation makes a stale entry unservable (versions only ever
+grow), it just waits for LRU.
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from pilosa_tpu.utils.locks import TrackedLock
+from pilosa_tpu.utils.race import race_checked
+
+# Default LRU byte budget ([cache] result-cache-mb knob; 0 disables the
+# cache outright — get/put become no-ops).
+DEFAULT_BUDGET_BYTES = 64 << 20
+
+# Keys executed through an RPC-assembled version vector (HTTP fan-out
+# coordinators) only start caching on their SECOND sighting: collecting
+# remote versions costs a round trip per peer, and paying it for
+# one-off queries would tax every cold query to speed up none.
+_CANDIDATE_CAP = 1024
+
+_UNSET = object()
+
+
+def _popcount(words: np.ndarray) -> int:
+    """Exact popcount of a uint32 word array (small: delta words only)."""
+    if not len(words):
+        return 0
+    return int(
+        np.unpackbits(np.ascontiguousarray(words).view(np.uint8)).sum()
+    )
+
+
+def _result_nbytes(kind: str, result: Any) -> int:
+    if kind == "count":
+        return 32
+    # per-element rates sized to the real Python object graphs (a
+    # GroupCount carries a FieldRow list; a Pair is a small dataclass):
+    # a high-cardinality GroupBy must charge the budget roughly what it
+    # costs in RSS, or a 64 MB knob would admit hundreds of real MB
+    per = 384 if kind == "groupby" else 112
+    try:
+        return 64 + per * len(result)
+    except TypeError:
+        return 256
+
+
+def _vector_nbytes(vector: tuple) -> int:
+    n = 64
+    for elem in vector:
+        n += 48
+        if elem[0] == "v":
+            n += 16 * len(elem[5])
+    return n
+
+
+class _Entry:
+    """One cached result.
+
+    `vector` is a tuple of elements, one per (node, field, view) the
+    query read:
+
+      ("v", node, field, view, ident, shards, versions)
+          ident = the View's `_stack_token` (local / in-process mesh
+          member) or (boot_id, token) for a remote node's view —
+          instance identity, so delete/recreate or a peer restart can
+          never alias an old entry back to life;
+      ("m", node, field, view)
+          the field/view did not exist ("" view = field missing); its
+          materialization changes the element shape, forcing a miss.
+
+    `repair_row` is set only for Count over a single plain Row (the
+    vector then has exactly one "v" element): the row id whose merged
+    word delta can patch the cached scalar in place."""
+
+    __slots__ = (
+        "key", "kind", "index", "text", "result", "vector", "repair_row",
+        "clocks", "maybe_stale", "nbytes",
+    )
+
+    def __init__(self, key, kind, index, text, result, vector, repair_row,
+                 clocks=None):
+        self.key = key
+        self.kind = kind
+        self.index = index
+        self.text = text
+        self.result = result
+        self.vector = vector
+        self.repair_row = repair_row
+        # per-view mutation-clock vector (View.mutation_clock) read
+        # BEFORE the version vector: clock-equal implies version-equal,
+        # so warm repeats revalidate on one integer per view instead of
+        # walking the shard axis. None = fall back to the exact vector.
+        self.clocks = clocks
+        # a covered mutation was observed since the entry last proved
+        # fresh (store / hit / in-place repair). Drives the admission
+        # cost discount only — a maybe-stale entry must not admit a
+        # recompute byte-free (sched/cost.py); serving correctness
+        # never reads it.
+        self.maybe_stale = False
+        self.nbytes = (
+            len(text)
+            + _result_nbytes(kind, result)
+            + _vector_nbytes(vector)
+        )
+
+
+@race_checked(exclude=(
+    # [cache] knobs: written by NodeServer construction/configure, read
+    # lock-free on the hot lookup paths — a racy read sees either the
+    # old or the new setting, both valid configurations (GIL-atomic
+    # int/bool reads; entries themselves stay fully lock-guarded)
+    "_budget",
+    "_repair_enabled",
+))
+class ResultCache:
+    """LRU byte-budgeted store of versioned query results (one
+    process-global instance, RESULT_CACHE, like core/devcache.py)."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+        self._mu = TrackedLock("resultcache.mu")
+        self._budget = int(budget_bytes)
+        self._repair_enabled = True
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._bytes = 0
+        # view token -> keys whose vector covers it (invalidation/repair)
+        self._by_token: Dict[int, Set[tuple]] = {}
+        # index name -> resident bytes (per-tenant attribution; feeds the
+        # cache.resident_bytes{index} gauge and quota work)
+        self._by_index: Dict[str, int] = {}
+        # (index, field, view) -> row -> refcount of repairable Count
+        # entries interested in that row's pre-merge words (the merge
+        # barrier's old-words capture hook, core/merge.py)
+        self._interest: Dict[tuple, Dict[int, int]] = {}
+        # (scope, text) -> live entry keys (admission cost discount)
+        self._by_text: Dict[tuple, Set[tuple]] = {}
+        # keys seen once but not yet cached (RPC-vector gating)
+        self._candidates: "OrderedDict[tuple, bool]" = OrderedDict()
+        self._counters: Dict[str, int] = {
+            "hits": 0,
+            "misses": 0,
+            "revalidations": 0,
+            "repairs": 0,
+            "evictions": 0,
+            "stores": 0,
+        }
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(self, budget_bytes=_UNSET, repair=_UNSET) -> None:
+        """Install the server's [cache] knobs (cli/config.py ->
+        server/node.py). Process-global like the [hbm] knobs: all
+        in-process nodes share one store (entries stay node-scoped via
+        the index/view tokens in their keys)."""
+        with self._mu:
+            if budget_bytes is not _UNSET:
+                self._budget = int(budget_bytes)
+            if repair is not _UNSET:
+                self._repair_enabled = bool(repair)
+            self._evict_over_budget_locked()
+
+    @property
+    def budget_bytes(self) -> int:
+        return self._budget
+
+    @property
+    def repair_enabled(self) -> bool:
+        return self._repair_enabled
+
+    # -- lookup / store -----------------------------------------------------
+
+    def get(self, key, vector, recount: bool = True):
+        """(found, result). A hit requires the entry's stored vector to
+        EQUAL the caller's freshly collected one — identical fragment
+        versions mean identical content, so the stored result is what a
+        recompute would return. `recount=False` suppresses the miss
+        counter (the repair retry re-gets after running the barrier)."""
+        if vector is None or self._budget <= 0:
+            return False, None
+        with self._mu:
+            e = self._entries.get(key)
+            if e is not None and e.vector == vector:
+                self._entries.move_to_end(key)
+                self._counters["hits"] += 1
+                self._counters["revalidations"] += 1
+                e.maybe_stale = False
+                result = e.result
+                kind = e.kind
+            else:
+                if recount:
+                    self._counters["misses"] += 1
+                return False, None
+        if kind == "count":
+            return True, result
+        return True, copy.deepcopy(result)
+
+    def get_by_clock(self, key, clocks):
+        """(found, result): the O(#views) fast path — serve when the
+        caller's freshly read per-view mutation clocks equal the
+        entry's. Sound because every fragment-version bump also bumps
+        its view's clock (and clocks were read BEFORE the entry's
+        vector at store/refresh time): clock-equal ⇒ zero mutation
+        events since ⇒ version-vector-equal. Misses are silent — the
+        caller falls back to the exact vector path, which counts."""
+        if clocks is None or self._budget <= 0:
+            return False, None
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None or e.clocks is None or e.clocks != clocks:
+                return False, None
+            self._entries.move_to_end(key)
+            self._counters["hits"] += 1
+            self._counters["revalidations"] += 1
+            e.maybe_stale = False
+            result = e.result
+            kind = e.kind
+        if kind == "count":
+            return True, result
+        return True, copy.deepcopy(result)
+
+    def refresh_clocks(self, key, clocks) -> None:
+        """Arm the clock fast path after a successful exact-vector
+        revalidation. `clocks` MUST have been read before the vector
+        the caller just matched — a write landing in between then keeps
+        the fast path disarmed (live clock moved past), never wrong."""
+        if clocks is None:
+            return
+        with self._mu:
+            e = self._entries.get(key)
+            if e is not None:
+                e.clocks = clocks
+
+    def count_miss(self) -> None:
+        """Book one lookup that concluded a miss. The executor defers
+        this until the repair retry has also failed, so one logical
+        lookup never records both a miss and a hit (a repaired serve
+        would otherwise read as cacheHitRate 0.5 on a 100%-served
+        dashboard)."""
+        with self._mu:
+            self._counters["misses"] += 1
+
+    def repairable(self, key) -> bool:
+        """Whether a miss on `key` is worth a repair attempt: a live
+        Count entry with a repair row, and repair enabled. The caller
+        then runs the read barrier (which fires note_merges) and
+        re-gets."""
+        if not self._repair_enabled:
+            return False
+        with self._mu:
+            e = self._entries.get(key)
+            return e is not None and e.repair_row is not None
+
+    def note_candidate(self, key) -> bool:
+        """Record a sighting of an RPC-vector key; True when the key was
+        already seen (worth paying the version round trips now)."""
+        with self._mu:
+            if key in self._entries:
+                return True
+            if key in self._candidates:
+                self._candidates.move_to_end(key)
+                return True
+            self._candidates[key] = True
+            while len(self._candidates) > _CANDIDATE_CAP:
+                self._candidates.popitem(last=False)
+            return False
+
+    def put(
+        self,
+        key,
+        kind: str,
+        index: str,
+        text: str,
+        result: Any,
+        vector: tuple,
+        repair_row: Optional[int] = None,
+        clocks: Optional[tuple] = None,
+    ) -> None:
+        if vector is None or self._budget <= 0:
+            return
+        if kind != "count":
+            result = copy.deepcopy(result)
+        if repair_row is not None and (
+            kind != "count"
+            or not self._repair_enabled
+            or sum(1 for el in vector if el[0] == "v") != 1
+        ):
+            repair_row = None
+        e = _Entry(key, kind, index, text, result, vector, repair_row, clocks)
+        if e.nbytes > self._budget:
+            return  # a single over-budget entry would evict everything
+        with self._mu:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._unindex_locked(old)
+            self._entries[key] = e
+            self._index_locked(e)
+            self._counters["stores"] += 1
+            self._candidates.pop(key, None)
+            self._evict_over_budget_locked()
+
+    # -- internal indexing (all under self._mu) -----------------------------
+
+    def _index_locked(self, e: _Entry) -> None:
+        self._bytes += e.nbytes
+        self._by_index[e.index] = self._by_index.get(e.index, 0) + e.nbytes
+        self._by_text.setdefault((e.key[0], e.text), set()).add(e.key)
+        for elem in e.vector:
+            if elem[0] != "v":
+                continue
+            ident = elem[4]
+            if isinstance(ident, int):  # local/in-process view token
+                self._by_token.setdefault(ident, set()).add(e.key)
+        if e.repair_row is not None:
+            elem = next(el for el in e.vector if el[0] == "v")
+            ikey = (e.index, elem[2], elem[3])
+            rows = self._interest.setdefault(ikey, {})
+            rows[e.repair_row] = rows.get(e.repair_row, 0) + 1
+
+    def _unindex_locked(self, e: _Entry) -> None:
+        self._bytes -= e.nbytes
+        left = self._by_index.get(e.index, 0) - e.nbytes
+        if left > 0:
+            self._by_index[e.index] = left
+        else:
+            self._by_index.pop(e.index, None)
+        tkey = (e.key[0], e.text)
+        keys = self._by_text.get(tkey)
+        if keys is not None:
+            keys.discard(e.key)
+            if not keys:
+                self._by_text.pop(tkey, None)
+        for elem in e.vector:
+            if elem[0] != "v":
+                continue
+            ident = elem[4]
+            if isinstance(ident, int):
+                keys = self._by_token.get(ident)
+                if keys is not None:
+                    keys.discard(e.key)
+                    if not keys:
+                        self._by_token.pop(ident, None)
+        if e.repair_row is not None:
+            elem = next(el for el in e.vector if el[0] == "v")
+            ikey = (e.index, elem[2], elem[3])
+            rows = self._interest.get(ikey)
+            if rows is not None:
+                n = rows.get(e.repair_row, 0) - 1
+                if n > 0:
+                    rows[e.repair_row] = n
+                else:
+                    rows.pop(e.repair_row, None)
+                    if not rows:
+                        self._interest.pop(ikey, None)
+
+    def _drop_locked(self, key, evict: bool = False) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._unindex_locked(e)
+            if evict:
+                self._counters["evictions"] += 1
+
+    def _evict_over_budget_locked(self) -> None:
+        while self._bytes > self._budget and self._entries:
+            key = next(iter(self._entries))
+            self._drop_locked(key, evict=True)
+
+    # -- invalidation funnels ----------------------------------------------
+
+    def note_mutation(self, token: int, shard: int) -> None:
+        """A fragment of the view owning `token` mutated (the same
+        on_mutate hook that drives dirty-extent invalidation). Entries
+        covering that (view, shard) whose result cannot be repaired drop
+        eagerly; repairable Count entries stay for the repair window —
+        revalidation keeps either choice exact."""
+        self.note_mutations(token, (shard,))
+
+    def note_mutations(self, token: int, shards) -> None:
+        with self._mu:
+            keys = self._by_token.get(token)
+            if not keys:
+                return
+            dirty = set(shards)
+            for key in list(keys):
+                e = self._entries.get(key)
+                if e is None:
+                    continue
+                covered = any(
+                    elem[0] == "v"
+                    and elem[4] == token
+                    and dirty.intersection(elem[5])
+                    for elem in e.vector
+                )
+                if not covered:
+                    continue
+                if e.repair_row is None:
+                    self._drop_locked(key)
+                else:
+                    # kept for the repair window, but no longer
+                    # hit-likely: the admission discount must charge a
+                    # possible recompute its full device bytes
+                    e.maybe_stale = True
+
+    def note_merges(self, token: int, merges) -> None:
+        """The merge barrier just applied staged deltas for fragments of
+        the view owning `token` (View.sync_pending). Patch every covered
+        repairable Count entry in place — count(new) = count(old) +
+        popcount(delta & ~old_words) when the burst touched its row,
+        version re-key alone when it did not — and drop everything else
+        covering a merged shard (their results are stale and
+        unrepairable)."""
+        if not merges:
+            return
+        by_shard = {m.shard: m for m in merges}
+        with self._mu:
+            keys = self._by_token.get(token)
+            if not keys:
+                return
+            for key in list(keys):
+                e = self._entries.get(key)
+                if e is None:
+                    continue
+                self._apply_merges_locked(e, token, by_shard)
+
+    def _apply_merges_locked(self, e: _Entry, token: int, by_shard) -> None:
+        new_vector = list(e.vector)
+        changed = False
+        count = e.result if e.kind == "count" else None
+        for i, elem in enumerate(e.vector):
+            if elem[0] != "v" or elem[4] != token:
+                continue
+            shards, versions = elem[5], list(elem[6])
+            touched = False
+            for pos, s in enumerate(shards):
+                m = by_shard.get(s)
+                if m is None:
+                    continue
+                if (
+                    e.repair_row is None
+                    or not self._repair_enabled
+                    or not m.applied
+                    or not m.clean
+                    or versions[pos] != m.base_version
+                ):
+                    self._drop_locked(e.key)
+                    return
+                if e.repair_row in m.rows:
+                    old = m.old_words.get(e.repair_row)
+                    if old is None:
+                        # the barrier had no interest registered when it
+                        # captured (entry raced in): unrepairable
+                        self._drop_locked(e.key)
+                        return
+                    widx, wvals = m.word_delta(e.repair_row)
+                    count += _popcount(
+                        np.bitwise_and(wvals, np.bitwise_not(old[widx]))
+                    )
+                    self._counters["repairs"] += 1
+                # row untouched by the burst: the count is unchanged and
+                # the entry just re-keys forward to the merged version
+                versions[pos] = m.new_version
+                touched = True
+            if touched:
+                new_vector[i] = elem[:6] + (tuple(versions),)
+                changed = True
+        if changed:
+            e.vector = tuple(new_vector)
+            # the clock moved with the burst: disarm the fast path until
+            # the next exact-vector revalidation re-reads live clocks
+            e.clocks = None
+            # patched to the merged versions: hit-likely again
+            e.maybe_stale = False
+            if e.kind == "count":
+                e.result = count
+
+    def interest_rows(self, index: str, field: str, view: str) -> Set[int]:
+        """Rows of (index, field, view) that repairable Count entries
+        are watching — the merge barrier captures these rows' pre-merge
+        words so note_merges can patch without re-reading operands.
+        Fast empty path: one dict lookup under the lock."""
+        with self._mu:
+            rows = self._interest.get((index, field, view))
+            return set(rows) if rows else set()
+
+    # -- GC ----------------------------------------------------------------
+
+    def drop_view(self, token: int) -> None:
+        """A View closed (field/index delete, fragment drop): entries
+        whose vector references it must not outlive it."""
+        with self._mu:
+            for key in list(self._by_token.get(token, ())):
+                self._drop_locked(key)
+
+    def drop_index(self, index: str) -> None:
+        """Label GC on index delete (NodeServer.drop_index_telemetry):
+        the per-index byte attribution and every entry must go with the
+        index."""
+        with self._mu:
+            for key, e in list(self._entries.items()):
+                if e.index == index:
+                    self._drop_locked(key)
+
+    def drop_scope(self, scope) -> None:
+        """Drop every entry keyed under one Index's cache scope (rank
+        cache recalculation: TopN order can change with no version
+        bump)."""
+        with self._mu:
+            for key in list(self._entries):
+                if key[0] == scope:
+                    self._drop_locked(key)
+
+    def _clear_locked(self) -> None:
+        self._entries.clear()
+        self._by_token.clear()
+        self._by_index.clear()
+        self._interest.clear()
+        self._by_text.clear()
+        self._candidates.clear()
+        self._bytes = 0
+
+    def clear(self) -> None:
+        """Drop every entry (counters keep accumulating)."""
+        with self._mu:
+            self._clear_locked()
+
+    def reset(self) -> None:
+        """clear() plus counter reset (test isolation)."""
+        with self._mu:
+            self._clear_locked()
+            for k in self._counters:
+                self._counters[k] = 0
+
+    # -- introspection ------------------------------------------------------
+
+    def has_text(self, scope, text: str) -> bool:
+        """Whether a HIT-LIKELY entry is stored for (scope, text) — the
+        admission cost estimator's probe (sched/cost.py). Cheap by
+        design (no version walk), but entries that observed a covered
+        mutation since they last proved fresh are excluded: a
+        maybe-stale entry's repeat may recompute at full cost, and
+        admitting that byte-free would let it bypass the byte budget."""
+        if scope is None:
+            return False
+        with self._mu:
+            keys = self._by_text.get((scope, text))
+            if not keys:
+                return False
+            return any(
+                not e.maybe_stale
+                for k in keys
+                if (e := self._entries.get(k)) is not None
+            )
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """cache.* gauge values (NodeServer.publish_cache_gauges) plus
+        the per-index byte attribution."""
+        with self._mu:
+            snap: Dict[str, Any] = dict(self._counters)
+            snap["resident_bytes"] = self._bytes
+            snap["entries"] = len(self._entries)
+            snap["by_index"] = dict(self._by_index)
+            return snap
+
+
+RESULT_CACHE = ResultCache()

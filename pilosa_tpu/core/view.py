@@ -11,10 +11,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from pilosa_tpu.utils.locks import TrackedRLock
+from pilosa_tpu.utils.locks import TrackedLock, TrackedRLock
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.resultcache import RESULT_CACHE
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 
 VIEW_STANDARD = "standard"
@@ -46,6 +47,31 @@ class View:
         self.fragments: Dict[int, Fragment] = {}
         # owner token for cross-shard row stacks in the global device cache
         self._stack_token = new_owner_token()
+        # view-level mutation clock (result cache fast path): bumped on
+        # EVERY mutation event that bumps a fragment version — the
+        # on_mutate funnel and the bulk stage router — so clock-equal
+        # implies every fragment version in this view is unchanged. The
+        # cache revalidates warm repeats against this one integer per
+        # view instead of walking the whole shard axis; a clock mismatch
+        # falls back to the exact per-shard vector (a write to a
+        # DISJOINT shard subset must not kill covering entries).
+        # ORDERING CONTRACT: the clock bumps AFTER the version bump(s),
+        # before the mutation call returns. A reader overlapping an
+        # IN-FLIGHT write may therefore still fast-path the pre-write
+        # result — the same partial-visibility window any query racing
+        # a bulk import already has — but once the write returns, every
+        # later lookup sees the new clock. Trailing (not leading) is
+        # load-bearing: it guarantees a clock read always corresponds
+        # to a state no NEWER than any vector read after it, which is
+        # what makes arming entries with (clock, vector) pairs sound —
+        # a leading bump could arm a pre-write vector under the
+        # post-write clock and serve stale forever.
+        # Dedicated leaf lock: bumps happen under fragment locks, and
+        # view._mu is taken BEFORE fragment locks elsewhere (fragment
+        # creation) — a lost += under concurrency could freeze the clock
+        # across a real mutation, which revalidation soundness forbids.
+        self._clock_mu = TrackedLock("view.clock_mu")
+        self.mutation_clock = 0
         # shards with staged writes whose covering stack extents were NOT
         # invalidated at stage time (they are version-keyed, so they can
         # never be served stale): the merge barrier's reconciliation
@@ -75,6 +101,7 @@ class View:
             # per-index attribution must not resurrect the label after
             # telemetry GC
             DEVICE_CACHE.invalidate_owner(self._stack_token)
+            RESULT_CACHE.drop_view(self._stack_token)
             self._dirty_staged.clear()
 
     def _fragment_path(self, shard: int) -> Optional[str]:
@@ -104,11 +131,20 @@ class View:
                 # covers it are dropped (stale version keys would miss
                 # anyway; this frees exactly the stale HBM immediately
                 # instead of churning the whole owner or waiting on LRU)
-                frag.on_mutate = lambda s=shard: DEVICE_CACHE.invalidate_owner_shard(
-                    self._stack_token, s
-                )
+                frag.on_mutate = lambda s=shard: self._on_fragment_mutate(s)
                 self.fragments[shard] = frag
             return frag
+
+    def _on_fragment_mutate(self, shard: int) -> None:
+        """The per-mutation funnel (Fragment.on_mutate): dirty-extent
+        device invalidation plus the result-cache notification — cached
+        results covering the mutated (view, shard) drop eagerly unless
+        they are Count entries awaiting the merge barrier's in-place
+        repair (core/resultcache.py)."""
+        with self._clock_mu:
+            self.mutation_clock += 1
+        DEVICE_CACHE.invalidate_owner_shard(self._stack_token, shard)
+        RESULT_CACHE.note_mutation(self._stack_token, shard)
 
     def fragment_if_exists(self, shard: int) -> Optional[Fragment]:
         return self.fragments.get(shard)
@@ -129,6 +165,7 @@ class View:
                     except OSError:
                         pass
             DEVICE_CACHE.invalidate_owner(self._stack_token)
+            RESULT_CACHE.drop_view(self._stack_token)
             return True
 
     def available_shards(self) -> List[int]:
@@ -182,6 +219,13 @@ class View:
                 else:
                     frags = [self.fragments.get(s) for s in shards]
         merges = merge_mod.merge_barrier(frags)
+        if merges:
+            # result-cache repair/re-key: the SAME merged word deltas
+            # that patch resident device extents below also patch cached
+            # Count scalars in place (count += popcount(delta & ~old)),
+            # so a repeat Count after a set-only burst serves from host
+            # memory without re-reading a single operand word
+            RESULT_CACHE.note_merges(self._stack_token, merges)
         # reconcile ONLY the shards this barrier covered: a query over a
         # disjoint shard span must not invalidate (and forget) other
         # shards' still-patchable extents — they stay dirty until their
@@ -417,7 +461,14 @@ class View:
         # stale) and defer to the merge barrier, which patches resident
         # ones in place with the merged delta instead of forcing a
         # ~extent-sized PCIe re-stage per touched extent
+        with self._clock_mu:
+            self.mutation_clock += 1
         DEVICE_CACHE.invalidate_owner_uncovered(self._stack_token)
+        # result-cache dirty reporting, batched like the device pass:
+        # stale non-repairable results drop now, repairable Counts wait
+        # for the barrier's repair (stage_positions ran notify=False, so
+        # the per-fragment on_mutate funnel did not fire)
+        RESULT_CACHE.note_mutations(self._stack_token, dirty)
         with self._mu:
             self._dirty_staged.update(dirty)
 

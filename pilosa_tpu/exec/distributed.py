@@ -175,8 +175,14 @@ class DistributedExecutor(Executor):
                         partials.append(
                             self._mesh_group_partial(idx, c, mesh_nodes, fspan)
                         )
-                    except meshgroup.MeshUnsupported:
+                    except meshgroup.MeshUnsupported as e:
                         meshgroup.note_fallback()
+                        # reason-tagged fallback counter: a silent drop
+                        # to HTTP legs is a 5-9x latency regression that
+                        # must be visible on dashboards
+                        self.stats.with_tags(
+                            f"reason:{getattr(e, 'reason', 'unsupported')}"
+                        ).count("mesh.fallback")
                     else:
                         for nid in mesh_nodes:
                             remaining.pop(nid, None)
@@ -418,9 +424,181 @@ class DistributedExecutor(Executor):
                 span.set_tag("mesh.collective_bytes", cbytes)
                 meshgroup.note_dispatch(len(mesh_nodes), len(shard_list), cbytes)
                 return totals
-        except meshgroup.MeshUnsupported:
+        except meshgroup.MeshUnsupported as e:
             meshgroup.note_fallback()
+            self.stats.with_tags(
+                f"reason:{getattr(e, 'reason', 'unsupported')}"
+            ).count("mesh.fallback")
             return None
+
+    # ------------------------------------------------------------------
+    # versioned result cache: assembled version vectors (core/resultcache)
+    # ------------------------------------------------------------------
+
+    def version_vector(self, idx: Index, ctx, opt: ExecOptions, expect=None):
+        """The fan-out's assembled version vector: per owner node, the
+        versions of the fragments its partial would read — local and
+        in-process mesh members by direct (lock-free) reads, remote
+        peers over one parallel /internal/versions round. Per-node shard
+        lists are Shift-extended exactly like the legs' execution, so
+        the vector covers every fragment a leg actually touches. None =
+        uncacheable this round (unreachable peer, first sighting of an
+        RPC-vector key, topology lookup failure). `expect` (the
+        store-path guard's pre-execution vector): when the CHEAP
+        in-process parts already diverge from it — continuous local
+        ingest racing the query — bail before paying the remote RPC
+        round for a store that cannot succeed."""
+        if opt.remote or self._is_single_node():
+            return super().version_vector(idx, ctx, opt)
+        from pilosa_tpu.core import resultcache as rcache
+
+        cluster = self._cluster()
+        try:
+            remaining = dict(
+                cluster.shards_by_node(idx.name, list(ctx.shard_list))
+            )
+        except Exception:  # noqa: BLE001 - assembly is best-effort
+            return None
+        members = self._mesh_members()
+        parts: List[Any] = []
+        rpc: List[tuple] = []
+        for nid in sorted(remaining):
+            node_shards = tuple(
+                Executor._shards_for(
+                    self, idx, sorted(remaining[nid]), ctx.call
+                )
+            )
+            if nid == self.local_id:
+                parts.append(
+                    self.local_version_vector(
+                        idx, ctx.views, node_shards, node=nid
+                    )
+                )
+            elif nid in members:
+                idx2 = members[nid].index(idx.name)
+                if idx2 is None:
+                    return None
+                parts.append(
+                    self.local_version_vector(
+                        idx2, ctx.views, node_shards, node=nid
+                    )
+                )
+            else:
+                rpc.append((nid, node_shards))
+                parts.append(None)
+        if rpc:
+            if expect is not None and not self._parts_match_expect(
+                parts, expect, len(ctx.views)
+            ):
+                return None
+            # remote versions cost one RTT per peer: only repeat keys
+            # pay it (a one-off query would be taxed for nothing)
+            if not rcache.RESULT_CACHE.note_candidate(ctx.key):
+                return None
+            fetched = self._fetch_remote_versions(idx, ctx, rpc)
+            if fetched is None:
+                return None
+            it = iter(fetched)
+            parts = [next(it) if p is None else p for p in parts]
+        out: List[tuple] = []
+        for elems in parts:
+            out.extend(elems)
+        return tuple(out)
+
+    def clock_vector(self, idx: Index, ctx, opt: ExecOptions):
+        """The O(#views) clock fast path applies only where every clock
+        is readable in-process (single node, remote legs): coordinator
+        entries span peers whose clocks live behind the same RPC the
+        exact vector rides, so the fast path would save nothing."""
+        if opt.remote or self._is_single_node():
+            return super().clock_vector(idx, ctx, opt)
+        return None
+
+    @staticmethod
+    def _parts_match_expect(parts, expect, views_per_node) -> bool:
+        """Whether every already-collected (in-process) per-node part
+        equals its positional slice of `expect` — each node contributes
+        exactly one element per referenced view, so slices align unless
+        the assignment itself changed (then the mismatch is the right
+        answer too)."""
+        o = 0
+        for p in parts:
+            if p is not None and tuple(expect[o:o + views_per_node]) != p:
+                return False
+            o += views_per_node
+        return True
+
+    def _fetch_remote_versions(self, idx: Index, ctx, rpc):
+        """One parallel /internal/versions round; None when any peer is
+        unreachable or reports the call ineligible on its side."""
+        def fetch(t):
+            nid, node_shards = t
+            try:
+                resp = self.client.fragment_versions(
+                    self._uri_of(nid), idx.name, ctx.text, list(node_shards)
+                )
+            except Exception:  # noqa: BLE001 - degrade to uncacheable
+                return None
+            if not isinstance(resp, dict) or resp.get("views") is None:
+                return None
+            boot = str(resp.get("boot", ""))
+            try:
+                shards = tuple(int(s) for s in resp.get("shards", node_shards))
+                elems = []
+                for item in resp["views"]:
+                    if item[0] == "m":
+                        elems.append(("m", nid, item[1], item[2]))
+                    else:
+                        elems.append(
+                            ("v", nid, item[1], item[2],
+                             (boot, int(item[3])), shards,
+                             tuple(int(x) for x in item[4]))
+                        )
+                return tuple(elems)
+            except Exception:  # noqa: BLE001 - malformed peer payload
+                return None
+
+        if len(rpc) == 1:
+            fetched = [fetch(rpc[0])]
+        else:
+            fetched = list(self._fanout_pool().map(fetch, rpc))
+        if any(f is None for f in fetched):
+            return None
+        return fetched
+
+    def versions_payload(self, index_name: str, pql: str, shards):
+        """Serve /internal/versions (server/handler.py): this node's
+        version vector for one call over `shards`, Shift-extended the
+        way a leg's execution would extend them. Returns (shard_list,
+        elements) or None when the call is cache-ineligible here."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            return None
+        from pilosa_tpu.pql import parse
+        from pilosa_tpu.pql.parser import ParseError
+
+        try:
+            q = parse(pql)
+        except ParseError:
+            return None
+        if len(q.calls) != 1:
+            return None
+        c = q.calls[0]
+        ctx = self._cache_spec(
+            idx, c, list(shards), ExecOptions(remote=True)
+        )
+        if ctx is None:
+            return None
+        shard_list = tuple(
+            Executor._shards_for(self, idx, sorted(int(s) for s in shards), c)
+        )
+        out = []
+        for elem in self.local_version_vector(idx, ctx.views, shard_list):
+            if elem[0] == "m":
+                out.append(["m", elem[2], elem[3]])
+            else:
+                out.append(["v", elem[2], elem[3], elem[4], list(elem[6])])
+        return list(shard_list), out
 
     def count_lowering_class(self, index_name: str, query) -> str:
         """Which lowering a pure-Count query's batch round would ride:

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from pilosa_tpu.core import resultcache as rcache
 from pilosa_tpu.core import timeq
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
@@ -548,6 +549,56 @@ class _StackedLowering:
         return PNary("or", (pos, neg))
 
 
+# ---------------------------------------------------------------------------
+# Versioned result cache (core/resultcache.py): eligibility surface.
+# A call is cacheable when its referenced (field, view) set is STATICALLY
+# enumerable — anything data-dependent (time-quantum view discovery) or
+# version-blind (row attrs) makes it ineligible and it executes normally.
+# ---------------------------------------------------------------------------
+
+_CACHE_KINDS = {"Count": "count", "TopN": "topn", "GroupBy": "groupby"}
+_CACHE_BITMAP_OK = frozenset(
+    {"Row", "Union", "Intersect", "Difference", "Xor", "Not", "All",
+     "Shift", "Range"}
+)
+# args whose presence means time-view discovery (data-dependent views)
+_CACHE_TIME_ARGS = ("from", "to", "_start", "_end")
+# TopN attrName/attrValues/tanimotoThreshold read row attrs / source
+# counts outside the version vector — ineligible
+_CACHE_TOPN_ARGS = frozenset({"_field", "n", "ids", "threshold"})
+_CACHE_GROUPBY_ARGS = frozenset({"filter", "limit", "offset", "previous"})
+_CACHE_ROWS_ARGS = frozenset({"_field", "field", "limit", "previous", "column"})
+
+
+class _CacheCtx:
+    """One call's cache context: the key, the referenced views, and the
+    pre-execution version vector (None = uncacheable this round — the
+    spec was eligible but the vector could not be assembled, e.g. a
+    first sighting of an RPC-vector key or an unreachable peer)."""
+
+    __slots__ = (
+        "key", "kind", "views", "shard_list", "vector", "repair_row",
+        "text", "index_name", "opt_remote", "call", "clocks", "hit",
+        "hit_result",
+    )
+
+    def __init__(self, key, kind, views, shard_list, text, index_name,
+                 repair_row, opt_remote, call):
+        self.key = key
+        self.kind = kind
+        self.views = views  # canonical sorted ((field, view), ...)
+        self.shard_list = shard_list
+        self.text = text
+        self.index_name = index_name
+        self.repair_row = repair_row
+        self.opt_remote = opt_remote
+        self.call = call  # for per-node Shift shard-extension (distributed)
+        self.vector = None
+        self.clocks = None  # per-view mutation clocks, read pre-vector
+        self.hit = False
+        self.hit_result = None
+
+
 class Executor:
     """Single-node executor. Cluster fan-out wraps this via the same
     per-shard lowering (reference: executor.go:44)."""
@@ -595,6 +646,7 @@ class Executor:
             translation.translate_query(idx, query)
         results = []
         calls = query.calls
+        cache_hits = 0
         i = 0
         while i < len(calls):
             # Batch maximal runs of adjacent Count calls into one multi-root
@@ -610,19 +662,80 @@ class Executor:
             ):
                 j += 1
             if j - i >= 2 and self._counts_batchable(opt):
+                # per-call result-cache interplay: the run is reads-only,
+                # so every member's version vector can resolve up front;
+                # cached members serve from host memory and only the
+                # misses dispatch (whole-run batch when nothing hit)
+                ctxs = [
+                    self._cache_lookup(idx, cc, shards, opt)
+                    for cc in calls[i:j]
+                ]
+                if any(cx is not None and cx.hit for cx in ctxs):
+                    # serve the hits, and keep the MISSES batched: they
+                    # are still adjacent Counts, so they ride one
+                    # multi-root dispatch — one stale sibling must not
+                    # degrade the other nine to per-call dispatches
+                    miss = [
+                        (cc, cx)
+                        for cc, cx in zip(calls[i:j], ctxs)
+                        if not (cx is not None and cx.hit)
+                    ]
+                    miss_results = None
+                    if len(miss) >= 2:
+                        miss_results = self._execute_count_batch(
+                            idx, [cc for cc, _ in miss], shards, opt
+                        )
+                        if miss_results is not None:
+                            for (_, cx), r in zip(miss, miss_results):
+                                self._cache_store(idx, cx, r)
+                    it = iter(miss_results or ())
+                    for cc, cx in zip(calls[i:j], ctxs):
+                        if cx is not None and cx.hit:
+                            results.append(cx.hit_result)
+                            cache_hits += 1
+                        elif miss_results is not None:
+                            results.append(next(it))
+                        else:
+                            r = self._execute_call(idx, cc, shards, opt)
+                            self._cache_store(idx, cx, r)
+                            results.append(r)
+                    i = j
+                    continue
                 batch = self._execute_count_batch(idx, calls[i:j], shards, opt)
                 if batch is not None:
+                    for cx, r in zip(ctxs, batch):
+                        self._cache_store(idx, cx, r)
                     results.extend(batch)
                 else:
                     # no stacked form for some child: run the whole batch
                     # per-call (re-attempting ever-shorter batches would be
                     # O(run^2) lowering walks)
-                    for call in calls[i:j]:
-                        results.append(self._execute_call(idx, call, shards, opt))
+                    for cc, cx in zip(calls[i:j], ctxs):
+                        r = self._execute_call(idx, cc, shards, opt)
+                        self._cache_store(idx, cx, r)
+                        results.append(r)
                 i = j
                 continue
-            results.append(self._execute_call(idx, calls[i], shards, opt))
+            cx = self._cache_lookup(idx, calls[i], shards, opt)
+            if cx is not None and cx.hit:
+                results.append(cx.hit_result)
+                cache_hits += 1
+            else:
+                r = self._execute_call(idx, calls[i], shards, opt)
+                self._cache_store(idx, cx, r)
+                results.append(r)
             i += 1
+        if cache_hits:
+            # flight-recorder attribution (a sub-millisecond p50 in the
+            # histograms must be attributable, not mysterious): tag the
+            # enclosing api.query span; profiles and the slow-query log
+            # then show cache-served queries explicitly
+            from pilosa_tpu.utils import tracing
+
+            sp = tracing.active_span()
+            if sp is not None:
+                sp.set_tag("cache.hit", True)
+                sp.set_tag("cache.hits", cache_hits)
         resp = QueryResponse(results=results)
         # Column attrs for every column in any Row result (executor.go:164;
         # Options(columnAttrs=...) mutates opt before we get here). Columns
@@ -663,6 +776,297 @@ class Executor:
                     ext.update(range(sh + 1, sh + 1 + k))
                 s = sorted(ext)
         return s
+
+    # ------------------------------------------------------------------
+    # versioned result cache (core/resultcache.py)
+    # ------------------------------------------------------------------
+
+    def _cache_spec(self, idx: Index, c: Call, shards, opt: ExecOptions):
+        """Build the cache context for one call, or None when the call
+        is ineligible (unknown shape, data-dependent views, attr reads).
+        The key is (index scope token, canonical post-translation text,
+        resolved shard list, remote flag): remote legs return different
+        shapes (untrimmed TopN candidates) than coordinator results, so
+        they cache under distinct keys."""
+        kind = _CACHE_KINDS.get(c.name)
+        if kind is None or rcache.RESULT_CACHE.budget_bytes <= 0:
+            return None
+        scope = getattr(idx, "_cache_scope", None)
+        if scope is None:
+            return None
+        views: List[Tuple[str, str]] = []
+        repair_row = None
+        try:
+            if kind == "count":
+                if len(c.children) != 1 or c.args:
+                    return None
+                if not self._cache_views(idx, c.children[0], views):
+                    return None
+                repair_row = self._cache_repair_row(c.children[0])
+            elif kind == "topn":
+                if not set(c.args) <= _CACHE_TOPN_ARGS or len(c.children) > 1:
+                    return None
+                fname = c.args.get("_field")
+                if not isinstance(fname, str):
+                    return None
+                f = idx.field(fname)
+                if f is None or f.options.type == FIELD_TYPE_TIME:
+                    return None
+                views.append((fname, VIEW_STANDARD))
+                for child in c.children:
+                    if not self._cache_views(idx, child, views):
+                        return None
+            else:  # groupby
+                if not set(c.args) <= _CACHE_GROUPBY_ARGS:
+                    return None
+                if not c.children:
+                    return None
+                for child in c.children:
+                    if child.name != "Rows":
+                        return None
+                    if not set(child.args) <= _CACHE_ROWS_ARGS:
+                        return None
+                    fname = child.args.get("field") or child.args.get("_field")
+                    if not isinstance(fname, str):
+                        return None
+                    f = idx.field(fname)
+                    if f is None or f.options.type == FIELD_TYPE_TIME:
+                        return None
+                    views.append((fname, VIEW_STANDARD))
+                filt = c.args.get("filter")
+                if isinstance(filt, Call) and not self._cache_views(
+                    idx, filt, views
+                ):
+                    return None
+            shard_list = tuple(self._shards_for(idx, shards, c))
+        except Exception:  # noqa: BLE001 - eligibility is best-effort
+            return None
+        uniq = tuple(sorted(set(views)))
+        if not uniq:
+            return None
+        if repair_row is not None and len(uniq) != 1:
+            repair_row = None
+        text = str(c)
+        key = (scope, text, shard_list, bool(opt.remote))
+        return _CacheCtx(
+            key, kind, uniq, shard_list, text, idx.name, repair_row,
+            bool(opt.remote), c,
+        )
+
+    def _cache_views(self, idx: Index, c: Call, out: list) -> bool:
+        """Collect the (field, view) pairs a bitmap tree reads; False
+        when they are not statically enumerable (time-quantum ranges,
+        TIME fields whose view set depends on data bounds, unknown call
+        shapes)."""
+        if any(k in c.args for k in _CACHE_TIME_ARGS):
+            return False
+        name = c.name
+        if name in ("Union", "Intersect", "Difference", "Xor", "Shift"):
+            pass
+        elif name in ("Not", "All"):
+            ef = idx.existence_field()
+            if ef is None:
+                return False
+            out.append((ef.name, VIEW_STANDARD))
+        elif name in ("Row", "Range"):
+            conds = c.condition_args()
+            if conds:
+                if len(c.args) != 1 or len(conds) != 1 or c.children:
+                    return False
+                fname = next(iter(conds))
+                f = idx.field(fname)
+                if f is None or f.options.type == FIELD_TYPE_TIME:
+                    return False
+                out.append((fname, f.bsi_view_name()))
+                return True
+            args = [k for k in c.args if not k.startswith("_")]
+            if len(args) != 1 or c.children:
+                return False
+            fname = args[0]
+            rid = c.args[fname]
+            if isinstance(rid, bool) or not isinstance(rid, int):
+                return False  # untranslated key / call arg: let exec decide
+            f = idx.field(fname)
+            if f is None or f.options.type == FIELD_TYPE_TIME:
+                return False
+            out.append((fname, VIEW_STANDARD))
+            return True
+        else:
+            return False
+        for child in c.children:
+            if not self._cache_views(idx, child, out):
+                return False
+        for v in c.args.values():
+            if isinstance(v, Call) and not self._cache_views(idx, v, out):
+                return False
+        return True
+
+    @staticmethod
+    def _cache_repair_row(c: Call) -> Optional[int]:
+        """Count over a single plain Row is incrementally repairable:
+        the merge barrier's word delta patches the cached popcount in
+        place. Anything else (algebra, BSI, Not) falls back to
+        revalidate-or-recompute."""
+        if c.name != "Row" or c.children or c.condition_args():
+            return None
+        args = [k for k in c.args if not k.startswith("_")]
+        if len(args) != 1:
+            return None
+        rid = c.args[args[0]]
+        if isinstance(rid, bool) or not isinstance(rid, int):
+            return None
+        return rid
+
+    def local_version_vector(
+        self, idx: Index, views, shard_list, node: str = ""
+    ) -> tuple:
+        """The exact fragment-version vector this node would read for
+        `views` over `shard_list` — lock-free monotonic reads (every
+        mutation funnel bumps Fragment.version, staged writes included).
+        Elements carry the View's instance token so a delete/recreate
+        can never alias an old entry back to life."""
+        vec = []
+        for fname, vname in views:
+            f = idx.field(fname)
+            if f is None:
+                vec.append(("m", node, fname, ""))
+                continue
+            v = f.view(vname)
+            if v is None:
+                vec.append(("m", node, fname, vname))
+                continue
+            # hot loop (954 iterations per view on the bench geometry):
+            # one local dict ref + .get per shard, no method dispatch
+            frags = v.fragments
+            versions = tuple(
+                fr.version if (fr := frags.get(s)) is not None else -1
+                for s in shard_list
+            )
+            vec.append(
+                ("v", node, fname, vname, v._stack_token,
+                 tuple(shard_list), versions)
+            )
+        return tuple(vec)
+
+    def version_vector(
+        self, idx: Index, ctx: _CacheCtx, opt: ExecOptions, expect=None
+    ):
+        """Single-node: the local vector IS the vector. The distributed
+        executor overrides this with the fan-out's assembled vector
+        (local + in-process mesh members + remote peers). `expect` is
+        the store-path fast-fail hint: when the in-process parts
+        already diverge from it, assembly may bail (None) without
+        paying the remote version RPCs for a store that cannot
+        happen — local collection is cheap, so the base class ignores
+        it."""
+        return self.local_version_vector(idx, ctx.views, ctx.shard_list)
+
+    def clock_vector(self, idx: Index, ctx: _CacheCtx, opt: ExecOptions):
+        """O(#views) revalidation fast path: one mutation-clock integer
+        per referenced view (View.mutation_clock — bumped on every
+        mutation event that bumps a fragment version). Clock-equal
+        implies version-vector-equal, so the warm path never walks the
+        shard axis. None disables the fast path (the distributed
+        coordinator's entries span remote nodes whose clocks live
+        behind an RPC that dominates anyway)."""
+        vec = []
+        for fname, vname in ctx.views:
+            f = idx.field(fname)
+            if f is None:
+                vec.append(("m", "", fname, ""))
+                continue
+            v = f.view(vname)
+            if v is None:
+                vec.append(("m", "", fname, vname))
+                continue
+            vec.append(("c", v._stack_token, v.mutation_clock))
+        return tuple(vec)
+
+    def _cache_lookup(self, idx: Index, c: Call, shards, opt: ExecOptions):
+        """Resolve one call against the result cache. Returns None when
+        the call is ineligible; otherwise a _CacheCtx whose `hit` is set
+        when the stored result revalidated (or was repaired in place by
+        the read barrier this lookup ran)."""
+        ctx = self._cache_spec(idx, c, shards, opt)
+        if ctx is None:
+            return None
+        RC = rcache.RESULT_CACHE
+        # clock fast path: clocks are read BEFORE any vector they might
+        # arm, so a write racing the reads keeps the fast path disarmed
+        # (live clock moved past) instead of ever serving stale
+        clocks = ctx.clocks = self.clock_vector(idx, ctx, opt)
+        found, res = RC.get_by_clock(ctx.key, clocks)
+        if found:
+            ctx.hit = True
+            ctx.hit_result = res
+            return ctx
+        ctx.vector = self.version_vector(idx, ctx, opt)
+        if ctx.vector is None:
+            # unassemblable vector (first sighting of an RPC key, an
+            # unreachable peer): a lookup happened and nothing served —
+            # that is a miss on the dashboards, per observability.md
+            RC.count_miss()
+            return ctx
+        # miss accounting is deferred to the END of the lookup: a
+        # repaired serve is one hit, not a miss-then-hit (the repair
+        # retry would otherwise pin cacheHitRate at 0.5 on a fully
+        # cache-served dashboard)
+        found, res = RC.get(ctx.key, ctx.vector, recount=False)
+        if found:
+            RC.refresh_clocks(ctx.key, clocks)
+        elif ctx.repair_row is not None and RC.repairable(ctx.key):
+            # cheap repair: collect the current versions UNDER the read
+            # barrier — sync_pending runs the merge barrier, which fires
+            # note_merges and patches the cached Count from the burst's
+            # word delta (count += popcount(delta & ~old)); if the entry
+            # re-keyed to the live versions, serve it with zero
+            # dispatches and zero operand re-reads
+            clocks = ctx.clocks = self.clock_vector(idx, ctx, opt)
+            self._cache_barrier(idx, ctx)
+            vec2 = self.version_vector(idx, ctx, opt)
+            if vec2 is not None:
+                found, res = RC.get(ctx.key, vec2, recount=False)
+                ctx.vector = vec2
+                if found:
+                    RC.refresh_clocks(ctx.key, clocks)
+        if found:
+            ctx.hit = True
+            ctx.hit_result = res
+        else:
+            RC.count_miss()
+        return ctx
+
+    def _cache_barrier(self, idx: Index, ctx: _CacheCtx) -> None:
+        """Run the read barrier over the call's referenced views (the
+        same barrier execution would run first) so staged bursts merge
+        and the repair hook fires."""
+        for fname, vname in ctx.views:
+            f = idx.field(fname)
+            v = f.view(vname) if f is not None else None
+            if v is not None:
+                try:
+                    v.sync_pending(shards=ctx.shard_list)
+                except Exception:  # noqa: BLE001 - barrier is best-effort here
+                    return
+
+    def _cache_store(self, idx: Index, ctx, result) -> None:
+        """Store a freshly computed result, guarded against racing
+        writers: the vector is re-collected AFTER execution and the
+        entry is stored only when it equals the pre-execution one —
+        execution itself never bumps versions (barriers merge, stage
+        bumps already happened), so inequality means a concurrent
+        mutation landed mid-query and the result belongs to no single
+        version state."""
+        if ctx is None or ctx.vector is None or result is None:
+            return
+        opt = ExecOptions(remote=ctx.opt_remote)
+        vec2 = self.version_vector(idx, ctx, opt, expect=ctx.vector)
+        if vec2 != ctx.vector:
+            return
+        rcache.RESULT_CACHE.put(
+            ctx.key, ctx.kind, ctx.index_name, ctx.text, result, ctx.vector,
+            repair_row=ctx.repair_row, clocks=ctx.clocks,
+        )
 
     # ------------------------------------------------------------------
     # prefetch warming (pilosa_tpu/hbm/)

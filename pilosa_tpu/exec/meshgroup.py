@@ -47,7 +47,14 @@ from pilosa_tpu.utils.locks import TrackedLock
 
 class MeshUnsupported(Exception):
     """The call (or its operands) has no mesh-group form; the caller falls
-    back to per-node HTTP legs — never an error surface."""
+    back to per-node HTTP legs — never an error surface. `reason` is a
+    LOW-CARDINALITY tag (budget / no_stacked_form / unsupported) for the
+    `mesh.fallback` counter, so fallback-rate regressions are visible on
+    dashboards instead of silent."""
+
+    def __init__(self, msg: str = "", reason: str = "unsupported"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 # Calls the mesh-group path may fold into one sharded program. Shift is
@@ -480,9 +487,9 @@ def mesh_count(ex, gidx: GroupIndex, c: Call, shard_list: List[int]) -> Tuple[in
     try:
         lowered = ex._lower_roots(gidx, [c.children[0]], shard_list, empty_ok=True)
     except BudgetExceeded as e:
-        raise MeshUnsupported(str(e)) from e
+        raise MeshUnsupported(str(e), reason="budget") from e
     if lowered is None:
-        raise MeshUnsupported("no stacked form")
+        raise MeshUnsupported("no stacked form", reason="no_stacked_form")
     if lowered == ex._EMPTY_LOWER:
         return 0, 0
     roots, low, n_out, out_shards = lowered
@@ -513,9 +520,9 @@ def mesh_count_batch(ex, gidx: GroupIndex, calls: List[Call],
     try:
         lowered = ex._lower_roots(gidx, children, shard_list, empty_ok=True)
     except BudgetExceeded as e:
-        raise MeshUnsupported(str(e)) from e
+        raise MeshUnsupported(str(e), reason="budget") from e
     if lowered is None:
-        raise MeshUnsupported("no stacked form")
+        raise MeshUnsupported("no stacked form", reason="no_stacked_form")
     if lowered == ex._EMPTY_LOWER:
         return [0] * len(calls), 0
     roots, low, n_out, out_shards = lowered

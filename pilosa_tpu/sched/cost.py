@@ -231,6 +231,51 @@ def staged_merge_bytes(idx: Any, field_names: Optional[Set[str]] = None) -> int:
     return total
 
 
+def _probe_text(idx: Any, c: Call) -> Optional[str]:
+    """Canonical POST-translation text for the result-cache probe:
+    admission runs before the executor translates row keys to ids, but
+    cache entries are keyed on translated text, so a probe with raw key
+    strings would never match on a keyed field. Resolution here is
+    READ-ONLY (`find_key` — never creating ids the way execution's
+    translation may); an unresolvable key means no entry can exist, so
+    None (no discount)."""
+    s = str(c)
+    if '"' not in s:
+        return s  # no string args anywhere: already canonical
+    import copy as _copy
+
+    cc = _copy.deepcopy(c)
+    if not _probe_translate(idx, cc):
+        return None
+    return str(cc)
+
+
+def _probe_translate(idx: Any, c: Call) -> bool:
+    """Replace string row-key args with their ids in place, keyed-field
+    rows only (the shapes the cache deems eligible carry no other
+    translatable strings); False when any key cannot resolve."""
+    for k, v in list(c.args.items()):
+        if isinstance(v, Call):
+            if not _probe_translate(idx, v):
+                return False
+        elif (
+            isinstance(v, str)
+            and not k.startswith("_")
+            and k not in ("from", "to")
+        ):
+            f = idx.field(k) if idx is not None else None
+            if f is None or not getattr(f.options, "keys", False):
+                return False
+            rid = f.translate_store.find_key(v)
+            if rid is None:
+                return False
+            c.args[k] = rid
+    for child in c.children:
+        if not _probe_translate(idx, child):
+            return False
+    return True
+
+
 def _shard_count(idx: Any, shards: Optional[Sequence[int]]) -> int:
     if shards is not None:
         return max(1, len(shards))
@@ -323,6 +368,29 @@ def estimate(
                 continue
             peak = max(peak, min(raw, dispatch_cap))
             sweeps += max(1, math.ceil(raw / dispatch_cap))
+        if peak and idx is not None:
+            # result-cache discount FIRST: when every read call has a
+            # LIVE cached entry (key presence — the version check would
+            # cost what it saves), the query is cache-hit-likely and
+            # will serve from host memory with zero dispatches —
+            # charging it full device bytes would queue microsecond
+            # answers behind byte-budget waits, and the per-fragment
+            # residency/staged walks below would cost more than the
+            # whole cached answer
+            from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+            scope = getattr(idx, "_cache_scope", None)
+            read_calls = [c for c in calls if c.name not in _WRITE_CALLS]
+            if (
+                scope is not None
+                and read_calls
+                and all(
+                    (t := _probe_text(idx, c)) is not None
+                    and RESULT_CACHE.has_text(scope, t)
+                    for c in read_calls
+                )
+            ):
+                peak = 0
         if peak and idx is not None:
             # cached-resident discount: operands already in HBM stage for
             # free, so don't charge the byte account for them twice —

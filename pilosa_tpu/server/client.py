@@ -375,6 +375,25 @@ class InternalClient:
         channel for DDL a node missed while DOWN)."""
         self._json("POST", uri, "/schema", json.dumps({"indexes": schema}).encode())
 
+    def fragment_versions(
+        self,
+        uri: str,
+        index: str,
+        query: str,
+        shards: Sequence[int],
+        timeout: float = 5.0,
+    ) -> dict:
+        """One peer's fragment-version vector for a single call
+        (POST /internal/versions) — the result cache's remote
+        revalidation path. Short default timeout over the normal
+        retry/breaker plane: an unreachable peer degrades the cache to
+        a miss, never blocks the query."""
+        body = {"index": index, "query": query, "shards": list(shards)}
+        return self._json(
+            "POST", uri, "/internal/versions", json.dumps(body).encode(),
+            timeout=timeout,
+        ) or {}
+
     def node_stats(self, uri: str, timeout: float = 5.0) -> dict:
         """One peer's mergeable registry export (GET /internal/stats) —
         the federated rollup's pull path. Short default timeout: a dead

@@ -636,6 +636,30 @@ class Handler(BaseHTTPRequestHandler):
                 out["spans"] = spans[-128:]
         self._reply(out)
 
+    @route("POST", "/internal/versions")
+    def post_internal_versions(self):
+        """Result-cache revalidation (core/resultcache.py): the
+        coordinator asks for this node's fragment-version vector for
+        one call over a shard list — a cheap metadata read instead of a
+        full leg execution. `views: null` = the call is cache-ineligible
+        here (the coordinator then executes normally)."""
+        d = self._json_body_dict()
+        index = self._body_str(d, "index")
+        pql = self._body_str(d, "query")
+        shards = d.get("shards")
+        if not isinstance(shards, list) or not all(
+            isinstance(s, int) and not isinstance(s, bool) for s in shards
+        ):
+            raise BadParam("shards must be a list of integers")
+        payload = self.node.executor.versions_payload(index, pql, shards)
+        if payload is None:
+            self._reply({"views": None})
+            return
+        shard_list, views = payload
+        self._reply(
+            {"boot": self.node.boot_id, "shards": shard_list, "views": views}
+        )
+
     @route("POST", "/internal/cluster/message")
     def post_cluster_message(self):
         self._reply(self.api.receive_message(self._json_body()))

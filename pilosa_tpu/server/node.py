@@ -99,6 +99,8 @@ class NodeServer:
         mesh_min_nodes: int = 2,  # group-local owners before the fold engages; 0 off
         mesh_ici_gbps: float = 100.0,  # intra-group collective link (cost model)
         mesh_dcn_gbps: float = 3.0,  # cross-group HTTP/DCN link (cost model)
+        cache_result_mb: int = 64,  # result-cache LRU budget, MB; 0 disables
+        cache_count_repair: bool = True,  # in-place Count repair on bursts
         import_concurrency: int = 8,  # parallel replica-import RPCs per call
         resize_transfer_concurrency: int = 4,  # parallel fragment fetches
         resize_cutover_timeout: float = 30.0,  # catch-up barrier bound, s
@@ -227,6 +229,22 @@ class NodeServer:
 
         wal_mod.GROUP_COMMIT.configure(sync_interval=wal_sync_interval)
         wal_mod.GROUP_COMMIT.stats = self.stats
+        # versioned result cache (core/resultcache.py): process-global
+        # like the [hbm] knobs (entries stay node-scoped through the
+        # index/view tokens in their keys) — the last-constructed
+        # server's budget wins. boot_id salts the version vectors this
+        # node reports to coordinators: a restart replays versions from
+        # 0, so without it a coordinator's cached entry could alias a
+        # rebuilt-but-different fragment at the same version count.
+        import uuid
+
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        self.boot_id = uuid.uuid4().hex
+        RESULT_CACHE.configure(
+            budget_bytes=max(0, int(cache_result_mb)) << 20,
+            repair=cache_count_repair,
+        )
         self.prefetcher = None
         if hbm_prefetch_depth > 0 and self.scheduler is not None:
             self.prefetcher = hbmmod.Prefetcher(
@@ -644,6 +662,32 @@ class NodeServer:
                 self.stats.with_tags(f"index:{idx}").gauge(
                     "sched.index_inflight_bytes", nb
                 )
+        # versioned result cache (core/resultcache.py): hit/miss/repair
+        # counters plus per-index resident bytes (the sum over labels is
+        # the cache's whole footprint; an index that drained publishes a
+        # final 0 then leaves the working set, like hbm.resident_bytes)
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        csnap = RESULT_CACHE.stats_snapshot()
+        self.stats.gauge("cache.hits", csnap["hits"])
+        self.stats.gauge("cache.misses", csnap["misses"])
+        self.stats.gauge("cache.revalidations", csnap["revalidations"])
+        self.stats.gauge("cache.repairs", csnap["repairs"])
+        self.stats.gauge("cache.evictions", csnap["evictions"])
+        self.stats.gauge("cache.entries", csnap["entries"])
+        cache_by_index = csnap["by_index"]
+        cstale = getattr(self, "_cache_idx_published", set()) - set(
+            cache_by_index
+        )
+        self._cache_idx_published = set(cache_by_index)
+        for idx, nb in cache_by_index.items():
+            self.stats.with_tags(f"index:{idx}").gauge(
+                "cache.resident_bytes", nb
+            )
+        for idx in cstale:
+            self.stats.with_tags(f"index:{idx}").gauge(
+                "cache.resident_bytes", 0
+            )
 
     def drop_index_telemetry(self, index: str) -> None:
         """Label GC for a deleted index: remove every per-index metric
@@ -661,11 +705,19 @@ class NodeServer:
         from pilosa_tpu.exec import meshgroup
 
         meshgroup.drop_index(index)
+        # result-cache entries and their per-index byte attribution must
+        # not outlive the index (cache.resident_bytes{index} label GC)
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        RESULT_CACHE.drop_index(index)
         if self.scheduler is not None:
             self.scheduler.drop_index(index)
         published = getattr(self, "_hbm_idx_published", None)
         if published is not None:
             published.discard(index)
+        cache_published = getattr(self, "_cache_idx_published", None)
+        if cache_published is not None:
+            cache_published.discard(index)
 
     def _ticker_error(self, ticker: str, exc: BaseException) -> None:
         """Background tickers must survive any failure, but never silently:

@@ -106,6 +106,13 @@ class TimelineSampler:
         phase = (
             job.get("phase", "") if job.get("state") == "RUNNING" else ""
         )
+        # versioned result cache: resident footprint + hit rate on the
+        # timeline, so "queries went sub-millisecond" is explainable
+        # from the same ring that shows the load change
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        rsnap = RESULT_CACHE.stats_snapshot()
+        lookups = rsnap["hits"] + rsnap["misses"]
         now_mono = time.monotonic()
         sample = {
             "t": time.time(),
@@ -124,6 +131,11 @@ class TimelineSampler:
             "queries": queries,
             "resizePhase": phase,
             "walStagedPositions": srv.holder.staged_position_count(),
+            "cacheResidentBytes": rsnap["resident_bytes"],
+            "cacheEntries": rsnap["entries"],
+            "cacheHitRate": (
+                round(rsnap["hits"] / lookups, 4) if lookups else 0.0
+            ),
         }
         with self._mu:
             dt = (

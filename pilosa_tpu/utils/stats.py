@@ -122,6 +122,23 @@ STAT_NAMES = frozenset(
         "mesh.group_size",
         "mesh.local_shards",
         "mesh.collective_bytes",
+        # mesh-group fallbacks (exec/distributed.py): eligible fan-outs
+        # that bailed to HTTP legs at lowering time, tagged by reason
+        # ("budget" / "no_stacked_form" / "unsupported") so a fallback-
+        # rate regression — a 5-9x latency cliff — is visible instead of
+        # silent
+        "mesh.fallback",
+        # versioned result cache (core/resultcache.py, refreshed at
+        # scrape/sampler time by publish_cache_gauges): revalidated and
+        # repaired hits serve with zero compiled dispatches; resident
+        # bytes are attributed per index (label GC on index delete)
+        "cache.hits",
+        "cache.misses",
+        "cache.revalidations",
+        "cache.repairs",
+        "cache.evictions",
+        "cache.entries",
+        "cache.resident_bytes",
         # live elastic resize (server/node.py streaming resharding):
         # per-fragment transfer legs, delta catch-up volume, cutover
         # latency and aborted jobs
@@ -165,6 +182,8 @@ STAT_LABELS: Dict[str, Tuple[str, ...]] = {
     "sched.index_inflight_bytes": ("index",),
     "hbm.resident_bytes": ("index",),
     "hbm.restage_bytes": ("index",),
+    "cache.resident_bytes": ("index",),
+    "mesh.fallback": ("reason",),
     # federation meta-gauges (server/telemetry.py writes these into the
     # merged registry directly; the "cluster." prefix covers the names)
     "cluster.peer_stale": ("node",),

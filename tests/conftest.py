@@ -114,6 +114,23 @@ def _hbm_pin_leak_guard():
 
 
 @pytest.fixture(autouse=True)
+def _result_cache_isolation():
+    """Result-cache isolation (core/resultcache.py): the store is
+    process-global like DEVICE_CACHE, so entries, counters and the
+    configured budget must not leak across tests — reset to defaults
+    afterwards (the cache stays ENABLED suite-wide: every repeat query
+    in the suite then exercises revalidation against the recompute the
+    test asserts, which is free differential coverage)."""
+    yield
+    from pilosa_tpu.core import resultcache
+
+    resultcache.RESULT_CACHE.reset()
+    resultcache.RESULT_CACHE.configure(
+        budget_bytes=resultcache.DEFAULT_BUDGET_BYTES, repair=True
+    )
+
+
+@pytest.fixture(autouse=True)
 def _fault_plane_leak_guard():
     """State-leak guard: a test that installs a process-global
     FaultInjector or BreakerRegistry (faults.install_injector /

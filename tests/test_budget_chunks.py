@@ -43,6 +43,9 @@ def test_count_chunks_instead_of_per_shard(big_ix, monkeypatch, rng):
     h, ex, _ = big_ix
     want = ex.execute("i", "Count(Intersect(Row(f=1), Row(f=2)))")[0]
     _tight_budget(monkeypatch, mult=1)
+    from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+    RESULT_CACHE.reset()  # the probe asserts chunked dispatches, not the cache
     planmod.reset_stats()
     exmod.FALLBACK_STATS["count_reads"] = 0
     got = ex.execute("i", "Count(Intersect(Row(f=1), Row(f=2)))")

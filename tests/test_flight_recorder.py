@@ -470,7 +470,12 @@ def test_profile_count_reconciles_on_three_node_cluster():
     executing node's staging, compiled dispatch, and host read) —
     reconcile to within 10% of the reported query_ms; /metrics exports
     query_ms as a bucketed histogram with a finite p99."""
-    with ClusterHarness(3, replica_n=1, in_memory=True) as c:
+    # cache_result_mb=0: this acceptance probes the fan-out's span tree —
+    # a result-cache hit (the intended fast path) would skip the legs and
+    # dispatches the reconciliation is about
+    with ClusterHarness(
+        3, replica_n=1, in_memory=True, cache_result_mb=0
+    ) as c:
         api = c[0].api
         _seed(api, n_shards=12)
         # cold profiled run: staging attribution must be visible

@@ -98,6 +98,9 @@ class TestExtentPaging:
         # no pins survive the dispatch
         assert snap1["pinned_bytes"] == 0
 
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        RESULT_CACHE.reset()  # run 2 must exercise extent re-staging
         got2 = ex.execute("hbmx", q)[0]
         assert got2 == got1
         snap2 = hbm_res.stats_snapshot()

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.resultcache import RESULT_CACHE
 from pilosa_tpu.exec import Executor
 from pilosa_tpu.exec import executor as exmod
 from pilosa_tpu.exec import plan as planmod
@@ -47,6 +48,9 @@ def test_multicount_one_dispatch_matches_serial(ix):
     ]
     ex.execute("i", MULTI)  # warm
     planmod.reset_stats()
+    # this probe asserts the BATCH dispatch shape: drop the cached
+    # results so the repeat actually dispatches instead of revalidating
+    RESULT_CACHE.reset()
     got = ex.execute("i", MULTI)
     assert got == singles
     assert planmod.STATS["evals"] == 1  # four counts, ONE dispatch
@@ -89,6 +93,7 @@ def test_multicount_sparse_compaction(ix, rng):
     q = "Count(Row(g=1)) Count(Intersect(Row(g=1), Row(g=1)))"
     ex.execute("i", q)  # warm
     planmod.reset_stats()
+    RESULT_CACHE.reset()  # the probe asserts the dispatch, not the cache
     got = ex.execute("i", q)
     expect = 4 * len(range(0, 200, 33))
     assert got == [expect, expect]

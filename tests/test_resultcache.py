@@ -1,0 +1,813 @@
+"""Versioned result cache (core/resultcache.py): store units,
+revalidation and incremental count repair (counter-asserted: zero
+compiled dispatches, zero device reads, flat upload bytes on cached
+hits), invalidation funnels, per-index GC, the admission cost discount,
+and the differential harness — cached == recomputed bit-for-bit across
+randomized set/clear/mutex/bulk interleavings on the single-node, HTTP
+fan-out and mesh-group paths, with the naive model as the Count oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.naive import NaiveBitmap
+from pilosa_tpu.core.resultcache import RESULT_CACHE, ResultCache
+from pilosa_tpu.exec import plan as planmod
+from pilosa_tpu.hbm import residency as hbm_res
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import ClusterHarness
+
+
+def _snap():
+    return RESULT_CACHE.stats_snapshot()
+
+
+def _seed_counts():
+    s = _snap()
+    return (
+        s["hits"], s["misses"], s["repairs"], s["stores"],
+        planmod.STATS["evals"], planmod.STATS["host_reads"],
+        hbm_res.stats_snapshot()["restage_bytes"],
+    )
+
+
+def _harness(n=1, **kw):
+    kw.setdefault("in_memory", True)
+    kw.setdefault("telemetry_sample_interval", 0.0)
+    return ClusterHarness(n, **kw)
+
+
+def _seed(api, index="i", rows=(1, 2, 3), n=200, shards=2, seed=7):
+    rng = np.random.default_rng(seed)
+    api.create_index(index)
+    api.create_field(index, "f")
+    for r in rows:
+        cols = rng.integers(0, shards * SHARD_WIDTH, n).astype(np.uint64)
+        api.import_bits(index, "f", np.full(len(cols), r, np.uint64), cols)
+
+
+# ---------------------------------------------------------------------------
+# store units
+# ---------------------------------------------------------------------------
+
+
+def _vec(token, shards=(0, 1), versions=(0, 0)):
+    return (("v", "", "f", "standard", token, tuple(shards), tuple(versions)),)
+
+
+class TestStore:
+    def test_lru_byte_budget_evicts_oldest(self):
+        rc = ResultCache(budget_bytes=600)
+        for i in range(8):
+            rc.put((i, f"q{i}", (0,), False), "count", "i", f"q{i}", i, _vec(i))
+        snap = rc.stats_snapshot()
+        assert snap["resident_bytes"] <= 600
+        assert snap["evictions"] > 0
+        # the newest entry survived, the oldest did not
+        assert rc.get((7, "q7", (0,), False), _vec(7))[0]
+        assert not rc.get((0, "q0", (0,), False), _vec(0), recount=False)[0]
+
+    def test_version_mismatch_misses(self):
+        rc = ResultCache()
+        rc.put(("k", "q", (0,), False), "count", "i", "q", 5, _vec(1))
+        assert rc.get(("k", "q", (0,), False), _vec(1)) == (True, 5)
+        found, _ = rc.get(("k", "q", (0,), False), _vec(1, versions=(0, 3)))
+        assert not found
+
+    def test_zero_budget_disables(self):
+        rc = ResultCache(budget_bytes=0)
+        rc.put(("k", "q", (0,), False), "count", "i", "q", 5, _vec(1))
+        assert rc.stats_snapshot()["entries"] == 0
+        assert rc.get(("k", "q", (0,), False), _vec(1)) == (False, None)
+
+    def test_per_index_attribution_and_drop(self):
+        rc = ResultCache()
+        rc.put(("a", "q", (0,), False), "count", "idx_a", "q", 1, _vec(1))
+        rc.put(("b", "q", (0,), False), "count", "idx_b", "q", 2, _vec(2))
+        by = rc.stats_snapshot()["by_index"]
+        assert set(by) == {"idx_a", "idx_b"} and all(v > 0 for v in by.values())
+        rc.drop_index("idx_a")
+        by = rc.stats_snapshot()["by_index"]
+        assert set(by) == {"idx_b"}
+        assert not rc.get(("a", "q", (0,), False), _vec(1), recount=False)[0]
+
+    def test_note_mutation_drops_nonrepairable_only(self):
+        rc = ResultCache()
+        rc.put(("k", "t", (0,), False), "topn", "i", "t", [1], _vec(9))
+        rc.put(
+            ("k", "c", (0,), False), "count", "i", "c", 4, _vec(9),
+            repair_row=1,
+        )
+        rc.note_mutation(9, 0)
+        assert rc.stats_snapshot()["entries"] == 1  # the Count stayed
+        rc.note_mutation(9, 5)  # uncovered shard: no-op
+        assert rc.stats_snapshot()["entries"] == 1
+
+    def test_mutated_results_do_not_poison_the_store(self):
+        rc = ResultCache()
+        pairs = [{"id": 1}]
+        rc.put(("k", "t", (0,), False), "topn", "i", "t", pairs, _vec(3))
+        pairs[0]["id"] = 99  # caller mutates its own copy post-store
+        found, got = rc.get(("k", "t", (0,), False), _vec(3))
+        assert found and got == [{"id": 1}]
+        got[0]["id"] = 77  # reader mutates the served copy
+        assert rc.get(("k", "t", (0,), False), _vec(3))[1] == [{"id": 1}]
+
+    def test_has_text(self):
+        rc = ResultCache()
+        rc.put(("s", "q1", (0,), False), "count", "i", "q1", 1, _vec(1))
+        assert rc.has_text("s", "q1")
+        assert not rc.has_text("s", "q2")
+        assert not rc.has_text(None, "q1")
+        rc.drop_index("i")
+        assert not rc.has_text("s", "q1")
+
+
+# ---------------------------------------------------------------------------
+# single-node revalidation: zero dispatches, zero device reads
+# ---------------------------------------------------------------------------
+
+
+class TestRevalidation:
+    def test_count_topn_groupby_serve_with_zero_dispatches(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            api.create_field("i", "g")
+            api.import_bits(
+                "i", "g", np.full(64, 1, np.uint64),
+                np.arange(64, dtype=np.uint64),
+            )
+            queries = [
+                "Count(Intersect(Row(f=1), Row(f=2)))",
+                "Count(Not(Row(f=1)))",
+                "TopN(f, n=2)",
+                "GroupBy(Rows(f), Rows(g))",
+            ]
+            cold = [api.query("i", q) for q in queries]
+            h0, m0, _, _, e0, r0, u0 = _seed_counts()
+            warm = [api.query("i", q) for q in queries]
+            h1, m1, _, _, e1, r1, u1 = _seed_counts()
+            assert warm == cold
+            assert (e1 - e0, r1 - r0) == (0, 0)  # no dispatch, no read
+            assert u1 - u0 == 0  # no host->device upload
+            assert h1 - h0 == len(queries)
+            assert m1 - m0 == 0
+
+    def test_partial_hit_run_keeps_misses_batched(self):
+        """One cached sibling in an adjacent-Count run must not degrade
+        the misses to per-call dispatches: the miss subset still rides
+        ONE multi-root batch."""
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            api.create_field("i", "g")
+            api.import_bits(
+                "i", "g", np.full(60, 1, np.uint64),
+                np.arange(60, dtype=np.uint64),
+            )
+            api.import_bits(
+                "i", "g", np.full(40, 2, np.uint64),
+                np.arange(40, dtype=np.uint64),
+            )
+            q3 = "Count(Row(f=1))Count(Row(g=1))Count(Row(g=2))"
+            want = api.query("i", q3)
+            assert api.query("i", q3) == want  # all three cached
+            api.query("i", "Set(99, g=1)")  # stale g entries, f still hot
+            e0 = planmod.STATS["evals"]
+            got = api.query("i", q3)
+            assert got == [want[0], want[1] + 1, want[2]]
+            # f served from cache; BOTH g misses shared one dispatch
+            assert planmod.STATS["evals"] - e0 == 1, planmod.STATS
+
+    def test_any_write_invalidates(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            q = "Count(Row(f=1))"
+            before = api.query("i", q)[0]
+            assert api.query("i", q)[0] == before
+            api.query("i", f"Set({5 * SHARD_WIDTH - 1}, f=1)")
+            after = api.query("i", q)[0]
+            assert after == before + 1
+            assert api.query("i", q)[0] == after
+
+    def test_clear_invalidates(self):
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "f")
+            cols = np.arange(100, dtype=np.uint64)
+            api.import_bits("i", "f", np.full(100, 1, np.uint64), cols)
+            q = "Count(Row(f=1))"
+            assert api.query("i", q)[0] == 100
+            assert api.query("i", q)[0] == 100
+            api.import_bits(
+                "i", "f", np.full(40, 1, np.uint64), cols[:40], clear=True
+            )
+            assert api.query("i", q)[0] == 60
+            assert api.query("i", q)[0] == 60
+
+    def test_read_after_write_within_one_query(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            base = api.query("i", "Count(Row(f=1))")[0]
+            api.query("i", "Count(Row(f=1))")  # cached
+            col = 3 * SHARD_WIDTH // 2
+            got = api.query(
+                "i", f"Set({col}, f=1) Count(Row(f=1))"
+            )
+            assert got[1] == base + 1
+
+    def test_time_args_are_ineligible(self):
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field(
+                "i", "t", {"type": "time", "time_quantum": "YMD"}
+            )
+            api.import_bits(
+                "i", "t", np.full(10, 1, np.uint64),
+                np.arange(10, dtype=np.uint64),
+                timestamps=["2024-01-02T03:04"] * 10,
+            )
+            s0 = _snap()["stores"]
+            q = "Count(Row(t=1, from='2024-01-01T00:00', to='2025-01-01T00:00'))"
+            r1 = api.query("i", q)
+            r2 = api.query("i", q)
+            assert r1 == r2 == [10]
+            assert _snap()["stores"] == s0  # never cached
+
+    def test_profile_marks_cache_served_queries(self):
+        """A sub-millisecond p50 must be attributable: profiled repeats
+        carry a cache.hit span tag in the assembled trace (on the
+        api.query root, or on the exec.batch span when the count
+        batcher led the execution)."""
+
+        def _tagged(node):
+            if node["tags"].get("cache.hit"):
+                return True
+            return any(_tagged(ch) for ch in node.get("children", []))
+
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            for q in (
+                "Count(Intersect(Row(f=1), Row(f=2)))",  # batcher-led
+                "TopN(f, n=2)",  # direct: tag on the api.query root
+            ):
+                cold = api.query_response("i", q, profile=True)
+                assert not any(_tagged(r) for r in cold.profile["roots"])
+                warm = api.query_response("i", q, profile=True)
+                assert warm.results == cold.results
+                assert any(_tagged(r) for r in warm.profile["roots"]), q
+
+    def test_recalculate_caches_flushes(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            q = "TopN(f, n=2)"
+            api.query("i", q)
+            api.query("i", q)
+            e0 = _snap()["entries"]
+            assert e0 > 0
+            api.recalculate_caches()
+            assert _snap()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# incremental count repair
+# ---------------------------------------------------------------------------
+
+
+class TestCountRepair:
+    def _setup(self, c):
+        api = c[0].api
+        api.create_index("i")
+        api.create_field("i", "f")
+        cols = np.arange(100, dtype=np.uint64)
+        api.import_bits("i", "f", np.full(100, 1, np.uint64), cols)
+        q = "Count(Row(f=1))"
+        assert api.query("i", q)[0] == 100
+        assert api.query("i", q)[0] == 100  # cached
+        return api, q
+
+    def test_set_only_burst_repairs_in_place(self):
+        with _harness(1) as c:
+            api, q = self._setup(c)
+            # staged burst: 50 already-set + 150 new bits (overlap makes
+            # popcount(delta & ~old) != popcount(delta))
+            api.import_bits(
+                "i", "f", np.full(200, 1, np.uint64),
+                np.arange(50, 250, dtype=np.uint64),
+            )
+            h0, m0, p0, s0, e0, r0, u0 = _seed_counts()
+            got = api.query("i", q)[0]
+            h1, m1, p1, s1, e1, r1, u1 = _seed_counts()
+            assert got == 250
+            assert p1 - p0 == 1  # one in-place repair
+            assert h1 - h0 == 1  # served from the cache
+            assert m1 - m0 == 0  # a repaired serve is NOT also a miss
+            assert s1 - s0 == 0  # no re-store: the entry was patched
+            assert (e1 - e0, r1 - r0) == (0, 0)  # zero dispatch/read
+            assert u1 - u0 == 0  # operand words never re-uploaded
+            # oracle: the naive model agrees
+            assert got == NaiveBitmap(range(250)).count()
+
+    def test_burst_to_other_row_rekeys_without_recompute(self):
+        with _harness(1) as c:
+            api, q = self._setup(c)
+            api.import_bits(
+                "i", "f", np.full(80, 2, np.uint64),
+                np.arange(80, dtype=np.uint64),
+            )
+            h0, _, p0, _, e0, _, _ = _seed_counts()
+            assert api.query("i", q)[0] == 100
+            h1, _, p1, _, e1, _, _ = _seed_counts()
+            assert h1 - h0 == 1  # still a cache hit
+            assert p1 - p0 == 0  # row untouched: re-key only, no patch
+            assert e1 - e0 == 0
+
+    def test_clear_falls_back_to_recompute(self):
+        with _harness(1) as c:
+            api, q = self._setup(c)
+            api.import_bits(
+                "i", "f", np.full(30, 1, np.uint64),
+                np.arange(30, dtype=np.uint64), clear=True,
+            )
+            p0 = _snap()["repairs"]
+            assert api.query("i", q)[0] == 70
+            assert _snap()["repairs"] == p0  # non-monotone: no repair
+            assert api.query("i", q)[0] == 70
+
+    def test_mutex_writes_fall_back_to_recompute(self):
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "m", {"type": "mutex"})
+            cols = np.arange(50, dtype=np.uint64)
+            api.import_bits("i", "m", np.full(50, 1, np.uint64), cols)
+            q = "Count(Row(m=1))"
+            assert api.query("i", q)[0] == 50
+            assert api.query("i", q)[0] == 50
+            # mutex steal: cols 0..19 move to row 2
+            api.import_bits("i", "m", np.full(20, 2, np.uint64), cols[:20])
+            assert api.query("i", q)[0] == 30
+            assert api.query("i", "Count(Row(m=2))")[0] == 20
+
+    def test_repair_disabled_still_correct(self):
+        with _harness(1, cache_count_repair=False) as c:
+            api, q = self._setup(c)
+            api.import_bits(
+                "i", "f", np.full(100, 1, np.uint64),
+                np.arange(100, 200, dtype=np.uint64),
+            )
+            p0 = _snap()["repairs"]
+            assert api.query("i", q)[0] == 200
+            assert _snap()["repairs"] == p0
+            assert api.query("i", q)[0] == 200
+
+    def test_repeated_bursts_chain_repairs(self):
+        with _harness(1) as c:
+            api, q = self._setup(c)
+            total = set(range(100))
+            rng = np.random.default_rng(11)
+            for _ in range(5):
+                cols = rng.integers(0, 3 * SHARD_WIDTH, 300).astype(np.uint64)
+                api.import_bits(
+                    "i", "f", np.full(len(cols), 1, np.uint64), cols
+                )
+                total.update(int(x) for x in cols)
+                assert api.query("i", q)[0] == len(total)
+            assert _snap()["repairs"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# distributed paths
+# ---------------------------------------------------------------------------
+
+
+class TestFanoutPath:
+    def test_coordinator_caches_on_assembled_vector(self):
+        with _harness(3) as c:
+            api = c[0].api
+            _seed(api, shards=6)
+            q = "Count(Intersect(Row(f=1), Row(f=2)))"
+            results = [api.query("i", q)[0] for _ in range(4)]
+            assert len(set(results)) == 1
+            # candidate gating: sighting 1 uncached, 2 stores, 3+ hit
+            h = _snap()["hits"]
+            assert h >= 1
+            e0 = planmod.STATS["evals"]
+            assert api.query("i", q)[0] == results[0]
+            assert planmod.STATS["evals"] == e0  # hit: no dispatch anywhere
+
+    def test_write_through_any_node_refreshes(self):
+        with _harness(3) as c:
+            _seed(c[0].api, shards=6)
+            q = "Count(Row(f=1))"
+            vals = [c[0].api.query("i", q)[0] for _ in range(3)]
+            # write lands through a DIFFERENT node's api
+            col = 5 * SHARD_WIDTH + 17
+            c[1].api.import_bits(
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64),
+            )
+            got = c[0].api.query("i", q)[0]
+            assert got == vals[0] + 1
+            assert c[0].api.query("i", q)[0] == got
+
+    def test_remote_leg_results_cache_on_the_peer(self):
+        with _harness(2) as c:
+            _seed(c[0].api, shards=4)
+            q = "Count(Row(f=1))"
+            for _ in range(3):
+                c[0].api.query("i", q)
+            # the peers executed legs with remote=True: their executors
+            # cached the leg partials under remote-scoped keys
+            assert _snap()["stores"] >= 1
+
+
+class TestMeshPath:
+    def test_mesh_repeats_hit_without_rpc_gating(self):
+        with _harness(3, mesh_group="rc-ici") as c:
+            api = c[0].api
+            _seed(api, shards=6)
+            q = "Count(Union(Row(f=1), Row(f=3)))"
+            cold = api.query("i", q)[0]
+            h0, _, _, _, e0, r0, _ = _seed_counts()
+            warm = api.query("i", q)[0]
+            h1, _, _, _, e1, r1, _ = _seed_counts()
+            assert warm == cold
+            # in-process members need no RPC: the SECOND query already
+            # serves from the assembled in-process vector
+            assert h1 - h0 == 1
+            assert (e1 - e0, r1 - r0) == (0, 0)
+
+    def test_member_write_invalidates_group_entry(self):
+        with _harness(3, mesh_group="rc-ici2") as c:
+            api = c[0].api
+            _seed(api, shards=6)
+            q = "Count(Row(f=1))"
+            base = api.query("i", q)[0]
+            assert api.query("i", q)[0] == base
+            # find a column owned by a non-coordinator member and set it
+            cluster = c[0].cluster
+            for s in range(6):
+                owner = cluster.shard_nodes("i", s)[0]
+                if owner.id != c[0].node.id:
+                    break
+            col = s * SHARD_WIDTH + 12345
+            c[1].api.import_bits(
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64),
+            )
+            got = api.query("i", q)[0]
+            assert got == base + 1
+
+
+# ---------------------------------------------------------------------------
+# differential: cached == recomputed bit-for-bit across randomized
+# mutation interleavings, naive model as the Count oracle
+# ---------------------------------------------------------------------------
+
+
+_DIFF_EXPRS = [
+    ("Count(Row(f=1))", lambda m, ex: len(m[("f", 1)])),
+    ("Count(Row(m=1))", lambda m, ex: len(m[("m", 1)])),
+    (
+        "Count(Intersect(Row(f=1), Row(g=1)))",
+        lambda m, ex: NaiveBitmap(m[("f", 1)])
+        .intersect(NaiveBitmap(m[("g", 1)]))
+        .count(),
+    ),
+    (
+        "Count(Union(Row(f=0), Row(g=2)))",
+        lambda m, ex: NaiveBitmap(m[("f", 0)])
+        .union(NaiveBitmap(m[("g", 2)]))
+        .count(),
+    ),
+    (
+        "Count(Difference(Row(f=1), Row(g=0)))",
+        lambda m, ex: NaiveBitmap(m[("f", 1)])
+        .difference(NaiveBitmap(m[("g", 0)]))
+        .count(),
+    ),
+    (
+        "Count(Xor(Row(f=2), Row(g=2)))",
+        lambda m, ex: NaiveBitmap(m[("f", 2)])
+        .xor(NaiveBitmap(m[("g", 2)]))
+        .count(),
+    ),
+    (
+        "Count(Not(Row(f=1)))",
+        lambda m, ex: len(ex - m[("f", 1)]),
+    ),
+]
+_DIFF_RECOMPUTE_ONLY = ["TopN(f, n=3)", "GroupBy(Rows(f), Rows(g))"]
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("mode", ["single", "fanout", "mesh"])
+    def test_cached_equals_recomputed_under_mutations(self, mode, rng):
+        n = 1 if mode == "single" else 3
+        kw = {"mesh_group": "dif-ici"} if mode == "mesh" else {}
+        n_shards = 3
+        with _harness(n, **kw) as c:
+            api = c[0].api
+            api.create_index("d")
+            for fname in ("f", "g"):
+                api.create_field("d", fname)
+            api.create_field("d", "m", {"type": "mutex"})
+            model = {
+                (fl, r): set() for fl in ("f", "g", "m") for r in range(3)
+            }
+            mutex_owner: dict = {}
+            existence: set = set()
+
+            def do_import(fl, clear=False):
+                r = int(rng.integers(0, 3))
+                cols = np.unique(
+                    rng.integers(0, n_shards * SHARD_WIDTH, 120)
+                ).astype(np.uint64)
+                node = c[int(rng.integers(0, n))]
+                node.api.import_bits(
+                    "d", fl, np.full(len(cols), r, np.uint64), cols,
+                    clear=clear,
+                )
+                existence.update(int(x) for x in cols)
+                if fl == "m":
+                    for col in (int(x) for x in cols):
+                        old = mutex_owner.get(col)
+                        if old is not None:
+                            model[("m", old)].discard(col)
+                        mutex_owner[col] = r
+                        model[("m", r)].add(col)
+                elif clear:
+                    model[(fl, r)].difference_update(int(x) for x in cols)
+                else:
+                    model[(fl, r)].update(int(x) for x in cols)
+
+            def check_query():
+                pql, expect = _DIFF_EXPRS[
+                    int(rng.integers(0, len(_DIFF_EXPRS)))
+                ]
+                node = c[int(rng.integers(0, n))]
+                want = expect(model, existence)
+                got = node.api.query("d", pql)[0]
+                assert got == want, (pql, got, want)
+                # repeat immediately: the cached answer must agree
+                assert node.api.query("d", pql)[0] == want, pql
+
+            do_import("f")
+            do_import("g")
+            do_import("m")
+            for _ in range(40):
+                roll = rng.random()
+                if roll < 0.25:
+                    do_import("f")
+                elif roll < 0.4:
+                    do_import("g")
+                elif roll < 0.5:
+                    do_import("m")
+                elif roll < 0.6:
+                    do_import("f", clear=True)
+                else:
+                    check_query()
+            # final sweep: every expression, cached vs naive vs a fresh
+            # recompute with the cache dropped
+            for pql, expect in _DIFF_EXPRS:
+                want = expect(model, existence)
+                cached = api.query("d", pql)[0]
+                assert cached == want, (pql, cached, want)
+            for pql in _DIFF_RECOMPUTE_ONLY:
+                cached = api.query("d", pql)
+                cached2 = api.query("d", pql)
+                RESULT_CACHE.reset()
+                fresh = api.query("d", pql)
+                assert cached == cached2 == fresh, pql
+
+
+# ---------------------------------------------------------------------------
+# GC + cost discount + concurrency
+# ---------------------------------------------------------------------------
+
+
+class TestClockFastPath:
+    """The O(#views) revalidation fast path (View.mutation_clock):
+    sound only if EVERY mutation funnel that bumps a fragment version
+    also bumps its view's clock — probe each funnel and assert both the
+    bump and post-mutation correctness."""
+
+    def test_every_mutation_funnel_bumps_the_clock(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            v = c[0].holder.index("i").field("f").view("standard")
+            q = "Count(Row(f=1))"
+
+            def served():
+                api.query("i", q)
+                return api.query("i", q)[0]
+
+            base = served()
+            mutations = [
+                # staged bulk router (stage_bulk, notify=False path)
+                lambda: api.import_bits(
+                    "i", "f", np.array([1], np.uint64),
+                    np.array([SHARD_WIDTH + 1], np.uint64),
+                ),
+                # exact clear import (import_positions funnel)
+                lambda: api.import_bits(
+                    "i", "f", np.array([1], np.uint64),
+                    np.array([SHARD_WIDTH + 1], np.uint64), clear=True,
+                ),
+                # single-bit PQL writes (set_bit/clear_bit funnels)
+                lambda: api.query("i", f"Set({SHARD_WIDTH + 2}, f=1)"),
+                lambda: api.query("i", f"Clear({SHARD_WIDTH + 2}, f=1)"),
+            ]
+            expect = base
+            deltas = [1, -1, 1, -1]
+            for mutate, d in zip(mutations, deltas):
+                clock0 = v.mutation_clock
+                mutate()
+                assert v.mutation_clock > clock0, mutate
+                expect += d
+                assert served() == expect
+
+    def test_exact_revalidation_rearms_the_clock(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            api.create_field("i", "g")
+            api.import_bits(
+                "i", "g", np.full(8, 1, np.uint64),
+                np.arange(8, dtype=np.uint64),
+            )
+            q = "Count(Row(f=1))"
+            api.query("i", q)
+            api.query("i", q)
+            (entry,) = RESULT_CACHE._entries.values()
+            assert entry.clocks is not None  # armed at store
+            # a write to ANOTHER FIELD's view leaves f's clock alone:
+            # the repeat stays on the fast path
+            api.import_bits(
+                "i", "g", np.array([1], np.uint64),
+                np.array([9], np.uint64),
+            )
+            h0 = _snap()["hits"]
+            api.query("i", q)
+            assert _snap()["hits"] == h0 + 1
+            assert entry.clocks is not None
+
+
+class TestGC:
+    def test_index_churn_returns_cache_to_baseline(self):
+        with _harness(1) as c:
+            srv = c[0]
+            base_bytes = _snap()["resident_bytes"]
+            for i in range(20):
+                name = f"churn_{i}"
+                _seed(srv.api, index=name, n=30, shards=1)
+                srv.api.query(name, "Count(Row(f=1))")
+                srv.api.query(name, "Count(Row(f=1))")  # stores + hits
+                assert _snap()["by_index"].get(name, 0) > 0
+                srv.api.delete_index(name)
+                assert name not in _snap()["by_index"]
+            snap = _snap()
+            assert snap["resident_bytes"] == base_bytes
+            assert not any(k.startswith("churn_") for k in snap["by_index"])
+
+    def test_field_delete_drops_covering_entries(self):
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api)
+            q = "Count(Row(f=1))"
+            api.query("i", q)
+            api.query("i", q)
+            assert _snap()["entries"] > 0
+            api.delete_field("i", "f")
+            assert _snap()["entries"] == 0
+
+
+class TestCostDiscount:
+    def test_cache_hit_likely_queries_admit_byte_free(self):
+        from pilosa_tpu.pql import parse
+        from pilosa_tpu.sched import cost as costmod
+
+        with _harness(1) as c:
+            api = c[0].api
+            _seed(api, n=500, shards=4)
+            api.create_field("i", "g")
+            api.import_bits(
+                "i", "g", np.full(64, 1, np.uint64),
+                np.arange(64, dtype=np.uint64),
+            )
+            idx = c[0].holder.index("i")
+            q = parse("Count(Row(f=1))")
+            cold = costmod.estimate(idx, q)
+            assert cold.device_bytes > 0
+            api.query("i", "Count(Row(f=1))")  # stores the entry
+            warm = costmod.estimate(idx, q)
+            assert warm.device_bytes == 0
+            # no text aliasing: an uncached query over an un-staged field
+            # keeps its full admission weight
+            other = costmod.estimate(idx, parse("Count(Row(g=1))"))
+            assert other.device_bytes > 0
+            # a covered mutation makes the entry maybe-stale: its repeat
+            # may recompute at full cost, so the discount must NOT let
+            # it bypass the byte budget (the staged surcharge applies)
+            api.import_bits(
+                "i", "f", np.array([1], np.uint64),
+                np.array([3], np.uint64),
+            )
+            stale = costmod.estimate(idx, q)
+            assert stale.device_bytes > 0
+            # a served repeat (repair or recompute+restore) proves the
+            # entry fresh again and re-arms the discount
+            api.query("i", "Count(Row(f=1))")
+            again = costmod.estimate(idx, q)
+            assert again.device_bytes == 0
+
+    def test_discount_resolves_row_keys_read_only(self):
+        """Admission sees PRE-translation text but entries key on
+        translated text: the probe resolves row keys via find_key —
+        read-only, never minting ids — so keyed-field repeats still
+        admit byte-free."""
+        from pilosa_tpu.pql import parse
+        from pilosa_tpu.sched import cost as costmod
+
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("k")
+            api.create_field("k", "f", {"keys": True})
+            api.import_bits(
+                "k", "f", ["alpha"] * 30, np.arange(30, dtype=np.uint64)
+            )
+            idx = c[0].holder.index("k")
+            f = idx.field("f")
+            api.query("k", 'Count(Row(f="alpha"))')  # stores (translated)
+            warm = costmod.estimate(idx, parse('Count(Row(f="alpha"))'))
+            assert warm.device_bytes == 0
+            # unknown key: no discount decision may CREATE the id
+            costmod.estimate(idx, parse('Count(Row(f="nope"))'))
+            assert f.translate_store.find_key("nope") is None
+
+
+class TestConcurrency:
+    def test_readers_race_staged_writers_stay_exact(self):
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "f")
+            api.import_bits(
+                "i", "f", np.full(100, 1, np.uint64),
+                np.arange(100, dtype=np.uint64),
+            )
+            stop = threading.Event()
+            errors: list = []
+            written: set = set(range(100))
+            lock = threading.Lock()
+
+            def writer():
+                rng = np.random.default_rng(5)
+                while not stop.is_set():
+                    cols = rng.integers(0, 2 * SHARD_WIDTH, 50).astype(
+                        np.uint64
+                    )
+                    with lock:
+                        api.import_bits(
+                            "i", "f", np.full(50, 1, np.uint64), cols
+                        )
+                        written.update(int(x) for x in cols)
+
+            def reader():
+                try:
+                    while not stop.is_set():
+                        with lock:
+                            want = len(written)
+                            got = api.query("i", "Count(Row(f=1))")[0]
+                        if got != want:
+                            errors.append((got, want))
+                            return
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(repr(e))
+
+            threads = [threading.Thread(target=writer)] + [
+                threading.Thread(target=reader) for _ in range(3)
+            ]
+            for t in threads:
+                t.start()
+            import time
+
+            time.sleep(1.0)
+            stop.set()
+            for t in threads:
+                t.join(10)
+            assert not errors, errors[:3]
+            assert api.query("i", "Count(Row(f=1))")[0] == len(written)

@@ -183,6 +183,9 @@ class TestOneDispatch:
         # warm the stacks, then assert: one plan eval, zero serial lowering
         ex.execute("wide", "Count(Intersect(Row(f=1), Row(f=2)))")
         planmod.reset_stats()
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        RESULT_CACHE.reset()  # the probe asserts the dispatch, not the cache
         import pilosa_tpu.exec.executor as exmod
 
         def boom(*a, **k):  # the serial per-shard path must never run

@@ -507,10 +507,17 @@ class TestLabelGC:
         with ClusterHarness(1, in_memory=True) as c:
             srv = c[0]
 
+            from pilosa_tpu.core.resultcache import RESULT_CACHE
+
             def churn(idx):
                 _seed(srv.api, idx, n_shards=1, rows=1)
+                # query TWICE: the repeat stores+serves a result-cache
+                # entry, so the churn also exercises cache.* per-index
+                # attribution and its cache.resident_bytes{index} series
+                srv.api.query(idx, "Count(Row(f=0))")
                 srv.api.query(idx, "Count(Row(f=0))")
                 srv.publish_cache_gauges()
+                assert RESULT_CACHE.stats_snapshot()["by_index"].get(idx, 0) > 0
                 srv.api.delete_index(idx)
                 srv.publish_cache_gauges()
 
@@ -518,6 +525,7 @@ class TestLabelGC:
             # devcache gauges, class:interactive,index:- lanes, ...)
             churn("warm0")
             baseline = set(srv.stats.registry.snapshot())
+            cache_base = RESULT_CACHE.stats_snapshot()["resident_bytes"]
             for i in range(100):
                 churn(f"tenant_{i}")
             final = set(srv.stats.registry.snapshot())
@@ -527,6 +535,10 @@ class TestLabelGC:
                 sorted(final - baseline)[:10],
                 sorted(baseline - final)[:10],
             )
+            # cache bytes return to baseline with no tenant attribution
+            csnap = RESULT_CACHE.stats_snapshot()
+            assert csnap["resident_bytes"] == cache_base
+            assert not any(k.startswith("tenant_") for k in csnap["by_index"])
 
     def test_release_after_drop_cannot_resurrect_the_series(self):
         """Delete an index while its query is in flight: the release's

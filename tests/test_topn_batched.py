@@ -160,6 +160,9 @@ class TestDispatchCounts:
         bits += [(r, SHARD_WIDTH + r) for r in range(10)]
         h, ex = _mk(bits)
         ex.execute("i", "TopN(f, n=5)")  # warm
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        RESULT_CACHE.reset()  # the probe asserts the tally path, not the cache
         planmod.reset_stats()
         for k in exmod.TOPN_STATS:
             exmod.TOPN_STATS[k] = 0
@@ -186,6 +189,9 @@ class TestDispatchCounts:
         src = [(0, s * SHARD_WIDTH + i) for s in range(n_shards) for i in range(200)]
         h, ex = _mk(bits, src_bits=src)
         ex.execute("i", "TopN(f, Row(g=0), n=5)")  # warm
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        RESULT_CACHE.reset()  # the probe asserts the tally path, not the cache
         planmod.reset_stats()
         for k in exmod.TOPN_STATS:
             exmod.TOPN_STATS[k] = 0

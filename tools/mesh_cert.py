@@ -51,9 +51,12 @@ def child(n_devices: int) -> dict:
     n_shards = n_devices * 2 + 1  # deliberately unpadded
     out: dict = {"n_devices": n_devices, "n_shards": n_shards, "nodes": 4}
 
+    # cache_result_mb=0: the cert counter-asserts the DISPATCH shape of
+    # repeat queries; a result-cache hit (the intended fast path) would
+    # serve them with zero dispatches and certify nothing
     with ClusterHarness(
         4, in_memory=True, mesh_group="cert-ici",
-        telemetry_sample_interval=0.0,
+        telemetry_sample_interval=0.0, cache_result_mb=0,
     ) as cluster:
         api = cluster[0].api
         api.create_index("cert")

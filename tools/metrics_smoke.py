@@ -112,6 +112,40 @@ def main() -> int:
             uri, "/index/smoke_a/query", {"query": "Count(Row(f=3))"}
         )
         assert resp["results"] == [len(mesh_cols)], resp
+        # versioned result cache (ISSUE 14): re-issue an IDENTICAL Count
+        # and assert the repeat served from the cache — cache.hits moved
+        # and the second query issued ZERO compiled dispatches (the
+        # in-process plan.STATS counter is the ground truth the gauges
+        # summarize)
+        from pilosa_tpu.exec import plan as planmod
+
+        repeat_q = {"query": "Count(Row(f=2))"}
+        resp = _post(uri, "/index/smoke_a/query", repeat_q)
+        assert resp["results"] == [600], resp
+        evals_before = planmod.STATS["evals"]
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        hits_before = RESULT_CACHE.stats_snapshot()["hits"]
+        resp = _post(uri, "/index/smoke_a/query", repeat_q)
+        assert resp["results"] == [600], resp
+        if planmod.STATS["evals"] != evals_before:
+            errors.append(
+                "repeat Count dispatched "
+                f"{planmod.STATS['evals'] - evals_before} compiled "
+                "program(s); expected a zero-dispatch cache hit"
+            )
+        if RESULT_CACHE.stats_snapshot()["hits"] <= hits_before:
+            errors.append("cache.hits did not move on a repeat Count")
+        # timeline sampler exposes the cache's footprint + hit rate
+        tl = json.loads(_get(uri, "/debug/timeline?sample=1"))
+        samples = tl.get("samples") or []
+        if not samples or "cacheHitRate" not in samples[-1]:
+            errors.append("timeline sample missing cacheHitRate")
+        elif samples[-1]["cacheHitRate"] <= 0:
+            errors.append(
+                f"timeline cacheHitRate = {samples[-1]['cacheHitRate']}, "
+                "expected > 0 after a cache-served repeat"
+            )
         # the resize-job record must scrape as well-formed JSON on a live
         # node (operators poll it during elastic resizes; an idle node
         # reports NONE)
@@ -151,6 +185,29 @@ def main() -> int:
     )
     if m and float(m.group(1)) <= 0:
         errors.append("ingest.merge_batches stayed zero after a staged burst")
+
+    # versioned result cache (ISSUE 14): the gauge families must render
+    # and the hit counter must reflect the cache-served repeat above
+    for fam, want_min in (
+        ("pilosa_tpu_cache_hits", 1.0),
+        ("pilosa_tpu_cache_misses", 1.0),
+        ("pilosa_tpu_cache_revalidations", 1.0),
+        ("pilosa_tpu_cache_entries", 1.0),
+    ):
+        m = re.search(rf"^{fam} ([0-9.e+-]+)", node_text, re.M)
+        if m is None:
+            errors.append(f"node /metrics: {fam} missing")
+        elif float(m.group(1)) < want_min:
+            errors.append(
+                f"node /metrics: {fam} = {m.group(1)}, expected >= {want_min}"
+            )
+    if not re.search(
+        r'^pilosa_tpu_cache_resident_bytes\{index="smoke_a"\} ',
+        node_text, re.M,
+    ):
+        errors.append(
+            "node /metrics: cache.resident_bytes{index=smoke_a} missing"
+        )
 
     # mesh-group execution (ISSUE 10): the cluster runs as one ICI
     # domain, so the Counts above must have ridden mesh dispatches —
