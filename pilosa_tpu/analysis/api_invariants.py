@@ -64,6 +64,7 @@ _SECTION_CLASSES = {
     "ClusterConfig": "cluster",
     "SchedConfig": "sched",
     "HbmConfig": "hbm",
+    "BsiConfig": "bsi",
     "IngestConfig": "ingest",
     "WalConfig": "wal",
     "MeshConfig": "mesh",

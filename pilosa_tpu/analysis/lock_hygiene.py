@@ -113,7 +113,7 @@ _DISPATCH_SCOPE = (
 # (by its conventional names) or anything acquired via dispatch_mutex()
 _DISPATCH_MUTEX_RE = re.compile(r"dispatch_*(mu|mutex)$", re.IGNORECASE)
 
-_RUN_SERIALIZED_NAMES = ("run_serialized",)
+_RUN_SERIALIZED_NAMES = ("run_serialized", "run_counted")
 
 # `# dispatch-ok: <reason>` annotation: on a call line it exempts that
 # call, on a `def` line the whole function body. For the three shapes

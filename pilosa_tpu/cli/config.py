@@ -67,6 +67,17 @@ class HbmConfig:
 
 
 @dataclass
+class BsiConfig:
+    # plane-streamed BSI aggregates (exec/bsistream.py; docs/
+    # configuration.md "BSI aggregates"): Sum/Min/Max and single-
+    # condition Range counts stage and reduce magnitude planes in slabs
+    # of this many planes per compiled dispatch — peak plane residency
+    # is slab-sized however deep the field, and a field at or under the
+    # slab answers in ONE dispatch. <= 0 restores the default (16).
+    slab_planes: int = 16
+
+
+@dataclass
 class IngestConfig:
     # bulk-ingest merge barrier (core/merge.py; docs/configuration.md
     # "Ingest"): staged deltas merge cross-fragment-batched at read
@@ -192,6 +203,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
+    bsi: BsiConfig = field(default_factory=BsiConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
     wal: WalConfig = field(default_factory=WalConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -272,6 +284,7 @@ class Config:
             ("cluster", self.cluster),
             ("sched", self.sched),
             ("hbm", self.hbm),
+            ("bsi", self.bsi),
             ("ingest", self.ingest),
             ("wal", self.wal),
             ("mesh", self.mesh),

@@ -158,6 +158,13 @@ def _build_parser() -> argparse.ArgumentParser:
         "(safety valve; 0 disables)",
     )
     sp.add_argument(
+        "--bsi-slab-planes", type=int,
+        help="magnitude planes per compiled dispatch for plane-streamed "
+        "BSI aggregates (Sum/Min/Max/Range counts): peak plane "
+        "residency stays slab-sized however deep the field "
+        "(<= 0 restores the default)",
+    )
+    sp.add_argument(
         "--import-concurrency", type=int,
         help="parallel replica-import RPCs per bulk import call (shard "
         "batches ship to their owner nodes on a pool this wide)",
@@ -307,6 +314,7 @@ _FLAG_KNOBS = {
     "hbm_extent_rows": ("hbm", "extent_rows"),
     "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
     "hbm_pin_timeout": ("hbm", "pin_timeout"),
+    "bsi_slab_planes": ("bsi", "slab_planes"),
     "merge_device_threshold": ("ingest", "merge_device_threshold"),
     "wal_sync_interval": ("wal", "sync_interval"),
     "mesh_group": ("mesh", "group"),
@@ -461,6 +469,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         hbm_extent_rows=cfg.hbm.extent_rows,
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,
+        bsi_slab_planes=cfg.bsi.slab_planes,
         merge_device_threshold=cfg.ingest.merge_device_threshold,
         wal_sync_interval=cfg.wal.sync_interval,
         mesh_group=cfg.mesh.group,

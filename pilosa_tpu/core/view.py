@@ -356,7 +356,16 @@ class View:
         arr = DEVICE_CACHE.get(key)
         if arr is None:
             return True  # evicted meanwhile: nothing resident to go stale
-        new_arr = arr
+        # batch the patch per ENTRY: every dirty (plane, shard-position)
+        # delta lands through ONE gather | OR | scatter with stacked
+        # index arrays, so a burst smeared over S shards costs one
+        # whole-extent copy instead of S of them — the old per-position
+        # `.at[p].set` cascade paid a full-extent copy per dirty shard
+        # (~11.6 s for a 50k-position burst over 954 shards,
+        # BENCH_NOTES round-10's named caveat)
+        idx_p: List[int] = []
+        idx_d: List[int] = []
+        blocks: List[np.ndarray] = []
         for p, m in deltas:
             for d, rid in enumerate(row_ids):
                 if rid not in m.rows:
@@ -366,11 +375,29 @@ class View:
                     continue
                 delta = np.zeros(WORDS_PER_ROW, np.uint32)
                 delta[widx] = wvals
-                ddev = jax.device_put(delta)
-                if kind == "row":
-                    new_arr = new_arr.at[p].set(new_arr[p] | ddev)
-                else:
-                    new_arr = new_arr.at[d, p].set(new_arr[d, p] | ddev)
+                blocks.append(delta)
+                idx_p.append(p)
+                idx_d.append(d)
+        new_arr = arr
+        n_batches = 0
+        # bounded scatter batches: stacking EVERY delta block at once
+        # would spike host+device memory by (dirty positions x touched
+        # rows x row bytes) — a whole-index smear into a monolithic
+        # deep-field entry could transiently allocate gigabytes. 256
+        # blocks (~32 MB at the default shard width) keeps the spike
+        # bounded while the cascade stays O(entries + deltas/256)
+        # device ops, never O(dirty shards).
+        CHUNK = 256
+        for c0 in range(0, len(blocks), CHUNK):
+            ddev = jax.device_put(np.stack(blocks[c0:c0 + CHUNK]))
+            if kind == "row":
+                pi = np.asarray(idx_p[c0:c0 + CHUNK])
+                new_arr = new_arr.at[pi].set(new_arr[pi] | ddev)
+            else:
+                di = np.asarray(idx_d[c0:c0 + CHUNK])
+                pi = np.asarray(idx_p[c0:c0 + CHUNK])
+                new_arr = new_arr.at[di, pi].set(new_arr[di, pi] | ddev)
+            n_batches += 1
         new_key = key[:5] + (
             ("ext", rows_per, ei, tuple(upd))
             if tail[0] == "ext"
@@ -382,13 +409,17 @@ class View:
         DEVICE_CACHE.invalidate(key)
         from pilosa_tpu.hbm import residency as hbm_res
 
-        hbm_res.note_extent_patch()
+        hbm_res.note_extent_patch(batches=n_batches)
         return True
 
-    def row_stack(self, row_id: int, shards, extents=None) -> Optional[object]:
+    def row_stack(self, row_id: int, shards, extents=None,
+                  parts: bool = False) -> Optional[object]:
         """uint32[S, W] device stack of one row over `shards`, or None when
         no listed shard has a fragment (the row is wholly absent).
-        `extents` (hbm.ExtentTable) receives the pinned extent keys."""
+        `extents` (hbm.ExtentTable) receives the pinned extent keys;
+        `parts` returns the per-extent arrays unassembled (the
+        plane-streamed aggregate path reduces across them in program
+        instead of paying a device-side concat per staging)."""
         from pilosa_tpu.hbm import residency as hbm_res
 
         shards = tuple(shards)
@@ -414,7 +445,7 @@ class View:
         return hbm_res.stage_row_stack(
             key, len(shards), build_slice, table=extents,
             versions=self._frag_versions(frags), shards=shards,
-            index=self.index,
+            index=self.index, parts=parts,
         )
 
     def stage_bulk(self, shards: np.ndarray, positions: np.ndarray) -> None:
@@ -472,10 +503,12 @@ class View:
         with self._mu:
             self._dirty_staged.update(dirty)
 
-    def plane_stack(self, row_ids, shards, extents=None) -> Optional[object]:
+    def plane_stack(self, row_ids, shards, extents=None,
+                    parts: bool = False) -> Optional[object]:
         """uint32[D, S, W] device stack (BSI planes × shards), or None when
         no listed shard has a fragment. Extents slice the shard axis: one
-        slice pages all D planes for its shard range together."""
+        slice pages all D planes for its shard range together. `parts`
+        returns the per-extent arrays unassembled."""
         from pilosa_tpu.hbm import residency as hbm_res
 
         row_ids = tuple(row_ids)
@@ -507,7 +540,7 @@ class View:
         return hbm_res.stage_plane_stack(
             key, len(shards), build_slice, table=extents,
             versions=self._frag_versions(frags), shards=shards,
-            index=self.index,
+            index=self.index, parts=parts,
         )
 
     # -- fan-down helpers (view.go:367-474) --------------------------------

@@ -1722,7 +1722,18 @@ class Executor:
         if len(c.children) != 1:
             raise ExecError("Count() only accepts a single bitmap input")
         shard_list = self._shards_for(idx, shards)
-        plans = self._lower_plans(idx, c.children[0], shard_list)
+        child = c.children[0]
+        if child.name in ("Row", "Range") and child.has_conditions():
+            # single-BSI-condition counts ride the plane-streamed ladders
+            # (exec/bsistream.py): slab-bounded plane residency, one
+            # dispatch per slab, scalar halfword-pair reads — instead of
+            # materializing the whole [D, S, W] stack through a plan
+            from pilosa_tpu.exec import bsistream
+
+            streamed = bsistream.count_range(self, idx, child, shard_list)
+            if streamed is not None:
+                return streamed
+        plans = self._lower_plans(idx, child, shard_list)
         if plans is not None:
             # one jitted dispatch + one [S] host read per (budget-sized)
             # shard chunk — usually exactly one
@@ -1856,6 +1867,13 @@ class Executor:
         f = self._field_of(idx, field_name)
         if f.options.type != FIELD_TYPE_INT:
             raise ExecError(f"field {field_name} is not an int field")
+        from pilosa_tpu.exec import bsistream
+
+        streamed = bsistream.aggregate(
+            self, idx, c, f, self._shards_for(idx, shards), "sum"
+        )
+        if streamed is not None:
+            return streamed
         chunks = self._bsi_chunks(idx, c, f, self._shards_for(idx, shards))
         if chunks is not None:
             # one jitted dispatch + one fused read per (budget-sized)
@@ -1906,6 +1924,14 @@ class Executor:
         f = self._field_of(idx, field_name)
         if f.options.type != FIELD_TYPE_INT:
             raise ExecError(f"field {field_name} is not an int field")
+        from pilosa_tpu.exec import bsistream
+
+        streamed = bsistream.aggregate(
+            self, idx, c, f, self._shards_for(idx, shards),
+            "min" if is_min else "max",
+        )
+        if streamed is not None:
+            return streamed
         chunks = self._bsi_chunks(idx, c, f, self._shards_for(idx, shards))
         if chunks is not None:
             from pilosa_tpu.ops import bsi as obsi
@@ -2052,7 +2078,7 @@ class Executor:
         for _, frag in present:
             cand.update(frag.row_ids())
         ordered = sorted(cand, reverse=not is_min)
-        chunk = 64
+        chunk = self._candidate_window(len(present))
         for i in range(0, len(ordered), chunk):
             ids = ordered[i : i + chunk]
             ic = self._topn_icounts(view, ids, present, src_stack)
@@ -2061,6 +2087,21 @@ class Executor:
                 if total:
                     return {"id": rid, "count": total}
         return {"id": 0, "count": 0}
+
+    @staticmethod
+    def _candidate_window(n_shards: int) -> int:
+        """Candidate rows per tally round for the extreme-end MinRow/
+        MaxRow walk: derived from the same quarter-budget arithmetic as
+        _chunk_by_budget (each candidate tallies against a [S, W] row
+        stack) instead of a hardcoded 64 — wide clusters stop paying
+        extra tally dispatches when the budget would fit more
+        candidates, and narrow ones stop over-chunking tiny operands."""
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+        from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+        row_bytes = max(1, n_shards) * WORDS_PER_ROW * 4
+        cap = max(1, DEVICE_CACHE.budget_bytes // 4)
+        return int(min(4096, max(16, cap // row_bytes)))
 
     # ------------------------------------------------------------------
     # writes

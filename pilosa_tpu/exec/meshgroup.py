@@ -63,10 +63,15 @@ class MeshUnsupported(Exception):
 # group-spanning stack cannot reproduce for foreign predecessors). Time
 # ranges (from/to args) are excluded because time-view discovery walks the
 # COORDINATOR's view list, which need not cover views materialized only on
-# a peer.
+# a peer. Sum/Min/Max fold via the plane-streamed aggregates
+# (exec/bsistream.py): the group adapter's plane stacks stage under the
+# mesh sharding and the kernels' in-program reductions partition into the
+# cross-device psum, so a mesh-group BSI aggregate is one dispatch + one
+# scalar host read regardless of group size — the Count "total" contract
+# extended to the whole BSI family.
 _ELIGIBLE = frozenset(
     {"Count", "Row", "Union", "Intersect", "Difference", "Xor", "Not", "All",
-     "TopN"}
+     "TopN", "Sum", "Min", "Max"}
 )
 
 
@@ -227,7 +232,8 @@ class GroupView:
         versions = tuple(f.version if f is not None else -1 for f in frags)
         return self._base_key(kind, ident, shards) + (versions,)
 
-    def row_stack(self, row_id: int, shards, extents=None):
+    def row_stack(self, row_id: int, shards, extents=None,
+                  parts: bool = False):
         """uint32[S, W] device stack of one row over the GROUP's shards
         (None when wholly absent) — the group-spanning analog of
         View.row_stack, staged under this adapter's owner token."""
@@ -254,9 +260,11 @@ class GroupView:
         return hbm_res.stage_row_stack(
             key, len(shards), build_slice, table=extents,
             versions=versions, shards=shards, index=self.index,
+            parts=parts,
         )
 
-    def plane_stack(self, row_ids, shards, extents=None):
+    def plane_stack(self, row_ids, shards, extents=None,
+                    parts: bool = False):
         """uint32[D, S, W] BSI plane stack over the group's shards."""
         from pilosa_tpu.hbm import residency as hbm_res
 
@@ -290,6 +298,7 @@ class GroupView:
         return hbm_res.stage_plane_stack(
             key, len(shards), build_slice, table=extents,
             versions=versions, shards=shards, index=self.index,
+            parts=parts,
         )
 
     def close(self) -> None:
@@ -484,8 +493,19 @@ def mesh_count(ex, gidx: GroupIndex, c: Call, shard_list: List[int]) -> Tuple[in
         from pilosa_tpu.exec.executor import ExecError
 
         raise ExecError("Count() only accepts a single bitmap input")
+    child = c.children[0]
+    if child.name in ("Row", "Range") and child.has_conditions():
+        # single-BSI-condition counts ride the plane-streamed ladders
+        # over the group adapter (exec/bsistream.py): the in-program
+        # halfword-pair reductions partition into the mesh psum, so the
+        # group answers in one dispatch per slab with a scalar read
+        from pilosa_tpu.exec import bsistream
+
+        streamed = bsistream.count_range(ex, gidx, child, shard_list)
+        if streamed is not None:
+            return streamed, 4 * 4  # two halfword pairs replicated
     try:
-        lowered = ex._lower_roots(gidx, [c.children[0]], shard_list, empty_ok=True)
+        lowered = ex._lower_roots(gidx, [child], shard_list, empty_ok=True)
     except BudgetExceeded as e:
         raise MeshUnsupported(str(e), reason="budget") from e
     if lowered is None:

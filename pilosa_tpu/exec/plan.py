@@ -76,6 +76,28 @@ def dispatch_mutex() -> TrackedLock:
     return _DISPATCH_MU
 
 
+def run_counted(fn, read: bool = True):
+    """run_serialized plus dispatch accounting and the exec.dispatch
+    attribution probe: STATS["evals"] books the compiled dispatch and —
+    when `read` — STATS["host_reads"] books the blocking result read the
+    caller is about to take. The plane-streamed BSI aggregates ride this
+    so their "one dispatch per budget chunk / one scalar read" contracts
+    are counter-asserted exactly like StackedPlan's."""
+    t_lock = _pre_dispatch()
+    with _DISPATCH_MU:
+        probe = _DispatchProbe(t_lock)
+        try:
+            import jax
+
+            out = jax.block_until_ready(fn())
+            probe.evaled()
+            if read:
+                _note_host_read()
+            return out
+        finally:
+            probe.finish()
+
+
 def run_serialized(fn):
     """Run one non-plan compiled dispatch under the one-program-at-a-time
     mutex, holding it through completion, and return fn()'s result fully

@@ -59,6 +59,12 @@ _counters: Dict[str, int] = {
     # delta, on device) instead of invalidated + re-staged over PCIe —
     # the merge barrier's reconciliation books these (core/view.py)
     "extent_patches": 0,
+    # batched patch scatters issued (one gather|OR|scatter per patched
+    # entry per 256 dirty delta blocks — the memory-bounded batch
+    # size): a smeared burst's cascade is O(entries) device ops, not
+    # O(dirty shards) — compare against extent_patches to read the
+    # coalescing ratio
+    "extent_patch_batches": 0,
 }
 # per-owner-index restage attribution ("-" collects staging not bound to
 # an index); dropped by drop_index() when the index is deleted so a
@@ -126,14 +132,19 @@ def stats_snapshot() -> Dict[str, int]:
             "prefetch_hits": _counters["prefetch_hits"],
             "prefetch_staged": _counters["prefetch_staged"],
             "extent_patches": _counters["extent_patches"],
+            "extent_patch_batches": _counters["extent_patch_batches"],
             "evicted_extent_bytes": snap["evicted_extent_bytes"],
         }
 
 
-def note_extent_patch() -> None:
+def note_extent_patch(batches: int = 0) -> None:
     """Book one in-place device-side extent patch (core/view.py
-    _patch_entry): a write that kept its covering extent resident."""
-    _bump("extent_patches")
+    _patch_entry): a write that kept its covering extent resident.
+    `batches` counts the batched gather|OR|scatter device ops the patch
+    issued (one per 256 dirty delta blocks, never one per shard)."""
+    with _stats_mu:
+        _counters["extent_patches"] += 1
+        _counters["extent_patch_batches"] += batches
 
 
 @contextmanager
@@ -231,13 +242,19 @@ def _stage(
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
+    parts: bool = False,
 ):
     """Assemble one device operand from per-extent cache entries.
 
     build_slice(lo, hi) -> host ndarray covering shard positions [lo, hi)
-    of the stack. Returns the assembled device array; every extent ends
-    pinned exactly once — ownership goes to `table` (released after the
-    plan's dispatch) or is released here when no table is given.
+    of the stack. Returns the assembled device array — or, with
+    `parts=True`, the TUPLE of per-extent device arrays in shard order
+    with no assembly at all (the plane-streamed kernels reduce across
+    the parts inside their one compiled program; a device-side concat
+    of a ~GB operand would re-copy it on every staging). Every extent
+    ends pinned exactly once — ownership goes to `table` (released
+    after the plan's dispatch) or is released here when no table is
+    given.
 
     `versions` (one entry per shard position) rides INSIDE each extent's
     cache key as that extent's own span slice: a write to one shard
@@ -251,7 +268,7 @@ def _stage(
     try:
         return _stage_inner(
             key_base, n_shards, build_slice, shard_axis, table,
-            versions=versions, shards=shards, index=index,
+            versions=versions, shards=shards, index=index, parts=parts,
         )
     finally:
         # staging wall time feeds the flight recorder's per-thread
@@ -270,6 +287,7 @@ def _stage_inner(
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
+    parts: bool = False,
 ):
     import jax
 
@@ -299,7 +317,7 @@ def _stage_inner(
             table.add([key])
         else:
             DEVICE_CACHE.unpin(key)
-        return arr
+        return (arr,) if parts else arr
 
     spans = [(lo, min(lo + rows, n_shards)) for lo in range(0, n_shards, rows)]
     keys = [
@@ -317,7 +335,7 @@ def _stage_inner(
     # pass-1 pins on extents the loop has not reached yet): a build
     # failure mid-loop must release all of them, not just the visited ones
     held: List[Tuple] = [k for k, r in zip(keys, resident) if r]
-    parts = []
+    out_parts = []
     try:
         for (lo, hi), key, was_resident in zip(spans, keys, resident):
             arr = None
@@ -350,17 +368,20 @@ def _stage_inner(
                     int(getattr(arr, "nbytes", 0)), key, bool(built),
                     index=index,
                 )
-            parts.append(arr)
+            out_parts.append(arr)
     except BaseException:
         DEVICE_CACHE.unpin_all(held)
         raise
     if table is not None:
         table.add(held)
-    assembled = (
-        parts[0]
-        if len(parts) == 1
-        else jax.numpy.concatenate(parts, axis=shard_axis)
-    )
+    if parts:
+        assembled = tuple(out_parts)
+    else:
+        assembled = (
+            out_parts[0]
+            if len(out_parts) == 1
+            else jax.numpy.concatenate(out_parts, axis=shard_axis)
+        )
     if table is None:
         DEVICE_CACHE.unpin_all(held)
     return assembled
@@ -374,13 +395,15 @@ def stage_row_stack(
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
+    parts: bool = False,
 ):
     """uint32[S, W] operand: extents slice axis 0 (the shard axis).
     `index` attributes the staged bytes to their owning index for the
-    per-tenant residency/restage telemetry."""
+    per-tenant residency/restage telemetry; `parts` skips assembly and
+    returns the per-extent arrays (plane-streamed aggregate path)."""
     return _stage(
         key_base, n_shards, build_slice, 0, table,
-        versions=versions, shards=shards, index=index,
+        versions=versions, shards=shards, index=index, parts=parts,
     )
 
 
@@ -392,11 +415,13 @@ def stage_plane_stack(
     versions: Optional[Tuple[int, ...]] = None,
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
+    parts: bool = False,
 ):
     """uint32[D, S, W] operand: extents slice axis 1; every extent carries
     all D planes for its shard range (one slice pages the whole magnitude
-    ladder for those shards together — they are always used together)."""
+    ladder for those shards together — they are always used together).
+    `parts` skips assembly and returns the per-extent arrays."""
     return _stage(
         key_base, n_shards, build_slice, 1, table,
-        versions=versions, shards=shards, index=index,
+        versions=versions, shards=shards, index=index, parts=parts,
     )

@@ -126,14 +126,31 @@ ZERO_COST = QueryCost()
 
 
 def _bsi_planes(idx: Any, field_name: Optional[str]) -> int:
-    """Plane stacks a BSI reference to `field_name` materializes:
-    bit_depth magnitude planes + sign + existence."""
+    """Row-stack equivalents a BSI reference to `field_name` holds at
+    PEAK: the plane-streamed lowering (exec/bsistream.py) stages and
+    reduces planes in `bsi-slab-planes`-bounded slabs with carried word
+    state, so peak residency is min(bit_depth, slab) planes + the
+    exists/sign/state rows — NOT the whole bit_depth+2 stack the old
+    estimator charged. Pricing the full stack over-charged admission
+    for warm deep-field repeats by up to ~2x (sweep count still grows
+    with depth via the slab dispatches)."""
+    from pilosa_tpu.exec import bsistream
+
+    slab = bsistream.slab_planes()
     if idx is not None and field_name:
         f = idx.field(field_name)
-        depth = getattr(getattr(f, "options", None), "bit_depth", 0) if f else 0
+        o = getattr(f, "options", None)
+        depth = getattr(o, "bit_depth", 0) if f else 0
         if depth:
-            return depth + 2
-    return _DEFAULT_BSI_PLANES
+            signed_ = getattr(o, "min", 0) < getattr(o, "base", 0)
+            if signed_ and depth > 31:
+                # the streamed path declines this shape (its virtual
+                # key needs depth+sign bits in uint32) and the kept
+                # legacy lowering stages the WHOLE stack — price that,
+                # not the slab peak
+                return depth + 2
+            return min(depth, slab) + 3
+    return min(_DEFAULT_BSI_PLANES, slab + 3)
 
 
 def _call_rows(idx: Any, c: Call) -> float:

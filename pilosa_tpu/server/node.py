@@ -93,6 +93,7 @@ class NodeServer:
         hbm_extent_rows: int = 256,  # shards per operand extent; 0 = monolithic
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
+        bsi_slab_planes: int = 16,  # BSI planes per streamed dispatch; <=0 default
         merge_device_threshold: Optional[int] = None,  # None = backend AUTO
         wal_sync_interval: float = 0.0,  # 0 strict; >0 bounded-loss cadence, s
         mesh_group: str = "",  # ICI domain id; "" = no mesh-local execution
@@ -214,6 +215,12 @@ class NodeServer:
         hbmmod.configure(
             extent_rows=hbm_extent_rows, pin_timeout=hbm_pin_timeout
         )
+        # plane-streamed BSI aggregate slab bound (exec/bsistream.py):
+        # process-global for the same reason as the [hbm] knobs — all
+        # in-process nodes share one device
+        from pilosa_tpu.exec import bsistream as bsistream_mod
+
+        bsistream_mod.configure(slab_planes=bsi_slab_planes)
         # cross-fragment deferred-delta merge crossover (core/merge.py):
         # process-global for the same reason as the [hbm] knobs — all
         # in-process nodes share the one device the merge dispatches to
@@ -598,6 +605,18 @@ class NodeServer:
         self.stats.gauge("hbm.pinned_bytes", hsnap["pinned_bytes"])
         self.stats.gauge("hbm.prefetch_hits", hsnap["prefetch_hits"])
         self.stats.gauge("hbm.extent_patches", hsnap["extent_patches"])
+        self.stats.gauge(
+            "hbm.extent_patch_batches", hsnap["extent_patch_batches"]
+        )
+        # plane-streamed BSI aggregates (exec/bsistream.py): slabs
+        # staged, cumulative slab operand bytes, compiled dispatches —
+        # the one-dispatch-per-slab contract made observable
+        from pilosa_tpu.exec import bsistream as bsistream_mod
+
+        bsnap = bsistream_mod.stats_snapshot()
+        self.stats.gauge("bsi.slabs", bsnap["slabs"])
+        self.stats.gauge("bsi.slab_bytes", bsnap["slab_bytes"])
+        self.stats.gauge("bsi.plane_dispatches", bsnap["plane_dispatches"])
         # cross-fragment deferred-delta merge barrier (core/merge.py):
         # cumulative barrier wall ms, staged buffers merged through any
         # path, and barriers that dispatched the device program

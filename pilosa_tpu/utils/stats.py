@@ -91,8 +91,21 @@ STAT_NAMES = frozenset(
         "hbm.prefetch_hits",
         # in-place device-side extent patches (core/view.py merge-barrier
         # reconciliation): writes that kept their covering extent resident
-        # instead of forcing an invalidate + PCIe re-stage
+        # instead of forcing an invalidate + PCIe re-stage.
+        # extent_patch_batches counts the batched gather|OR|scatter ops
+        # issued — one per patched entry per 256 dirty delta blocks,
+        # never one per shard (a smeared burst's cascade is O(entries)
+        # device ops, not O(dirty shards))
         "hbm.extent_patches",
+        "hbm.extent_patch_batches",
+        # plane-streamed BSI aggregates (exec/bsistream.py, refreshed at
+        # scrape/sampler time): plane slabs staged, cumulative slab
+        # operand bytes, and compiled dispatches issued by the streamed
+        # path — a depth <= slab field answers one dispatch per query
+        # chunk, so dispatches tracking slabs ~1:1 is the healthy shape
+        "bsi.slabs",
+        "bsi.slab_bytes",
+        "bsi.plane_dispatches",
         # cross-fragment deferred-delta merge barrier (core/merge.py,
         # refreshed at scrape time): cumulative barrier wall ms, staged
         # buffers merged (any path), and barriers that dispatched the

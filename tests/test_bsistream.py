@@ -1,0 +1,722 @@
+"""Plane-streamed BSI aggregates (ISSUE 15 tentpole): randomized
+differential harness against a host value model across all three
+execution paths, slab/budget chunking equivalence, dispatch-count
+contracts, the batched extent-patch cascade, and the knob plumbing.
+
+The oracle is a plain python dict {column: value} maintained alongside
+every mutation — Sum/Min/Max/Range answers are recomputed from it with
+numpy and must match bit-for-bit whatever the slab size, budget chunking
+or execution path.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.devcache import DEVICE_CACHE
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec import bsistream
+from pilosa_tpu.exec import plan as planmod
+from pilosa_tpu.hbm import residency as hbm_res
+from pilosa_tpu.parallel import mesh as pmesh
+from pilosa_tpu.pql import parse
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+from pilosa_tpu.testing import ClusterHarness
+
+
+@pytest.fixture
+def stream_env():
+    """Single-device staging, default slab knob, restored budget —
+    the deterministic environment the dispatch-count asserts need."""
+    old_mesh = pmesh.active_mesh()
+    pmesh.set_active_mesh(None)
+    old_budget = DEVICE_CACHE.budget_bytes
+    old_slab = bsistream.slab_planes()
+    DEVICE_CACHE.clear()
+    bsistream.reset_stats()
+    yield
+    bsistream.configure(slab_planes=old_slab)
+    DEVICE_CACHE.budget_bytes = old_budget
+    DEVICE_CACHE.clear()
+    bsistream.reset_stats()
+    pmesh.set_active_mesh(old_mesh)
+
+
+# ---------------------------------------------------------------------------
+# the host oracle
+# ---------------------------------------------------------------------------
+
+
+def _expected(model: dict, pql_kind: str, arg=None):
+    vals = np.array(list(model.values()), np.int64)
+    if pql_kind == "sum":
+        return (int(vals.sum()), len(vals)) if len(vals) else (0, 0)
+    if pql_kind == "min":
+        if not len(vals):
+            return (0, 0)
+        return (int(vals.min()), int((vals == vals.min()).sum()))
+    if pql_kind == "max":
+        if not len(vals):
+            return (0, 0)
+        return (int(vals.max()), int((vals == vals.max()).sum()))
+    if pql_kind == "between":
+        lo, hi = arg
+        return int(((vals >= lo) & (vals <= hi)).sum()) if len(vals) else 0
+    op, pred = arg
+    if not len(vals):
+        return 0
+    return int(
+        {
+            ">": vals > pred, ">=": vals >= pred,
+            "<": vals < pred, "<=": vals <= pred,
+            "==": vals == pred, "!=": vals != pred,
+        }[op].sum()
+    )
+
+
+def _check_all(run, model: dict, fname: str, fmin: int, fmax: int, rng):
+    """Assert every aggregate family against the oracle through `run`
+    (a callable pql -> first result). Predicates cover in-range,
+    boundary, zero-crossing and saturated (out-of-range) values."""
+    want_v, want_c = _expected(model, "sum")
+    vc = run(f"Sum(field={fname})")
+    assert (vc.value, vc.count) == (want_v, want_c), ("sum", vc)
+    want_v, want_c = _expected(model, "min")
+    vc = run(f"Min(field={fname})")
+    assert (vc.value, vc.count) == (want_v, want_c), ("min", vc)
+    want_v, want_c = _expected(model, "max")
+    vc = run(f"Max(field={fname})")
+    assert (vc.value, vc.count) == (want_v, want_c), ("max", vc)
+    mid = (fmin + fmax) // 2
+    some = next(iter(model.values())) if model else mid
+    preds = [
+        mid, fmin, fmax, 0, some,
+        fmin - 7, fmax + 7,  # saturated both sides
+        int(rng.integers(fmin, fmax + 1)),
+    ]
+    for op in (">", ">=", "<", "<=", "==", "!="):
+        for pred in preds:
+            got = run(f"Count(Row({fname} {op} {pred}))")
+            want = _expected(model, "range", (op, pred))
+            assert got == want, (op, pred, got, want)
+    for lo, hi in [
+        (fmin, fmax), (mid, fmax + 9), (fmin - 9, mid), (some, some),
+        tuple(sorted(rng.integers(fmin, fmax + 1, 2).tolist())),
+    ]:
+        got = run(f"Count(Row({fname} >< [{lo},{hi}]))")
+        assert got == _expected(model, "between", (lo, hi)), (lo, hi, got)
+    got = run(f"Count(Row({fname} != null))")
+    assert got == len(model), ("notnull", got, len(model))
+
+
+def _populate(idx, fname: str, fmin: int, fmax: int, n: int, n_shards: int,
+              rng, seed_field=None):
+    f = idx.create_field(fname, FieldOptions(type="int", min=fmin, max=fmax))
+    cols = rng.choice(
+        n_shards * SHARD_WIDTH, size=n, replace=False
+    ).astype(np.uint64)
+    vals = rng.integers(fmin, fmax + 1, n).astype(np.int64)
+    # boundary values are always present (sign/saturation edges)
+    vals[0], vals[1] = fmin, fmax
+    f.import_values(cols, vals)
+    return f, dict(zip(cols.tolist(), vals.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# single-node differential harness
+# ---------------------------------------------------------------------------
+
+
+class TestSingleNodeDifferential:
+    @pytest.mark.parametrize(
+        "fmin,fmax",
+        [
+            (0, 255),  # unsigned, base 0
+            (-300, 300),  # signed around zero
+            (1000, 66_000),  # positive base offset (base = min)
+            (-9000, -100),  # all-negative (base = max)
+        ],
+    )
+    def test_families_vs_oracle(self, stream_env, fmin, fmax):
+        rng = np.random.default_rng(17)
+        h = Holder().open()
+        idx = h.create_index("bs")
+        _f, model = _populate(idx, "v", fmin, fmax, 500, 5, rng)
+        ex = Executor(h)
+        _check_all(
+            lambda q: ex.execute("bs", q)[0], model, "v", fmin, fmax, rng
+        )
+
+    def test_randomized_mutation_interleavings(self, stream_env):
+        """set_value / import_values / clear_value interleaved with the
+        aggregate families — staged-merge interplay and value
+        overwrites must keep the streamed answers exact."""
+        rng = np.random.default_rng(23)
+        fmin, fmax = -500, 1500
+        h = Holder().open()
+        idx = h.create_index("bs")
+        f, model = _populate(idx, "v", fmin, fmax, 300, 4, rng)
+        ex = Executor(h)
+        run = lambda q: ex.execute("bs", q)[0]  # noqa: E731
+        for _round in range(4):
+            op = rng.integers(0, 3)
+            if op == 0:  # bulk overwrite/extend
+                cols = rng.integers(
+                    0, 4 * SHARD_WIDTH, 120
+                ).astype(np.uint64)
+                vals = rng.integers(fmin, fmax + 1, 120).astype(np.int64)
+                f.import_values(cols, vals)
+                model.update(zip(cols.tolist(), vals.tolist()))
+            elif op == 1:  # point writes
+                for _ in range(10):
+                    col = int(rng.integers(0, 4 * SHARD_WIDTH))
+                    val = int(rng.integers(fmin, fmax + 1))
+                    f.set_value(col, val)
+                    model[col] = val
+            else:  # clears of existing columns
+                for col in list(model)[:10]:
+                    f.clear_value(col)
+                    del model[col]
+            _check_all(run, model, "v", fmin, fmax, rng)
+
+    def test_filtered_aggregates(self, stream_env):
+        rng = np.random.default_rng(5)
+        h = Holder().open()
+        idx = h.create_index("bs")
+        _f, model = _populate(idx, "v", -100, 900, 400, 3, rng)
+        rf = idx.create_field("r", FieldOptions())
+        half = np.array(list(model)[: len(model) // 2], np.uint64)
+        rf.import_bits(np.zeros(len(half), np.uint64), half)
+        ex = Executor(h)
+        sel = np.array([model[c] for c in half.tolist()], np.int64)
+        (vc,) = ex.execute("bs", "Sum(Row(r=0), field=v)")
+        assert (vc.value, vc.count) == (int(sel.sum()), len(sel))
+        (vc,) = ex.execute("bs", "Min(Row(r=0), field=v)")
+        assert vc.value == int(sel.min())
+        assert vc.count == int((sel == sel.min()).sum())
+        (vc,) = ex.execute("bs", "Max(Row(r=0), field=v)")
+        assert vc.value == int(sel.max())
+        assert vc.count == int((sel == sel.max()).sum())
+        # filter matching nothing
+        (vc,) = ex.execute("bs", "Sum(Row(r=7), field=v)")
+        assert (vc.value, vc.count) == (0, 0)
+
+    @pytest.mark.parametrize("extent_rows", [1, 2, 3, 0])
+    def test_extent_parts_equivalence(self, stream_env, extent_rows):
+        """The kernels consume the extents as PART tuples with no
+        device-side concat — answers must be identical whatever the
+        paging granularity (multi-part, uneven tail part, monolithic),
+        and a warm filterless aggregate stays ONE dispatch however many
+        parts the operands split into."""
+        old_rows = hbm_res.extent_rows()
+        try:
+            hbm_res.configure(extent_rows=extent_rows)
+            rng = np.random.default_rng(53)
+            h = Holder().open()
+            idx = h.create_index("bs")
+            _f, model = _populate(idx, "v", -200, 600, 300, 7, rng)
+            ex = Executor(h)
+            _check_all(
+                lambda q: ex.execute("bs", q)[0], model, "v", -200, 600,
+                rng,
+            )
+            ex.execute("bs", "Sum(field=v)")  # warm
+            ev0, rd0 = planmod.STATS["evals"], planmod.STATS["host_reads"]
+            ex.execute("bs", "Sum(field=v)")
+            assert planmod.STATS["evals"] - ev0 == 1
+            assert planmod.STATS["host_reads"] - rd0 == 1
+        finally:
+            hbm_res.configure(extent_rows=old_rows)
+
+    def test_multi_slab_carried_state(self, stream_env):
+        """A field deeper than the slab knob walks MSB-first slabs with
+        carried ladder state — answers must be bit-identical to the
+        single-slab lowering, for every family."""
+        rng = np.random.default_rng(31)
+        fmin, fmax = -40_000, 700_000  # bit_depth ~20
+        h = Holder().open()
+        idx = h.create_index("bs")
+        _f, model = _populate(idx, "v", fmin, fmax, 400, 3, rng)
+        ex = Executor(h)
+        run = lambda q: ex.execute("bs", q)[0]  # noqa: E731
+        bsistream.configure(slab_planes=64)  # force single slab
+        DEVICE_CACHE.clear()
+        _check_all(run, model, "v", fmin, fmax, rng)
+        for slab in (7, 3, 1):
+            bsistream.configure(slab_planes=slab)
+            DEVICE_CACHE.clear()
+            _check_all(run, model, "v", fmin, fmax, rng)
+
+    def test_budget_chunk_boundaries(self, stream_env):
+        """Values straddling budget-chunk boundaries: a quarter-budget
+        too small for one slab over every shard forces BudgetExceeded
+        halving — per-chunk partials must combine to the same answers,
+        and each chunk pays exactly one dispatch (counter-asserted for
+        the filterless single-slab families)."""
+        rng = np.random.default_rng(41)
+        fmin, fmax = -10, 12  # depth 4: slab covers it
+        n_shards = 32
+        h = Holder().open()
+        idx = h.create_index("bs")
+        f = idx.create_field(
+            "v", FieldOptions(type="int", min=fmin, max=fmax)
+        )
+        # every shard populated, extremes placed in FIRST and LAST
+        # chunks so the cross-chunk combine is exercised
+        cols, vals = [], []
+        for s in range(n_shards):
+            c = (s * SHARD_WIDTH + rng.choice(
+                SHARD_WIDTH, 40, replace=False
+            )).astype(np.uint64)
+            v = rng.integers(fmin + 1, fmax, 40).astype(np.int64)
+            cols.append(c)
+            vals.append(v)
+        vals[0][0] = fmin
+        vals[-1][0] = fmax
+        cols_a = np.concatenate(cols)
+        vals_a = np.concatenate(vals)
+        f.import_values(cols_a, vals_a)
+        model = dict(zip(cols_a.tolist(), vals_a.tolist()))
+        ex = Executor(h)
+        run = lambda q: ex.execute("bs", q)[0]  # noqa: E731
+        _check_all(run, model, "v", fmin, fmax, rng)  # unchunked truth
+        # quarter-budget fits a 16-shard chunk but not all 32
+        stack = WORDS_PER_ROW * 4
+        mult = min(4, bsistream.slab_planes()) + 3
+        DEVICE_CACHE.budget_bytes = 4 * (20 * stack * mult)
+        DEVICE_CACHE.clear()
+        _check_all(run, model, "v", fmin, fmax, rng)
+        # dispatch shape: 2 chunks -> exactly 2 dispatches + 2 reads
+        for q in ("Sum(field=v)", "Min(field=v)", "Count(Row(v > 3))"):
+            ex.execute("bs", q)  # warm (plus result-cache decoupling)
+            ev0, rd0 = planmod.STATS["evals"], planmod.STATS["host_reads"]
+            from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+            RESULT_CACHE.reset()  # the Count repeat must re-execute
+            ex.execute("bs", q)
+            assert planmod.STATS["evals"] - ev0 == 2, q
+            assert planmod.STATS["host_reads"] - rd0 == 2, q
+
+    def test_one_dispatch_one_read_at_depth_under_slab(self, stream_env):
+        """The roofline contract: a warm filterless aggregate on a field
+        at or under the slab is exactly ONE compiled dispatch + ONE
+        scalar host read, whatever the shard count."""
+        rng = np.random.default_rng(43)
+        h = Holder().open()
+        idx = h.create_index("bs")
+        _f, model = _populate(idx, "v", -100, 100, 300, 6, rng)
+        ex = Executor(h)
+        for q in ("Sum(field=v)", "Min(field=v)", "Max(field=v)"):
+            ex.execute("bs", q)  # warm: stage + compile
+            ev0, rd0 = planmod.STATS["evals"], planmod.STATS["host_reads"]
+            sl0 = bsistream.stats_snapshot()["plane_dispatches"]
+            (vc,) = ex.execute("bs", q)
+            kind = q[:3].lower()
+            want_v, want_c = _expected(model, kind)
+            assert (vc.value, vc.count) == (want_v, want_c), q
+            assert planmod.STATS["evals"] - ev0 == 1, q
+            assert planmod.STATS["host_reads"] - rd0 == 1, q
+            assert bsistream.stats_snapshot()["plane_dispatches"] - sl0 == 1
+        # Range counts: traced predicates — changing the threshold reuses
+        # the compiled program AND dodges the result cache's text key
+        ex.execute("bs", "Count(Row(v > 17))")  # warm the program
+        ev0, rd0 = planmod.STATS["evals"], planmod.STATS["host_reads"]
+        got = ex.execute("bs", "Count(Row(v > 18))")[0]
+        assert got == _expected(model, "range", (">", 18))
+        assert planmod.STATS["evals"] - ev0 == 1
+        assert planmod.STATS["host_reads"] - rd0 == 1
+
+    def test_depth32_and_unstreamable_fall_back(self, stream_env):
+        """bit_depth 32 (the uint32 key-width edge) declines the
+        streamed path but must still answer exactly via the legacy
+        lowering."""
+        rng = np.random.default_rng(47)
+        h = Holder().open()
+        idx = h.create_index("bs")
+        f = idx.create_field(
+            "v", FieldOptions(type="int", min=0, max=(1 << 32) - 1)
+        )
+        assert f.options.bit_depth == 32
+        cols = rng.choice(2 * SHARD_WIDTH, 50, replace=False).astype(np.uint64)
+        vals = rng.integers(0, 1 << 32, 50).astype(np.int64)
+        vals[0], vals[1] = 0, (1 << 32) - 1
+        f.import_values(cols, vals)
+        model = dict(zip(cols.tolist(), vals.tolist()))
+        ex = Executor(h)
+        mv = np.array(list(model.values()))
+        (vc,) = ex.execute("bs", "Sum(field=v)")
+        assert (vc.value, vc.count) == (int(mv.sum()), len(mv))
+        (vc,) = ex.execute("bs", "Min(field=v)")
+        assert vc.value == int(mv.min())
+        (vc,) = ex.execute("bs", "Max(field=v)")
+        assert vc.value == int(mv.max())
+
+
+# ---------------------------------------------------------------------------
+# decomposition units
+# ---------------------------------------------------------------------------
+
+
+class TestDecompose:
+    def _field(self, fmin, fmax):
+        h = Holder().open()
+        idx = h.create_index("d")
+        return idx.create_field(
+            "v", FieldOptions(type="int", min=fmin, max=fmax)
+        )
+
+    def _cond(self, pql):
+        return next(iter(parse(pql).calls[0].condition_args().values()))
+
+    def test_unsigned_collapse(self):
+        f = self._field(0, 100)
+        jobs, preds, w, extras = bsistream._decompose(
+            f, self._cond("Row(v < 50)"), False
+        )
+        # positives collapse to consider; the negatives extra drops
+        assert jobs == (("lt", "consider", False),)
+        assert preds == (50,) and w == (1,) and extras == ()
+
+    def test_signed_keeps_branches(self):
+        f = self._field(-100, 100)
+        jobs, preds, w, extras = bsistream._decompose(
+            f, self._cond("Row(v < 50)"), True
+        )
+        assert jobs == (("lt", "pos", False),)
+        assert extras == (("neg", 1),)
+
+    def test_neq_is_subtractive(self):
+        f = self._field(-100, 100)
+        jobs, _preds, w, extras = bsistream._decompose(
+            f, self._cond("Row(v != 7)"), True
+        )
+        assert jobs == (("eq", "pos", False),)
+        assert w == (-1,) and extras == (("consider", 1),)
+
+    def test_saturated_is_zero_or_all(self):
+        f = self._field(0, 100)
+        assert bsistream._decompose(
+            f, self._cond("Row(v > 5000)"), False
+        ) == bsistream._ZERO
+        dec = bsistream._decompose(f, self._cond("Row(v < 5000)"), False)
+        assert dec == ((), (), (), (("consider", 1),))
+
+    def test_between_straddle(self):
+        f = self._field(-100, 100)
+        jobs, preds, w, extras = bsistream._decompose(
+            f, self._cond("Row(v >< [-10,20])"), True
+        )
+        assert jobs == (("lt", "pos", True), ("lt", "neg", True))
+        assert preds == (20, 10) and w == (1, 1)
+
+
+# ---------------------------------------------------------------------------
+# batched extent-patch cascade (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestPatchCascadeBatching:
+    def test_smeared_burst_is_one_scatter_per_entry(self, stream_env):
+        """A staged burst smeared over EVERY shard of a warm operand is
+        patched with one gather|OR|scatter per resident entry — not one
+        full-extent copy per dirty shard (the 11.6 s round-10 cliff)."""
+        hbm_res.configure(extent_rows=8)  # 32 shards -> 4 extents
+        hbm_res.reset_stats()
+        DEVICE_CACHE.budget_bytes = 1 << 30
+        S = 32
+        rng = np.random.default_rng(3)
+        h = Holder().open()
+        idx = h.create_index("pb")
+        f = idx.create_field("f", FieldOptions())
+        for s in range(S):
+            f.import_row_words(
+                0, s, rng.integers(0, 2**32, WORDS_PER_ROW).astype(np.uint32)
+            )
+        ex = Executor(h)
+        q = "Count(Row(f=0))"
+        got1 = ex.execute("pb", q)[0]  # warm: 4 extents resident
+        # keep the burst STAGED (no op-count snapshot trigger)
+        for fr in f.view("standard").fragments.values():
+            fr.max_op_n = 1 << 22
+        snap1 = hbm_res.stats_snapshot()
+        # one row-0 bit into every shard: 32 dirty shards, 4 extents
+        cols = np.array(
+            [s * SHARD_WIDTH + 77 for s in range(S)], np.uint64
+        )
+        f.import_bits(np.zeros(S, np.uint64), cols)
+        got2 = ex.execute("pb", q)[0]
+        snap2 = hbm_res.stats_snapshot()
+        assert (
+            snap2["extent_patches"] - snap1["extent_patches"] == 4
+        ), snap2
+        # THE batching property: one scatter per entry, not per shard
+        assert (
+            snap2["extent_patch_batches"] - snap1["extent_patch_batches"]
+            == 4
+        ), snap2
+        assert snap2["restage_bytes"] == snap1["restage_bytes"]
+        # exactness vs a cold re-stage
+        DEVICE_CACHE.clear()
+        assert ex.execute("pb", q)[0] == got2
+        assert got2 >= got1
+
+    def test_plane_stack_patch_batches(self, stream_env):
+        """BSI plane stacks patch through the same batched scatter (the
+        [D, S, W] index-pair form)."""
+        hbm_res.configure(extent_rows=0)  # monolithic: 1 entry per stack
+        hbm_res.reset_stats()
+        DEVICE_CACHE.budget_bytes = 1 << 30
+        rng = np.random.default_rng(9)
+        h = Holder().open()
+        idx = h.create_index("pb")
+        f = idx.create_field("v", FieldOptions(type="int", min=0, max=255))
+        S = 6
+        cols = rng.choice(S * SHARD_WIDTH, 200, replace=False).astype(np.uint64)
+        vals = rng.integers(0, 256, 200).astype(np.int64)
+        f.import_values(cols, vals)
+        model = dict(zip(cols.tolist(), vals.tolist()))
+        ex = Executor(h)
+        (vc,) = ex.execute("pb", "Sum(field=v)")  # warm plane stacks
+        mv = np.array(list(model.values()))
+        assert (vc.value, vc.count) == (int(mv.sum()), len(mv))
+        snap1 = hbm_res.stats_snapshot()
+        # a set-only burst into existing planes across several shards:
+        # row-word bits on plane 0 (odd values gain nothing new — use
+        # fresh columns so plane/exists rows genuinely change)
+        fresh = np.setdiff1d(
+            np.arange(0, S * SHARD_WIDTH, 997, dtype=np.uint64), cols
+        )[:60]
+        fvals = rng.integers(0, 256, len(fresh)).astype(np.int64)
+        bsiv = f.view(f.bsi_view_name())
+        for fr in bsiv.fragments.values():
+            fr.max_op_n = 1 << 22
+        f.import_values(fresh, fvals)
+        model.update(zip(fresh.tolist(), fvals.tolist()))
+        (vc,) = ex.execute("pb", "Sum(field=v)")
+        mv = np.array(list(model.values()))
+        assert (vc.value, vc.count) == (int(mv.sum()), len(mv))
+        snap2 = hbm_res.stats_snapshot()
+        patches = snap2["extent_patches"] - snap1["extent_patches"]
+        batches = (
+            snap2["extent_patch_batches"] - snap1["extent_patch_batches"]
+        )
+        if patches:  # import_values may restage instead when unpatchable
+            assert batches == patches
+
+
+# ---------------------------------------------------------------------------
+# candidate-window satellite + cost repricing + knob plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSatellites:
+    def test_candidate_window_tracks_budget(self, stream_env):
+        row = WORDS_PER_ROW * 4
+        DEVICE_CACHE.budget_bytes = 4 * 64 * row  # quarter = 64 rows @ 1 shard
+        assert Executor._candidate_window(1) == 64
+        assert Executor._candidate_window(8) == 16  # floor
+        DEVICE_CACHE.budget_bytes = 1 << 40
+        assert Executor._candidate_window(1) == 4096  # ceiling
+
+    def test_cost_prices_slab_peak(self, stream_env):
+        from pilosa_tpu.sched.cost import estimate
+
+        h = Holder().open()
+        idx = h.create_index("cx")
+        idx.create_field(
+            "deep", FieldOptions(type="int", min=0, max=(1 << 30) - 1)
+        )
+        idx.create_field("f", FieldOptions())
+        f = idx.field("f")
+        f.set_bit(1, 1)
+        slab = bsistream.slab_planes()
+        stack = WORDS_PER_ROW * 4
+        got = estimate(idx, parse("Count(Row(deep > 7))"), shards=[0])
+        # slab peak, NOT bit_depth+2 whole-stack (30 planes deep)
+        assert got.device_bytes == (min(30, slab) + 3) * stack
+        assert got.device_bytes < (30 + 2) * stack
+
+    def test_knob_plumbing_three_way(self):
+        from pilosa_tpu.cli.config import Config
+        from pilosa_tpu.cli.main import _build_parser
+
+        cfg = Config.load(overrides={"bsi": {"slab_planes": 5}})
+        assert cfg.bsi.slab_planes == 5
+        assert "slab-planes = 5" in cfg.to_toml()
+        args = _build_parser().parse_args(
+            ["server", "--bsi-slab-planes", "9"]
+        )
+        assert args.bsi_slab_planes == 9
+        old = bsistream.slab_planes()
+        try:
+            from pilosa_tpu.server.node import NodeServer
+
+            srv = NodeServer(None, "bsknob", bsi_slab_planes=6)
+            srv.start()
+            try:
+                assert bsistream.slab_planes() == 6
+            finally:
+                srv.stop()
+        finally:
+            bsistream.configure(slab_planes=old)
+
+    def test_env_knob(self, monkeypatch):
+        from pilosa_tpu.cli.config import Config
+
+        cfg = Config.load(env={"PILOSA_TPU_BSI__SLAB_PLANES": "11"})
+        assert cfg.bsi.slab_planes == 11
+        # non-positive / garbage env values restore the default instead
+        # of making every plane range empty (silently-zero aggregates)
+        for raw in ("-4", "0", "nope"):
+            monkeypatch.setenv("PILOSA_TPU_BSI_SLAB_PLANES", raw)
+            assert bsistream._env_slab_planes() == 16, raw
+
+    def test_configure_rejects_nonpositive(self):
+        old = bsistream.slab_planes()
+        try:
+            bsistream.configure(slab_planes=-3)
+            assert bsistream.slab_planes() == 16
+            bsistream.configure(slab_planes=5)
+            assert bsistream.slab_planes() == 5
+        finally:
+            bsistream.configure(slab_planes=old)
+
+    def test_cost_prices_legacy_for_streamed_ineligible(self, stream_env):
+        """A signed depth-32 field falls back to the legacy whole-stack
+        lowering — admission must price the full bit_depth+2 stack, not
+        the slab peak (a ~2x under-charge against the byte budget)."""
+        from pilosa_tpu.sched.cost import estimate
+
+        h = Holder().open()
+        idx = h.create_index("cx2")
+        idx.create_field(
+            "wide",
+            FieldOptions(type="int", min=-1, max=2**32 - 1),
+        )
+        assert idx.field("wide").options.bit_depth == 32
+        stack = WORDS_PER_ROW * 4
+        got = estimate(idx, parse("Count(Row(wide > 7))"), shards=[0])
+        assert got.device_bytes == (32 + 2) * stack
+
+
+# ---------------------------------------------------------------------------
+# HTTP fan-out + mesh-group differential equivalence
+# ---------------------------------------------------------------------------
+
+N_SHARDS = 9
+
+
+@pytest.fixture(scope="module")
+def bsi_cluster():
+    with ClusterHarness(
+        3, in_memory=True, mesh_group="bsi-ici",
+        telemetry_sample_interval=0.0,
+    ) as cluster:
+        api = cluster[0].api
+        api.create_index("bx")
+        api.create_field(
+            "bx", "v", options={"type": "int", "min": -800, "max": 800}
+        )
+        api.create_field(
+            "bx", "u", options={"type": "int", "min": 100, "max": 4000}
+        )
+        api.create_field("bx", "f")
+        rng = np.random.default_rng(29)
+        models = {}
+        for fname, fmin, fmax in (("v", -800, 800), ("u", 100, 4000)):
+            cols = rng.choice(
+                N_SHARDS * SHARD_WIDTH, 3000, replace=False
+            ).astype(np.uint64)
+            vals = rng.integers(fmin, fmax + 1, 3000).astype(np.int64)
+            vals[0], vals[1] = fmin, fmax
+            api.import_values("bx", fname, cols, vals)
+            models[fname] = dict(zip(cols.tolist(), vals.tolist()))
+        fcols = np.array(list(models["v"])[:1500], np.uint64)
+        api.import_bits(
+            "bx", "f", np.zeros(len(fcols), np.uint64), fcols
+        )
+        yield cluster, models, fcols
+
+
+def _set_mesh(cluster, on: bool) -> None:
+    for node in cluster.nodes:
+        node.executor.mesh_min_nodes = 2 if on else 0
+
+
+def _both(cluster, pql):
+    from pilosa_tpu.exec import meshgroup
+
+    api = cluster[0].api
+    _set_mesh(cluster, True)
+    meshgroup.reset_stats()
+    r_mesh = api.query("bx", pql)
+    snap = meshgroup.stats_snapshot()
+    _set_mesh(cluster, False)
+    try:
+        r_http = api.query("bx", pql)
+    finally:
+        _set_mesh(cluster, True)
+    return r_mesh, r_http, snap
+
+
+class TestClusterDifferential:
+    @pytest.mark.parametrize("fname,fmin,fmax", [
+        ("v", -800, 800), ("u", 100, 4000),
+    ])
+    def test_aggregates_all_paths(self, bsi_cluster, fname, fmin, fmax):
+        cluster, models, _ = bsi_cluster
+        model = models[fname]
+        rng = np.random.default_rng(2)
+
+        def run_mesh(q):
+            (rm,), (rh,), snap = _both(cluster, q)
+            # mesh partial == http partial == oracle, zero fallbacks
+            assert snap["fallbacks"] == 0, (q, snap)
+            assert snap["dispatches"] >= 1, (q, snap)
+            if hasattr(rm, "value"):
+                assert (rm.value, rm.count) == (rh.value, rh.count), q
+            else:
+                assert rm == rh, q
+            return rm
+
+        _check_all(run_mesh, model, fname, fmin, fmax, rng)
+
+    def test_mesh_aggregate_one_dispatch_one_read(self, bsi_cluster):
+        """The mesh-group contract extended to BSI aggregates: ONE
+        compiled dispatch + ONE scalar-sized host read for the whole
+        group, regardless of group size."""
+        cluster, models, _ = bsi_cluster
+        api = cluster[0].api
+        _set_mesh(cluster, True)
+        for q in ("Sum(field=u)", "Min(field=u)", "Max(field=u)"):
+            api.query("bx", q)  # warm: stage + compile
+            ev0, rd0 = planmod.STATS["evals"], planmod.STATS["host_reads"]
+            (vc,) = api.query("bx", q)
+            mv = np.array(list(models["u"].values()))
+            if q.startswith("Sum"):
+                assert (vc.value, vc.count) == (int(mv.sum()), len(mv))
+            assert planmod.STATS["evals"] - ev0 == 1, q
+            assert planmod.STATS["host_reads"] - rd0 == 1, q
+
+    def test_filtered_sum_all_paths(self, bsi_cluster):
+        cluster, models, fcols = bsi_cluster
+        sel = np.array(
+            [models["v"][c] for c in fcols.tolist()], np.int64
+        )
+        (rm,), (rh,), snap = _both(cluster, "Sum(Row(f=0), field=v)")
+        assert (rm.value, rm.count) == (rh.value, rh.count)
+        assert (rm.value, rm.count) == (int(sel.sum()), len(sel))
+        assert snap["fallbacks"] == 0
+
+    def test_write_visibility_through_mesh(self, bsi_cluster):
+        cluster, models, _ = bsi_cluster
+        api = cluster[0].api
+        _set_mesh(cluster, True)
+        col = 5 * SHARD_WIDTH + 123_457
+        api.query("bx", f"Set({col}, u=3999)")
+        models["u"][col] = 3999
+        (vc,), (vh,), _ = _both(cluster, "Sum(field=u)")
+        mv = np.array(list(models["u"].values()))
+        assert (vc.value, vc.count) == (int(mv.sum()), len(mv))
+        assert (vh.value, vh.count) == (vc.value, vc.count)

@@ -85,7 +85,15 @@ def test_eligibility_gates():
     assert not meshgroup.eligible(
         parse("Row(f=1, from='2020-01-01T00:00', to='2020-02-01T00:00')").calls[0]
     )
-    assert not meshgroup.eligible(parse("Sum(field=v)").calls[0])
+    # BSI aggregates fold since the plane-streamed lowering (round 11):
+    # their in-program reductions partition into the mesh collective
+    assert meshgroup.eligible(parse("Sum(field=v)").calls[0])
+    assert meshgroup.eligible(parse("Min(field=v)").calls[0])
+    assert meshgroup.eligible(parse("Max(Row(f=1), field=v)").calls[0])
+    # a Shift-bearing filter child still disqualifies the whole call
+    assert not meshgroup.eligible(
+        parse("Sum(Shift(Row(f=1), n=1), field=v)").calls[0]
+    )
 
 
 # ---------------------------------------------------------------------------

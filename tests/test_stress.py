@@ -114,6 +114,7 @@ _CORE_SUITES = [
     "tests/test_topn_batched.py",  # r5 gather-tally bit packing
     "tests/test_merge.py",  # ISSUE 9 cross-fragment merge equivalence
     "tests/test_meshexec.py",  # ISSUE 10 mesh-group differential equivalence
+    "tests/test_bsistream.py",  # ISSUE 15 plane-streamed BSI differential
 ]
 
 

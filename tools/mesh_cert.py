@@ -128,7 +128,47 @@ def child(n_devices: int) -> dict:
             assert [(p.id, p.count) for p in mesh_p] == [
                 (p.id, p.count) for p in http_p
             ], (pql, mesh_p, http_p)
-        out["equivalence_shapes"] = len(shapes) + 2
+        # BSI aggregate shapes (round 11, plane-streamed lowering): mesh
+        # == HTTP == host truth, and each warm mesh aggregate is exactly
+        # ONE compiled dispatch + ONE scalar-sized blocking host read
+        # however many devices the group spans
+        want_min = int(min(vvals))
+        want_max = int(max(vvals))
+        bsi_shapes = [
+            ("Sum(field=v)", (int(vvals.sum()), len(vvals))),
+            ("Min(field=v)", (want_min, int((vvals == want_min).sum()))),
+            ("Max(field=v)", (want_max, int((vvals == want_max).sum()))),
+        ]
+        for pql, (want_v, want_c) in bsi_shapes:
+            set_mesh(True)
+            api.query("cert", pql)  # warm: stage + compile
+            planmod.reset_stats()
+            meshgroup.reset_stats()
+            (mesh_vc,) = api.query("cert", pql)
+            snap = meshgroup.stats_snapshot()
+            assert planmod.STATS["evals"] == 1, (pql, planmod.STATS)
+            assert planmod.STATS["host_reads"] == 1, (pql, planmod.STATS)
+            assert snap["dispatches"] == 1 and snap["fallbacks"] == 0, (
+                pql, snap,
+            )
+            set_mesh(False)
+            (http_vc,) = api.query("cert", pql)
+            assert (mesh_vc.value, mesh_vc.count) == (want_v, want_c), (
+                pql, mesh_vc, want_v, want_c,
+            )
+            assert (http_vc.value, http_vc.count) == (want_v, want_c), (
+                pql, http_vc,
+            )
+        # streamed Range count: the traced-predicate program, 1 dispatch
+        set_mesh(True)
+        api.query("cert", "Count(Row(v > 99))")  # warm the program shape
+        planmod.reset_stats()
+        (got_r,) = api.query("cert", "Count(Row(v > 100))")
+        assert got_r == want_gt, (got_r, want_gt)
+        assert planmod.STATS["evals"] == 1, planmod.STATS
+        assert planmod.STATS["host_reads"] == 1, planmod.STATS
+        out["bsi_shapes"] = len(bsi_shapes) + 1
+        out["equivalence_shapes"] = len(shapes) + 2 + len(bsi_shapes) + 1
 
         # --- warm latency: mesh fold vs HTTP fan-out --------------------
         def median_ms(fn, n: int = 5) -> float:

@@ -146,6 +146,32 @@ def main() -> int:
                 f"timeline cacheHitRate = {samples[-1]['cacheHitRate']}, "
                 "expected > 0 after a cache-served repeat"
             )
+        # plane-streamed BSI aggregates (ISSUE 15): drive one Range
+        # count through the streamed lowering and assert it matches a
+        # host recompute of the imported values — the bsi.* gauge
+        # families asserted on the scraped text below must have moved
+        _post(
+            uri, "/index/smoke_a/field/val",
+            {"options": {"type": "int", "min": 0, "max": 1000}},
+        )
+        bsi_vals = [(i, (i * 37) % 1000) for i in range(200)]
+        _post(
+            uri, "/index/smoke_a/field/val/import-value",
+            {"cols": [c for c, _ in bsi_vals],
+             "values": [v for _, v in bsi_vals]},
+        )
+        want_range = sum(1 for _, v in bsi_vals if v > 500)
+        resp = _post(
+            uri, "/index/smoke_a/query", {"query": "Count(Row(val > 500))"}
+        )
+        assert resp["results"] == [want_range], (resp, want_range)
+        from pilosa_tpu.exec import bsistream
+
+        bsnap = bsistream.stats_snapshot()
+        if bsnap["plane_dispatches"] <= 0 or bsnap["slabs"] <= 0:
+            errors.append(
+                f"streamed BSI range issued no slab dispatches: {bsnap}"
+            )
         # the resize-job record must scrape as well-formed JSON on a live
         # node (operators poll it during elastic resizes; an idle node
         # reports NONE)
@@ -177,9 +203,27 @@ def main() -> int:
         "pilosa_tpu_ingest_merge_batches",
         "pilosa_tpu_ingest_merge_device",
         "pilosa_tpu_hbm_extent_patches",
+        "pilosa_tpu_hbm_extent_patch_batches",
     ):
         if not re.search(rf"^{fam} ", node_text, re.M):
             errors.append(f"node /metrics: {fam} missing")
+
+    # plane-streamed BSI aggregates (ISSUE 15): the bsi.* families must
+    # render and the slab/dispatch counters must have moved for the
+    # Range query driven above
+    for fam in (
+        "pilosa_tpu_bsi_slabs",
+        "pilosa_tpu_bsi_slab_bytes",
+        "pilosa_tpu_bsi_plane_dispatches",
+    ):
+        m = re.search(rf"^{fam} ([0-9.e+-]+)", node_text, re.M)
+        if m is None:
+            errors.append(f"node /metrics: {fam} missing")
+        elif float(m.group(1)) <= 0:
+            errors.append(
+                f"node /metrics: {fam} = {m.group(1)}, expected > 0 after "
+                "a streamed BSI Range query"
+            )
     m = re.search(
         r"^pilosa_tpu_ingest_merge_batches ([0-9.e+-]+)", node_text, re.M
     )
