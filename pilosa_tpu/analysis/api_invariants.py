@@ -63,6 +63,7 @@ _SECTION_CLASSES = {
     "Config": "",
     "ClusterConfig": "cluster",
     "SchedConfig": "sched",
+    "TenantsConfig": "tenants",
     "HbmConfig": "hbm",
     "BsiConfig": "bsi",
     "IngestConfig": "ingest",

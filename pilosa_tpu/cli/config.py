@@ -55,6 +55,24 @@ class SchedConfig:
 
 
 @dataclass
+class TenantsConfig:
+    # multi-tenant QoS enforcement (sched/tenants.py; docs/
+    # configuration.md "[tenants]"): per-index token-bucket rate limits
+    # and byte quotas, enforced at admission (429 + informed
+    # Retry-After) and in both caches' eviction loops. 0 = unlimited.
+    # Defaults apply to EVERY index; `overrides` entries of the form
+    # "index:knob=value[;knob=value...]" (kebab knob names: qps,
+    # bytes-per-s, inflight-bytes, hbm-bytes, cache-bytes) replace
+    # individual defaults per index.
+    default_qps: float = 0.0  # admitted queries/s per index
+    default_bytes_per_s: float = 0.0  # estimated device bytes/s per index
+    default_inflight_bytes: int = 0  # in-flight device-byte quota per index
+    default_hbm_bytes: int = 0  # HBM residency quota per index
+    default_cache_bytes: int = 0  # result-cache byte quota per index
+    overrides: List[str] = field(default_factory=list)
+
+
+@dataclass
 class HbmConfig:
     # HBM residency manager (pilosa_tpu/hbm/): operand stacks page in
     # and out of the device budget as shard-major EXTENTS instead of
@@ -202,6 +220,7 @@ class Config:
     import_concurrency: int = 8
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
+    tenants: TenantsConfig = field(default_factory=TenantsConfig)
     hbm: HbmConfig = field(default_factory=HbmConfig)
     bsi: BsiConfig = field(default_factory=BsiConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
@@ -283,6 +302,7 @@ class Config:
         for sect_name, sect in (
             ("cluster", self.cluster),
             ("sched", self.sched),
+            ("tenants", self.tenants),
             ("hbm", self.hbm),
             ("bsi", self.bsi),
             ("ingest", self.ingest),

@@ -143,6 +143,37 @@ def _build_parser() -> argparse.ArgumentParser:
         help="Retry-After seconds sent with 429 load-shed responses",
     )
     sp.add_argument(
+        "--tenants-default-qps", type=float,
+        help="per-index query-rate limit, queries/second (token bucket "
+        "with a one-second burst; 0 disables)",
+    )
+    sp.add_argument(
+        "--tenants-default-bytes-per-s", type=float,
+        help="per-index device-byte rate limit priced by the admission "
+        "cost estimator, bytes/second (0 disables)",
+    )
+    sp.add_argument(
+        "--tenants-default-inflight-bytes", type=int,
+        help="per-index cap on estimated device bytes in flight at once "
+        "(0 disables)",
+    )
+    sp.add_argument(
+        "--tenants-default-hbm-bytes", type=int,
+        help="per-index HBM devcache residency quota; eviction pressure "
+        "lands on over-quota indexes first (0 disables)",
+    )
+    sp.add_argument(
+        "--tenants-default-cache-bytes", type=int,
+        help="per-index result-cache byte quota (0 disables)",
+    )
+    sp.add_argument(
+        "--tenants-overrides", nargs="*",
+        help="per-index limit overrides, one entry per index: "
+        "'idx:qps=5;bytes-per-s=1e6;hbm-bytes=65536' (semicolon-joined "
+        "key=value pairs; keys: qps, bytes-per-s, inflight-bytes, "
+        "hbm-bytes, cache-bytes)",
+    )
+    sp.add_argument(
         "--hbm-extent-rows", type=int,
         help="shards per HBM operand extent — the paging granularity "
         "under memory pressure (0 stages whole stacks monolithically)",
@@ -311,6 +342,12 @@ _FLAG_KNOBS = {
     "admission_byte_budget": ("sched", "admission_byte_budget"),
     "admission_default_class": ("sched", "admission_default_class"),
     "shed_retry_after": ("sched", "shed_retry_after"),
+    "tenants_default_qps": ("tenants", "default_qps"),
+    "tenants_default_bytes_per_s": ("tenants", "default_bytes_per_s"),
+    "tenants_default_inflight_bytes": ("tenants", "default_inflight_bytes"),
+    "tenants_default_hbm_bytes": ("tenants", "default_hbm_bytes"),
+    "tenants_default_cache_bytes": ("tenants", "default_cache_bytes"),
+    "tenants_overrides": ("tenants", "overrides"),
     "hbm_extent_rows": ("hbm", "extent_rows"),
     "hbm_prefetch_depth": ("hbm", "prefetch_depth"),
     "hbm_pin_timeout": ("hbm", "pin_timeout"),
@@ -466,6 +503,12 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         admission_byte_budget=cfg.sched.admission_byte_budget,
         admission_default_class=cfg.sched.admission_default_class,
         shed_retry_after=cfg.sched.shed_retry_after,
+        tenant_default_qps=cfg.tenants.default_qps,
+        tenant_default_bytes_per_s=cfg.tenants.default_bytes_per_s,
+        tenant_default_inflight_bytes=cfg.tenants.default_inflight_bytes,
+        tenant_default_hbm_bytes=cfg.tenants.default_hbm_bytes,
+        tenant_default_cache_bytes=cfg.tenants.default_cache_bytes,
+        tenant_overrides=cfg.tenants.overrides,
         hbm_extent_rows=cfg.hbm.extent_rows,
         hbm_prefetch_depth=cfg.hbm.prefetch_depth,
         hbm_pin_timeout=cfg.hbm.pin_timeout,

@@ -95,6 +95,7 @@ def _nbytes(arr) -> int:
     "evictions",
     "evicted_extent_bytes",
     "stale_pin_reclaims",
+    "quota_evictions",
 ))
 class DeviceCache:
     """LRU key -> device array map with a byte budget.
@@ -151,6 +152,15 @@ class DeviceCache:
         # (the same overshoot the oversized-entry rule already allows);
         # the ledger settles back under budget when the session ends.
         self._defer_evict = 0
+        # per-index (tenant) residency quotas: 0 / absent = unlimited.
+        # Enforced by _evict_locked — eviction pressure lands on the
+        # over-quota owner FIRST (its own LRU order), and an index stays
+        # within its quota even when the global budget has room, so
+        # tenant A's warm extents survive tenant B's flood. Configured
+        # by NodeServer from the [tenants] section (configure_quotas).
+        self._index_quota_default = 0
+        self._index_quota: Dict[str, int] = {}
+        self._quota_evictions_index: Dict[str, int] = {}
         self.pin_timeout = pin_timeout
         self._clock = clock
         self.budget_bytes = (
@@ -161,6 +171,7 @@ class DeviceCache:
         self.evictions = 0
         self.evicted_extent_bytes = 0  # cumulative; paging tests diff this
         self.stale_pin_reclaims = 0
+        self.quota_evictions = 0  # subset of evictions: tenant-quota passes
 
     # -- core --------------------------------------------------------------
 
@@ -462,7 +473,14 @@ class DeviceCache:
                 del self._by_owner[key[0]]
 
     def _evict_locked(self, keep) -> None:
-        if self._bytes <= self.budget_bytes or self._defer_evict > 0:
+        if self._defer_evict > 0:
+            return
+        if self._index_quota or self._index_quota_default > 0:
+            # tenant quotas first: pressure lands on over-quota owners
+            # before any in-quota entry is touched, and an index is held
+            # to its own quota even with global budget to spare
+            self._evict_over_quota_locked(keep)
+        if self._bytes <= self.budget_bytes:
             return
         for key in list(self._entries):
             if self._bytes <= self.budget_bytes or len(self._entries) <= 1:
@@ -479,6 +497,51 @@ class DeviceCache:
                 self.evicted_extent_bytes += self._sizes.get(key, 0)
             self._drop_locked(key)
             self.evictions += 1
+
+    def _quota_for_locked(self, index: str) -> int:
+        q = self._index_quota.get(index)
+        return q if q is not None else self._index_quota_default
+
+    def _evict_over_quota_locked(self, keep) -> None:
+        """Per-index quota pass (LRU order within each owner). Counts
+        ZOMBIE bytes against the owner — invalidated-while-pinned device
+        memory is genuinely held on that tenant's behalf — but can only
+        evict live unpinned entries, so a tenant whose quota is consumed
+        by in-flight pins overshoots transiently, exactly like the
+        global budget does."""
+        by_idx = self._index_bytes_locked()
+        for key in list(self._entries):
+            if len(self._entries) <= 1:
+                break
+            if key == keep:
+                continue
+            idx = self._key_index.get(key, "-")
+            if idx == "-":
+                continue  # unattributed system entries are not a tenant
+            quota = self._quota_for_locked(idx)
+            if quota <= 0:
+                continue
+            held = by_idx.get(idx, 0)
+            if held <= quota:
+                continue
+            if self._pinned_locked(key):
+                continue
+            nb = self._sizes.get(key, 0)
+            if nb >= held and nb > quota:
+                # a single entry larger than the whole quota is still
+                # admitted when it is ALL the index holds (the query
+                # needs it to run) — same oversized-entry rule as the
+                # global budget; it goes once the index holds more
+                continue
+            if key in self._extent_keys:
+                self.evicted_extent_bytes += nb
+            self._drop_locked(key)
+            by_idx[idx] = held - nb
+            self.evictions += 1
+            self.quota_evictions += 1
+            self._quota_evictions_index[idx] = (
+                self._quota_evictions_index.get(idx, 0) + 1
+            )
 
     # -- introspection -----------------------------------------------------
 
@@ -502,14 +565,38 @@ class DeviceCache:
         computed from the same _sizes/_zombies ledgers under one lock
         hold."""
         with self._mu:
-            out: Dict[str, int] = {}
-            for key, nb in self._sizes.items():
-                idx = self._key_index.get(key, "-")
-                out[idx] = out.get(idx, 0) + nb
-            for key, nb in self._zombies.items():
-                idx = self._key_index.get(key, "-")
-                out[idx] = out.get(idx, 0) + nb
-            return out
+            return self._index_bytes_locked()
+
+    def _index_bytes_locked(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for key, nb in self._sizes.items():
+            idx = self._key_index.get(key, "-")
+            out[idx] = out.get(idx, 0) + nb
+        for key, nb in self._zombies.items():
+            idx = self._key_index.get(key, "-")
+            out[idx] = out.get(idx, 0) + nb
+        return out
+
+    def configure_quotas(
+        self,
+        default_bytes: int = 0,
+        overrides: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """Install per-index residency quotas ([tenants] section; 0 =
+        unlimited) and settle immediately: an index already over its new
+        quota sheds its own LRU entries now, not at its next insert."""
+        with self._mu:
+            self._index_quota_default = max(0, int(default_bytes))
+            self._index_quota = {
+                k: max(0, int(v)) for k, v in (overrides or {}).items()
+            }
+            self._evict_locked(keep=None)
+
+    def quota_evictions_by_index(self) -> Dict[str, int]:
+        """Cumulative tenant-quota evictions per index (published as
+        `tenant.quota_evictions{cache=hbm}` gauges)."""
+        with self._mu:
+            return dict(self._quota_evictions_index)
 
     def drop_index_attribution(self, index: str) -> None:
         """Label GC for a deleted index: re-bucket any surviving
@@ -525,6 +612,12 @@ class DeviceCache:
                 k for k, v in self._key_index.items() if v == index
             ]:
                 del self._key_index[key]
+            # tenant ledger GC rides along: the per-index eviction
+            # counter must not outlive the index (its gauge series was
+            # just dropped). The quota OVERRIDE stays — it is operator
+            # config, bounded by config size, and must re-apply if the
+            # index is recreated.
+            self._quota_evictions_index.pop(index, None)
 
     def owner_resident_bytes(self, owner: Hashable) -> int:
         """Resident bytes cached under one owner token (the admission
@@ -554,6 +647,7 @@ class DeviceCache:
                 "pinned_bytes": self._pinned_bytes_locked(),
                 "evicted_extent_bytes": self.evicted_extent_bytes,
                 "stale_pin_reclaims": self.stale_pin_reclaims,
+                "quota_evictions": self.quota_evictions,
             }
 
 

@@ -178,6 +178,14 @@ class ResultCache:
         self._by_text: Dict[tuple, Set[tuple]] = {}
         # keys seen once but not yet cached (RPC-vector gating)
         self._candidates: "OrderedDict[tuple, bool]" = OrderedDict()
+        # per-index (tenant) byte quotas ([tenants] section; 0 / absent
+        # = unlimited): an index is held to its quota even when the
+        # global budget has room, and under global pressure over-quota
+        # owners evict first — tenant A's microsecond-serve entries
+        # survive tenant B's flood
+        self._tenant_quota_default = 0
+        self._tenant_quota: Dict[str, int] = {}
+        self._quota_evictions_index: Dict[str, int] = {}
         self._counters: Dict[str, int] = {
             "hits": 0,
             "misses": 0,
@@ -185,20 +193,35 @@ class ResultCache:
             "repairs": 0,
             "evictions": 0,
             "stores": 0,
+            "quota_evictions": 0,
         }
 
     # -- configuration ------------------------------------------------------
 
-    def configure(self, budget_bytes=_UNSET, repair=_UNSET) -> None:
+    def configure(
+        self,
+        budget_bytes=_UNSET,
+        repair=_UNSET,
+        tenant_default_bytes=_UNSET,
+        tenant_overrides=_UNSET,
+    ) -> None:
         """Install the server's [cache] knobs (cli/config.py ->
-        server/node.py). Process-global like the [hbm] knobs: all
-        in-process nodes share one store (entries stay node-scoped via
-        the index/view tokens in their keys)."""
+        server/node.py) and the [tenants] per-index cache quotas.
+        Process-global like the [hbm] knobs: all in-process nodes share
+        one store (entries stay node-scoped via the index/view tokens in
+        their keys)."""
         with self._mu:
             if budget_bytes is not _UNSET:
                 self._budget = int(budget_bytes)
             if repair is not _UNSET:
                 self._repair_enabled = bool(repair)
+            if tenant_default_bytes is not _UNSET:
+                self._tenant_quota_default = max(0, int(tenant_default_bytes))
+            if tenant_overrides is not _UNSET:
+                self._tenant_quota = {
+                    k: max(0, int(v))
+                    for k, v in (tenant_overrides or {}).items()
+                }
             self._evict_over_budget_locked()
 
     @property
@@ -331,6 +354,12 @@ class ResultCache:
         if e.nbytes > self._budget:
             return  # a single over-budget entry would evict everything
         with self._mu:
+            quota = self._quota_for_locked(index)
+            if 0 < quota < e.nbytes:
+                # a single entry bigger than the tenant's whole quota
+                # can never be held within it — don't store it and then
+                # immediately evict it (or someone else's entries)
+                return
             old = self._entries.pop(key, None)
             if old is not None:
                 self._unindex_locked(old)
@@ -401,10 +430,32 @@ class ResultCache:
             if evict:
                 self._counters["evictions"] += 1
 
+    def _quota_for_locked(self, index: str) -> int:
+        q = self._tenant_quota.get(index)
+        return q if q is not None else self._tenant_quota_default
+
     def _evict_over_budget_locked(self) -> None:
+        if self._tenant_quota or self._tenant_quota_default > 0:
+            # tenant quotas first: over-quota owners shed their own LRU
+            # entries before any in-quota entry is touched, and each
+            # index is held to its quota even with global budget free
+            self._evict_over_quota_locked()
         while self._bytes > self._budget and self._entries:
             key = next(iter(self._entries))
             self._drop_locked(key, evict=True)
+
+    def _evict_over_quota_locked(self) -> None:
+        for key, e in list(self._entries.items()):
+            quota = self._quota_for_locked(e.index)
+            if quota <= 0:
+                continue
+            if self._by_index.get(e.index, 0) <= quota:
+                continue
+            self._drop_locked(key, evict=True)
+            self._counters["quota_evictions"] += 1
+            self._quota_evictions_index[e.index] = (
+                self._quota_evictions_index.get(e.index, 0) + 1
+            )
 
     # -- invalidation funnels ----------------------------------------------
 
@@ -534,12 +585,14 @@ class ResultCache:
 
     def drop_index(self, index: str) -> None:
         """Label GC on index delete (NodeServer.drop_index_telemetry):
-        the per-index byte attribution and every entry must go with the
-        index."""
+        the per-index byte attribution, the tenant eviction ledger and
+        every entry must go with the index. (The quota OVERRIDE stays —
+        operator config re-applies if the index is recreated.)"""
         with self._mu:
             for key, e in list(self._entries.items()):
                 if e.index == index:
                     self._drop_locked(key)
+            self._quota_evictions_index.pop(index, None)
 
     def drop_scope(self, scope) -> None:
         """Drop every entry keyed under one Index's cache scope (rank
@@ -565,11 +618,15 @@ class ResultCache:
             self._clear_locked()
 
     def reset(self) -> None:
-        """clear() plus counter reset (test isolation)."""
+        """clear() plus counter reset and tenant-quota reset to
+        unlimited (test isolation)."""
         with self._mu:
             self._clear_locked()
             for k in self._counters:
                 self._counters[k] = 0
+            self._tenant_quota_default = 0
+            self._tenant_quota = {}
+            self._quota_evictions_index = {}
 
     # -- introspection ------------------------------------------------------
 
@@ -600,6 +657,9 @@ class ResultCache:
             snap["resident_bytes"] = self._bytes
             snap["entries"] = len(self._entries)
             snap["by_index"] = dict(self._by_index)
+            snap["quota_evictions_by_index"] = dict(
+                self._quota_evictions_index
+            )
             return snap
 
 

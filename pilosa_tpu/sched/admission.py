@@ -13,6 +13,18 @@ production inference stack, applied to bitmap queries:
   times): `interactive` dequeues ahead of `batch` whenever both wait,
   without ever starving `batch`; `internal` (internode fan-out legs)
   sits between them;
+- WITHIN each class a second-level start-time-fair queue (SFQ) keyed
+  on index shares the class equally across tenants: a saturating
+  index's queue depth cannot starve same-class peers — the class
+  drains round-robin-fair over indexes by the same virtual-clock
+  machinery the classes use, not FIFO over arrival order;
+- per-index (tenant) QoS limits from sched/tenants.py are enforced at
+  admission on BOTH lanes: token-bucket rate limits (queries/s and
+  device-bytes/s, priced by sched/cost.py) charge before queueing, and
+  an in-flight device-byte quota is checked under sched.mu — over-
+  quota queries shed 429 with a Retry-After derived from the actual
+  constraint (bucket refill / queue-drain estimate; the knob is a
+  floor) and X-Pilosa-Quota-* detail;
 - the queue is BOUNDED and deadline-aware: when it is full, or an
   entry's deadline can no longer be met, the query is shed with
   `ShedError` -> HTTP 429 + Retry-After (retryable per server/faults.py,
@@ -32,6 +44,7 @@ from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from pilosa_tpu.sched.cost import QueryCost, ZERO_COST
+from pilosa_tpu.sched.tenants import TenantPolicy
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.utils.stats import Histogram
@@ -80,14 +93,45 @@ class ShedError(Exception):
     `trace_id` makes a shed query diagnosable from the client side: the
     api layer stamps the query's trace id (incoming header or the id the
     root span would have carried) so the 429 body/header names the exact
-    flight record to look for."""
+    flight record to look for.
+
+    `reason` is the shed taxonomy tag (rate | bytes | queue | deadline)
+    and, when a tenant quota tripped, `quota_limit`/`quota_usage`/
+    `quota_value` name the limit for the X-Pilosa-Quota-* response
+    headers — so a client can tell "the node is overloaded" from "YOU
+    are over YOUR quota" without reading /metrics."""
 
     def __init__(self, msg: str, retry_after: float = 1.0,
-                 trace_id: str = ""):
+                 trace_id: str = "", reason: str = "",
+                 quota_limit: str = "", quota_usage: float = 0.0,
+                 quota_value: float = 0.0):
         super().__init__(msg)
         self.retry_after = retry_after
         self.status = 429
         self.trace_id = trace_id
+        self.reason = reason
+        self.quota_limit = quota_limit
+        self.quota_usage = quota_usage
+        self.quota_value = quota_value
+
+
+class _ShedInfo:
+    """Everything a shed decision carries to _finish_admit: the human
+    `why` for the message, the `reason` tag for sched.shed, the DERIVED
+    Retry-After seconds (`after`; the shed-retry-after knob is applied
+    as a floor at raise time), and the tripped quota's detail when one
+    did."""
+
+    __slots__ = ("why", "reason", "after", "limit", "usage", "value")
+
+    def __init__(self, why: str, reason: str, after: float = 0.0,
+                 limit: str = "", usage: float = 0.0, value: float = 0.0):
+        self.why = why
+        self.reason = reason
+        self.after = after
+        self.limit = limit
+        self.usage = usage
+        self.value = value
 
 
 class Ticket:
@@ -157,11 +201,126 @@ class _Entry:
         self.shed = False
 
 
+class _ClassQueue:
+    """One WFQ class's queue, with a SECOND-LEVEL start-time-fair queue
+    (SFQ) keyed on index inside it: per-index FIFO sub-queues drained by
+    the same virtual-clock machinery the classes use (equal weight 1 per
+    index). A tenant flooding the class parks its excess behind its own
+    virtual time — it gets every slot when alone (work-conserving), but
+    the moment another index queues, grants interleave ~1:1 instead of
+    draining the flood first. Not self-locking: the controller guards
+    every call under sched.mu."""
+
+    __slots__ = ("subs", "ivtime", "iglobal", "n")
+
+    def __init__(self):
+        # index -> FIFO of its entries; plain dict keeps deterministic
+        # insertion-order iteration for tie-breaks
+        self.subs: Dict[Optional[str], Deque[_Entry]] = {}
+        self.ivtime: Dict[Optional[str], float] = {}
+        self.iglobal = 0.0  # intra-class SFQ anchor (mirror of _vglobal)
+        self.n = 0
+
+    def __len__(self) -> int:
+        return self.n
+
+    def _floor(self) -> float:
+        active = [
+            self.ivtime[k] for k, q in self.subs.items() if q
+        ]
+        return min(active) if active else 0.0
+
+    def append(self, e: _Entry) -> None:
+        q = self.subs.get(e.index)
+        if q is None:
+            q = self.subs[e.index] = deque()
+        if not q:
+            # a (re-)activating index competes from NOW — same no-banked-
+            # credit rule as the class-level clocks
+            self.ivtime[e.index] = max(
+                self.ivtime.get(e.index, 0.0), self.iglobal, self._floor()
+            )
+        q.append(e)
+        self.n += 1
+
+    def _best_key(self) -> Optional[object]:
+        """The index whose head would finish first in intra-class
+        virtual time (equal weights: min ivtime). Returns a 1-tuple so
+        a None index is distinguishable from 'queue empty'."""
+        best = None
+        best_v = 0.0
+        for k, q in self.subs.items():
+            if not q:
+                continue
+            v = self.ivtime[k]
+            if best is None or v < best_v:
+                best, best_v = (k,), v
+        return best
+
+    def head(self) -> Optional[_Entry]:
+        best = self._best_key()
+        return self.subs[best[0]][0] if best is not None else None
+
+    def popleft(self) -> _Entry:
+        best = self._best_key()
+        if best is None:
+            raise IndexError("pop from empty _ClassQueue")
+        (k,) = best
+        q = self.subs[k]
+        e = q.popleft()
+        self.n -= 1
+        start = self.ivtime[k]
+        self.iglobal = max(self.iglobal, start)
+        self.ivtime[k] = start + 1.0
+        if not q:
+            self._retire_locked(k)
+        return e
+
+    def remove(self, e: _Entry) -> None:
+        q = self.subs.get(e.index)
+        if q is None:
+            raise ValueError("entry not queued")
+        q.remove(e)  # raises ValueError when absent
+        self.n -= 1
+        if not q:
+            self._retire_locked(e.index)
+
+    def purge_expired(self, now: float) -> List[_Entry]:
+        """Pop expired sub-queue heads (consecutive ones per index) —
+        the per-index mirror of the old class-FIFO head purge. Entries
+        expiring behind a live head still wake via their own cv
+        timeout."""
+        out: List[_Entry] = []
+        for k in list(self.subs):
+            q = self.subs[k]
+            while q and q[0].deadline_at is not None and q[0].deadline_at <= now:
+                out.append(q.popleft())
+                self.n -= 1
+            if not q:
+                self._retire_locked(k)
+        return out
+
+    def _retire_locked(self, k: Optional[str]) -> None:
+        """A sub-queue drained: drop the deque, and prune its virtual
+        time once it holds no banked debt (re-activation anchors to at
+        least iglobal anyway) so tenant churn cannot grow the map."""
+        del self.subs[k]
+        if self.ivtime.get(k, 0.0) <= self.iglobal:
+            self.ivtime.pop(k, None)
+
+    def forget(self, index: str) -> None:
+        """drop_index GC: forget a deleted index's banked virtual time
+        (only when nothing of its is still queued)."""
+        if index not in self.subs:
+            self.ivtime.pop(index, None)
+
+
 @race_checked(exclude=(
     # wired once by NodeServer between construction and serving (init-
     # before-publish handoff); never rebound under load
     "prefetcher",
     "stats",
+    "tenants",
 ))
 class AdmissionController:
     def __init__(
@@ -173,6 +332,7 @@ class AdmissionController:
         retry_after: float = 1.0,
         stats: Any = None,
         clock: Callable[[], float] = time.monotonic,
+        tenants: Optional[TenantPolicy] = None,
     ):
         if max_concurrent < 1:
             raise ValueError("max_concurrent must be >= 1")
@@ -188,11 +348,14 @@ class AdmissionController:
         self.max_queue_depth = max(0, queue_depth)
         self._byte_budget = byte_budget
         self.default_class = default_class
-        self.retry_after = retry_after
+        self.retry_after = retry_after  # FLOOR for derived Retry-After
         self.stats = stats
+        # per-index QoS policy (sched/tenants.py): rate buckets charged
+        # before queueing, in-flight byte quota checked under sched.mu
+        self.tenants = tenants
         self._clock = clock
         self._cv = TrackedCondition(TrackedLock("sched.mu"))
-        self._queues: Dict[str, Deque[_Entry]] = {}
+        self._queues: Dict[str, _ClassQueue] = {}
         self._vtime: Dict[str, float] = {c: 0.0 for c in CLASS_WEIGHTS}
         # global virtual clock: the start tag of the entry most recently
         # granted from the queue (SFQ). A class re-activating after idling
@@ -285,11 +448,31 @@ class AdmissionController:
             # before results land, so it must START that much before its
             # deadline — feasibility and in-queue expiry both honor it
             deadline_at -= cost.transport_ms / 1000.0
+        # tenant rate buckets charge BEFORE any queueing, on BOTH lanes:
+        # a rate-limited tenant's queries must not hold queue slots while
+        # they wait for tokens — occupying the bounded queue is exactly
+        # the monopolization the limits exist to stop. The bucket's own
+        # refill time is the informed Retry-After.
+        if self.tenants is not None and index is not None:
+            denial = self.tenants.acquire(index, cost.device_bytes)
+            if denial is not None:
+                with self._cv:
+                    gauges = self._gauge_values_locked(index)
+                shed = _ShedInfo(
+                    f"index {index!r} over its {denial.limit} limit",
+                    denial.reason, after=denial.retry_after,
+                    limit=denial.limit, usage=denial.usage,
+                    value=denial.value,
+                )
+                return self._finish_admit(
+                    cls, cost, shed, 0.0, batchable, index, t0, gauges,
+                    leg=leg,
+                )
         if leg:
             return self._admit_leg(
                 cls, cost, deadline, deadline_at, t0, index
             )
-        shed_why: Optional[str] = None
+        shed: Optional[_ShedInfo] = None
         waited = 0.0
         with self._cv:
             if deadline is not None and (
@@ -299,7 +482,16 @@ class AdmissionController:
                 # exhausted outright, or the transport bill alone
                 # (collective + cross-group legs, sched/cost.py) already
                 # exceeds it — no grant could land results in time
-                shed_why = "deadline already exhausted on arrival"
+                shed = _ShedInfo(
+                    "deadline already exhausted on arrival", "deadline"
+                )
+            else:
+                # per-index in-flight byte quota: checked before the
+                # fast path so an over-quota tenant cannot ride an idle
+                # moment past its cap
+                shed = self._tenant_inflight_shed_locked(index, cost)
+            if shed is not None:
+                pass
             elif (
                 not self._queued_total_locked()
                 and self._inflight < self.max_concurrent
@@ -309,7 +501,10 @@ class AdmissionController:
                     cls, cost, queued=False, batchable=batchable, index=index
                 )
             elif self._queued_total_locked() >= self.max_queue_depth:
-                shed_why = "admission queue full"
+                shed = _ShedInfo(
+                    "admission queue full", "queue",
+                    after=self._drain_estimate_locked(),
+                )
             elif deadline_at is not None and not self._deadline_feasible_locked(
                 deadline_at
             ):
@@ -318,7 +513,10 @@ class AdmissionController:
                 # while the sender still has budget to re-map the leg to
                 # a replica, instead of discovering the miss only when
                 # the deadline expires
-                shed_why = "deadline cannot be met from the back of the queue"
+                shed = _ShedInfo(
+                    "deadline cannot be met from the back of the queue",
+                    "deadline", after=self._drain_estimate_locked(),
+                )
             else:
                 entry = _Entry(
                     cls, cost, deadline_at, t0, batchable=batchable,
@@ -326,7 +524,7 @@ class AdmissionController:
                 )
                 q = self._queues.get(cls)
                 if q is None:
-                    q = self._queues[cls] = deque()
+                    q = self._queues[cls] = _ClassQueue()
                 if not q:
                     # a (re-)activating class competes from NOW: lift its
                     # virtual time to the global clock / live floor so an
@@ -368,12 +566,17 @@ class AdmissionController:
                     except (KeyError, ValueError):
                         pass
                     self._pump_locked()
-                    shed_why = "deadline cannot be met in queue"
+                    shed = _ShedInfo(
+                        "deadline cannot be met in queue", "deadline",
+                        after=self._svc_estimate_locked(
+                            self._svc_ewma, self._svc_hist
+                        ),
+                    )
                 else:
                     waited = self._clock() - t0
             gauges = self._gauge_values_locked(index)
         return self._finish_admit(
-            cls, cost, shed_why, waited, batchable, index, t0, gauges
+            cls, cost, shed, waited, batchable, index, t0, gauges
         )
 
     def _admit_leg(
@@ -388,15 +591,26 @@ class AdmissionController:
         """Internal fan-out legs: own concurrency lane (same cap and
         waiting bound, FIFO, deadline-aware) so legs never compete with
         coordinator slots — legs run local shards only, so this lane has
-        no wait cycle and always drains."""
-        shed_why: Optional[str] = None
+        no wait cycle and always drains. Tenant limits are enforced here
+        too (rate buckets already charged by admit(); the in-flight byte
+        quota below): each node polices its own slice of a fan-out, so
+        an abusive tenant's legs shed at the peers as well."""
+        shed: Optional[_ShedInfo] = None
         waited = 0.0
         with self._cv:
             if deadline is not None and (
                 deadline <= 0
                 or (deadline_at is not None and deadline_at <= t0)
             ):
-                shed_why = "deadline already exhausted on arrival"
+                shed = _ShedInfo(
+                    "deadline already exhausted on arrival", "deadline"
+                )
+            else:
+                shed = self._tenant_inflight_shed_locked(
+                    index, cost, leg=True
+                )
+            if shed is not None:
+                pass
             elif (
                 self._inflight_leg < self.max_concurrent
                 and not self._leg_waiters
@@ -410,7 +624,10 @@ class AdmissionController:
                 self._inflight_bytes += cost.device_bytes
                 self._bump_index_bytes_locked(index, cost.device_bytes)
             elif len(self._leg_waiters) >= self.max_queue_depth:
-                shed_why = "internal-leg queue full"
+                shed = _ShedInfo(
+                    "internal-leg queue full", "queue",
+                    after=self._drain_estimate_locked(leg=True),
+                )
             elif deadline_at is not None and not self._leg_feasible_locked(
                 deadline_at
             ):
@@ -418,7 +635,10 @@ class AdmissionController:
                 # actually arrives on: reject while the SENDER still has
                 # budget to re-map the leg to a replica, instead of
                 # burning its whole budget to learn the miss at expiry
-                shed_why = "deadline cannot be met from the back of the queue"
+                shed = _ShedInfo(
+                    "deadline cannot be met from the back of the queue",
+                    "deadline", after=self._drain_estimate_locked(leg=True),
+                )
             else:
                 # strict FIFO handoff: grants come only from
                 # _pump_legs_locked popping the HEAD, so a new arrival
@@ -439,12 +659,17 @@ class AdmissionController:
                         self._leg_waiters.remove(entry)
                     except ValueError:
                         pass
-                    shed_why = "deadline cannot be met in queue"
+                    shed = _ShedInfo(
+                        "deadline cannot be met in queue", "deadline",
+                        after=self._svc_estimate_locked(
+                            self._leg_svc_ewma, self._leg_svc_hist
+                        ),
+                    )
                 else:
                     waited = self._clock() - t0
             gauges = self._gauge_values_locked(index)
         return self._finish_admit(
-            cls, cost, shed_why, waited, batchable=False, index=index,
+            cls, cost, shed, waited, batchable=False, index=index,
             t0=t0, gauges=gauges, leg=True,
         )
 
@@ -452,7 +677,7 @@ class AdmissionController:
         self,
         cls: str,
         cost: QueryCost,
-        shed_why: Optional[str],
+        shed: Optional[_ShedInfo],
         waited: float,
         batchable: bool,
         index: Optional[str],
@@ -467,20 +692,31 @@ class AdmissionController:
         # admit/shed/wait carry class AND index labels — per-tenant QoS
         # attribution; "-" marks requests bound to no index (e.g. resize
         # transfer serving) so the family's label set stays uniform.
+        # sched.shed additionally carries the reason taxonomy
+        # (rate | bytes | queue | deadline): overload and abuse must be
+        # distinguishable from /metrics alone.
         self._emit_gauges(gauges)
-        stats = (
-            self.stats.with_tags(f"class:{cls}", f"index:{index or '-'}")
-            if self.stats is not None
-            else None
-        )
-        if shed_why is not None:
-            if stats is not None:
-                stats.count("sched.shed", 1)
+        if shed is not None:
+            if self.stats is not None:
+                self.stats.with_tags(
+                    f"class:{cls}", f"index:{index or '-'}",
+                    f"reason:{shed.reason}",
+                ).count("sched.shed", 1)
+            # the knob is a FLOOR under the derived constraint time:
+            # informed backoff (bucket refill / queue-drain estimate)
+            # when the controller knows it, the configured blind default
+            # when it does not
+            retry = max(self.retry_after, shed.after)
             raise ShedError(
-                f"query shed ({shed_why}); retry after {self.retry_after:g}s",
-                retry_after=self.retry_after,
+                f"query shed ({shed.why}); retry after {retry:g}s",
+                retry_after=retry, reason=shed.reason,
+                quota_limit=shed.limit, quota_usage=shed.usage,
+                quota_value=shed.value,
             )
-        if stats is not None:
+        if self.stats is not None:
+            stats = self.stats.with_tags(
+                f"class:{cls}", f"index:{index or '-'}"
+            )
             stats.count("sched.admit", 1)
             stats.timing("sched.wait_ms", waited)
         return Ticket(
@@ -575,7 +811,11 @@ class AdmissionController:
         with self._cv:
             self._drop_batchable_locked(ticket.index)
 
-    def maybe_prefetch(self, warm: Optional[Callable[[], None]]) -> bool:
+    def maybe_prefetch(
+        self,
+        warm: Optional[Callable[[], None]],
+        index: Optional[str] = None,
+    ) -> bool:
         """Admitted-queue peek feeding the HBM prefetcher: when a new
         arrival would WAIT (slots full or a queue already formed), its
         warm closure — a stage-only lowering, Executor.warm — is offered
@@ -584,8 +824,13 @@ class AdmissionController:
         would take the fast path are never offered: they are about to
         stage for themselves anyway. Returns True when offered. The peek
         is racy by design — warming an extent twice is a cache hit, and
-        warming for a query that got in anyway costs nothing."""
+        warming for a query that got in anyway costs nothing. A tenant
+        currently out of rate tokens is never warmed: its queries are
+        about to shed, and the stage would spend PCIe (and evict
+        in-quota tenants' residency) on work that will not run."""
         if warm is None or self.prefetcher is None:
+            return False
+        if self.tenants is not None and self.tenants.throttled(index):
             return False
         with self._cv:
             would_wait = (
@@ -722,7 +967,10 @@ class AdmissionController:
         its bytes: byte-weightless entries from other classes are still
         granted (work-conserving for writes), but byte-weighted ones
         must not eat the earmark — otherwise a steady cheap stream
-        could refill the budget forever and starve the gated head."""
+        could refill the budget forever and starve the gated head.
+        Within the winning class, the head is the second-level SFQ's
+        pick (_ClassQueue): the index whose virtual time is lowest, so
+        same-class tenants drain fair instead of FIFO."""
         now = self._clock()
         granted_any = False
         byte_blocked: set = set()
@@ -733,8 +981,7 @@ class AdmissionController:
             for cls, q in self._queues.items():
                 if cls in byte_blocked:
                     continue
-                while q and q[0].deadline_at is not None and q[0].deadline_at <= now:
-                    expired = q.popleft()
+                for expired in q.purge_expired(now):
                     self._dequeued_batchable_locked(expired)
                     expired.shed = True  # its waiter raises ShedError
                     granted_any = True  # wake it
@@ -745,7 +992,7 @@ class AdmissionController:
                     best_cls, best_finish = cls, finish
             if best_cls is None:
                 break
-            head = self._queues[best_cls][0]
+            head = self._queues[best_cls].head()
             if not self._bytes_ok_locked(head.cost):
                 if reserved is None:
                     reserved = head.cost  # earmark its bytes
@@ -808,6 +1055,58 @@ class AdmissionController:
         rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
         return self._clock() + rounds * svc <= deadline_at
 
+    def _drain_estimate_locked(self, leg: bool = False) -> float:
+        """Queue-drain time estimate for a shed's Retry-After: the work
+        ahead drains over max_concurrent lanes at the learned service
+        rate — the same arithmetic the feasibility checks run, turned
+        into 'when a retry plausibly fits'. 0 with no history (the
+        shed-retry-after knob floors it)."""
+        if leg:
+            svc = self._svc_estimate_locked(
+                self._leg_svc_ewma, self._leg_svc_hist
+            )
+            ahead = len(self._leg_waiters) + self._inflight_leg
+        else:
+            svc = self._svc_estimate_locked(self._svc_ewma, self._svc_hist)
+            ahead = self._queued_total_locked() + self._inflight
+        if svc <= 0.0:
+            return 0.0
+        rounds = (ahead + self.max_concurrent - 1) // self.max_concurrent
+        return max(1, rounds) * svc
+
+    def _tenant_inflight_shed_locked(
+        self, index: Optional[str], cost: QueryCost, leg: bool = False
+    ) -> Optional[_ShedInfo]:
+        """Per-index in-flight device-byte quota (sched/tenants.py).
+        A single query whose estimate exceeds the whole quota still
+        runs — alone w.r.t. its own tenant's bytes — the same
+        single-oversized-entry rule the global byte budget and devcache
+        apply; otherwise that tenant could never run it at all."""
+        if self.tenants is None or index is None:
+            return None
+        if cost.device_bytes <= 0:
+            return None
+        quota = self.tenants.limits(index).inflight_bytes
+        if quota <= 0:
+            return None
+        held = self._inflight_bytes_index.get(index, 0)
+        if cost.device_bytes > quota:
+            if held == 0:
+                return None
+        elif held + cost.device_bytes <= quota:
+            return None
+        if leg:
+            svc = self._svc_estimate_locked(
+                self._leg_svc_ewma, self._leg_svc_hist
+            )
+        else:
+            svc = self._svc_estimate_locked(self._svc_ewma, self._svc_hist)
+        return _ShedInfo(
+            f"index {index!r} over its inflight-bytes quota",
+            "bytes", after=svc, limit="inflight-bytes",
+            usage=float(held), value=float(quota),
+        )
+
     def _bump_index_bytes_locked(
         self, index: Optional[str], delta: int
     ) -> None:
@@ -831,11 +1130,19 @@ class AdmissionController:
 
     def drop_index(self, index: str) -> None:
         """Label GC hook (NodeServer.drop_index_telemetry): forget a
-        deleted index's byte-attribution entry. In-flight queries on the
-        deleted index decrement into an absent key afterwards, which the
-        max(0, ...) clamp absorbs."""
+        deleted index's byte-attribution entry and its banked intra-
+        class SFQ virtual time. In-flight queries on the deleted index
+        decrement into an absent key afterwards, which the max(0, ...)
+        clamp absorbs."""
         with self._cv:
             self._inflight_bytes_index.pop(index, None)
+            for cq in self._queues.values():
+                cq.forget(index)
+        if self.tenants is not None:
+            # tenants.mu is taken AFTER sched.mu is released (lock
+            # ordering: admission calls into the policy with sched.mu
+            # free on the bucket path too)
+            self.tenants.drop_index(index)
 
     def inflight_bytes_by_index(self) -> Dict[str, int]:
         """Snapshot of per-index in-flight bytes (telemetry sampler)."""

@@ -399,8 +399,11 @@ class API:
             and len(self.cluster.nodes) <= 1
         ):
             warm_q = query
+            # index rides along so a rate-throttled tenant cannot keep
+            # warming HBM through the prefetch side door
             scheduler.maybe_prefetch(
-                lambda: self.server.executor.warm(index, warm_q, shards)
+                lambda: self.server.executor.warm(index, warm_q, shards),
+                index=index,
             )
         return scheduler.admit(
             cls=cls,

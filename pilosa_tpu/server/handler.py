@@ -258,6 +258,13 @@ class Handler(BaseHTTPRequestHandler):
                         "Retry-After": str(max(1, math.ceil(e.retry_after))),
                         "X-Pilosa-Retry-After": f"{e.retry_after:g}",
                     }
+                    if getattr(e, "quota_limit", ""):
+                        # tenant-quota sheds name the limit that tripped
+                        # so a client can tell "slow down" (rate) from
+                        # "shrink your working set" (byte quota)
+                        hdrs["X-Pilosa-Quota-Limit"] = e.quota_limit
+                        hdrs["X-Pilosa-Quota-Usage"] = f"{e.quota_usage:g}"
+                        hdrs["X-Pilosa-Quota-Value"] = f"{e.quota_value:g}"
                     body = {"error": str(e)}
                     if trace_id:
                         from pilosa_tpu.utils import tracing as _tracing

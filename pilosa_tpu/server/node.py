@@ -24,7 +24,7 @@ import os
 import threading
 import time
 import traceback
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from pilosa_tpu.utils.locks import TrackedLock
 
@@ -89,7 +89,13 @@ class NodeServer:
         admission_queue_depth: int = 128,  # bounded admission queue
         admission_byte_budget: int = 0,  # in-flight bytes; 0 = devcache budget
         admission_default_class: str = "interactive",  # headerless queries
-        shed_retry_after: float = 1.0,  # Retry-After seconds on 429
+        shed_retry_after: float = 1.0,  # Retry-After seconds on 429 (floor)
+        tenant_default_qps: float = 0.0,  # per-index query rate; 0 = unlimited
+        tenant_default_bytes_per_s: float = 0.0,  # per-index device-byte rate
+        tenant_default_inflight_bytes: int = 0,  # per-index in-flight byte cap
+        tenant_default_hbm_bytes: int = 0,  # per-index devcache residency quota
+        tenant_default_cache_bytes: int = 0,  # per-index result-cache quota
+        tenant_overrides: Sequence[str] = (),  # "idx:qps=5;hbm-bytes=65536"
         hbm_extent_rows: int = 256,  # shards per operand extent; 0 = monolithic
         hbm_prefetch_depth: int = 0,  # warm-queue bound; 0 disables prefetch
         hbm_pin_timeout: float = 60.0,  # stale-pin safety valve, seconds
@@ -190,6 +196,21 @@ class NodeServer:
         # is admitted before it may dispatch — bounded concurrency, a
         # bounded priority queue, 429 load shedding — and the observed
         # load feeds the count batcher so batch size grows under load
+        # multi-tenant QoS policy (sched/tenants.py): per-index token
+        # buckets and byte quotas. One policy object is shared by the
+        # scheduler (admission-time rate limits + inflight quota), the
+        # prefetcher gate, and both caches (residency quotas) so a single
+        # [tenants] section governs every enforcement point.
+        from pilosa_tpu.sched.tenants import TenantPolicy
+
+        self.tenant_policy = TenantPolicy(
+            default_qps=tenant_default_qps,
+            default_bytes_per_s=tenant_default_bytes_per_s,
+            default_inflight_bytes=tenant_default_inflight_bytes,
+            default_hbm_bytes=tenant_default_hbm_bytes,
+            default_cache_bytes=tenant_default_cache_bytes,
+            overrides=tenant_overrides,
+        )
         self.scheduler = None
         if max_concurrent_queries > 0:
             from pilosa_tpu.sched.admission import AdmissionController
@@ -201,6 +222,7 @@ class NodeServer:
                 default_class=admission_default_class,
                 retry_after=shed_retry_after,
                 stats=self.stats,
+                tenants=self.tenant_policy,
             )
             self.count_batcher.load_hint = self.scheduler.load
         # HBM residency manager (pilosa_tpu/hbm/): extent-granular paging
@@ -248,9 +270,21 @@ class NodeServer:
         from pilosa_tpu.core.resultcache import RESULT_CACHE
 
         self.boot_id = uuid.uuid4().hex
+        cache_default, cache_over = self.tenant_policy.cache_quota_map()
         RESULT_CACHE.configure(
             budget_bytes=max(0, int(cache_result_mb)) << 20,
             repair=cache_count_repair,
+            tenant_default_bytes=cache_default,
+            tenant_overrides=cache_over,
+        )
+        # per-index HBM residency quotas (process-global like the [hbm]
+        # knobs — one shared device cache): eviction pressure lands on
+        # over-quota owners before the global LRU pass
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+        hbm_default, hbm_over = self.tenant_policy.hbm_quota_map()
+        DEVICE_CACHE.configure_quotas(
+            default_bytes=hbm_default, overrides=hbm_over
         )
         self.prefetcher = None
         if hbm_prefetch_depth > 0 and self.scheduler is not None:
@@ -707,6 +741,39 @@ class NodeServer:
             self.stats.with_tags(f"index:{idx}").gauge(
                 "cache.resident_bytes", 0
             )
+        # multi-tenant quota plane (sched/tenants.py): effective per-index
+        # quota values (defaults merged with overrides) plus cumulative
+        # quota-first eviction counts from both caches. Published only
+        # when SOME [tenants] limit is configured — a quota-free node
+        # keeps its metrics surface unchanged.
+        pol = getattr(self, "tenant_policy", None)
+        if pol is not None and pol.any_limits():
+            live = sorted(
+                {i.name for i in self.holder.indexes()}
+                | set(by_index)
+                | set(cache_by_index)
+            )
+            for idx in live:
+                if idx == "-":
+                    continue
+                lim = pol.limits(idx)
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tenant.hbm_quota_bytes", lim.hbm_bytes
+                )
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tenant.cache_quota_bytes", lim.cache_bytes
+                )
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tenant.inflight_quota_bytes", lim.inflight_bytes
+                )
+            for idx, n in DEVICE_CACHE.quota_evictions_by_index().items():
+                self.stats.with_tags("cache:hbm", f"index:{idx}").gauge(
+                    "tenant.quota_evictions", n
+                )
+            for idx, n in csnap["quota_evictions_by_index"].items():
+                self.stats.with_tags("cache:result", f"index:{idx}").gauge(
+                    "tenant.quota_evictions", n
+                )
 
     def drop_index_telemetry(self, index: str) -> None:
         """Label GC for a deleted index: remove every per-index metric
@@ -730,7 +797,11 @@ class NodeServer:
 
         RESULT_CACHE.drop_index(index)
         if self.scheduler is not None:
+            # the scheduler GCs its queues AND the shared tenant policy's
+            # runtime ledgers (token buckets) for the index
             self.scheduler.drop_index(index)
+        elif getattr(self, "tenant_policy", None) is not None:
+            self.tenant_policy.drop_index(index)
         published = getattr(self, "_hbm_idx_published", None)
         if published is not None:
             published.discard(index)

@@ -348,6 +348,11 @@ class Telemetry:
                     "ingestBits": 0.0,
                     "hbmResidentBytes": 0.0,
                     "inflightBytes": 0.0,
+                    # tenant quota plane: effective HBM residency quota
+                    # (0 = unlimited) and cumulative quota-first
+                    # evictions across both caches
+                    "quotaBytes": 0.0,
+                    "quotaEvictions": 0.0,
                 },
             )
 
@@ -367,6 +372,11 @@ class Telemetry:
                 idx_row(idx)["hbmResidentBytes"] += v
             elif n == "sched.index_inflight_bytes":
                 idx_row(idx)["inflightBytes"] += v
+            elif n == "tenant.hbm_quota_bytes":
+                idx_row(idx)["quotaBytes"] += v
+            elif n == "tenant.quota_evictions":
+                # both cache:hbm and cache:result series fold in
+                idx_row(idx)["quotaEvictions"] += v
         for name in indexes:
             tag = (f"index:{name}",)
             indexes[name]["queryMsP50"] = merged.quantile(

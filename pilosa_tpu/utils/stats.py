@@ -152,6 +152,17 @@ STAT_NAMES = frozenset(
         "cache.evictions",
         "cache.entries",
         "cache.resident_bytes",
+        # multi-tenant QoS enforcement (sched/tenants.py policy; gauges
+        # refreshed at scrape/sampler time by publish_cache_gauges when
+        # any [tenants] limit is configured): the per-index EFFECTIVE
+        # quotas — defaults merged with overrides, so dashboards can
+        # plot usage/quota without parsing config — and the cumulative
+        # per-index tenant-quota evictions in each cache
+        # ("cache:<hbm|result>" tag)
+        "tenant.hbm_quota_bytes",
+        "tenant.cache_quota_bytes",
+        "tenant.inflight_quota_bytes",
+        "tenant.quota_evictions",
         # live elastic resize (server/node.py streaming resharding):
         # per-fragment transfer legs, delta catch-up volume, cutover
         # latency and aborted jobs
@@ -190,12 +201,20 @@ STAT_LABELS: Dict[str, Tuple[str, ...]] = {
     "ingest.apply_ms": ("index",),
     "ingest.route_ms": ("index",),
     "sched.admit": ("class", "index"),
-    "sched.shed": ("class", "index"),
+    # shed additionally carries the reason taxonomy — rate (tenant qps
+    # bucket), bytes (tenant bytes/s bucket or in-flight byte quota),
+    # queue (admission/leg queue full), deadline (all deadline sheds) —
+    # so overload and abuse are distinguishable from /metrics alone
+    "sched.shed": ("class", "index", "reason"),
     "sched.wait_ms": ("class", "index"),
     "sched.index_inflight_bytes": ("index",),
     "hbm.resident_bytes": ("index",),
     "hbm.restage_bytes": ("index",),
     "cache.resident_bytes": ("index",),
+    "tenant.hbm_quota_bytes": ("index",),
+    "tenant.cache_quota_bytes": ("index",),
+    "tenant.inflight_quota_bytes": ("index",),
+    "tenant.quota_evictions": ("cache", "index"),
     "mesh.fallback": ("reason",),
     # federation meta-gauges (server/telemetry.py writes these into the
     # merged registry directly; the "cluster." prefix covers the names)

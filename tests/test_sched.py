@@ -212,9 +212,10 @@ class TestAdmission:
             ctl.admit(cls="batch")
         t.release()
         snap = st.registry.snapshot()
-        # admit/shed carry class AND index labels ("-" = no index bound)
+        # admit/shed carry class AND index labels ("-" = no index bound);
+        # shed additionally carries the reason taxonomy tag
         assert snap.get("sched.admit;class:interactive,index:-") == 1
-        assert snap.get("sched.shed;class:batch,index:-") == 1
+        assert snap.get("sched.shed;class:batch,index:-,reason:queue") == 1
         assert "sched.queue_depth" in snap
         assert "sched.inflight" in snap
 
@@ -989,7 +990,7 @@ def test_retry_restamps_shrunken_deadline_header():
         th.start()
         _wait_until(
             lambda: srv.stats.registry.snapshot().get(
-                "sched.shed;class:internal,index:rd", 0
+                "sched.shed;class:internal,index:rd,reason:queue", 0
             )
             >= 1,
             what="first attempt shed",

@@ -504,7 +504,16 @@ class TestHbmAttribution:
 
 class TestLabelGC:
     def test_create_delete_100_indexes_returns_to_baseline(self):
-        with ClusterHarness(1, in_memory=True) as c:
+        # generous tenant limits: the quota machinery runs (per-index
+        # gauges, rate buckets, quota ledgers) without ever shedding,
+        # so the churn also proves the tenant series and bucket state GC
+        with ClusterHarness(
+            1,
+            in_memory=True,
+            tenant_default_qps=1e9,
+            tenant_default_hbm_bytes=1 << 30,
+            tenant_default_cache_bytes=1 << 30,
+        ) as c:
             srv = c[0]
 
             from pilosa_tpu.core.resultcache import RESULT_CACHE
@@ -539,6 +548,12 @@ class TestLabelGC:
             csnap = RESULT_CACHE.stats_snapshot()
             assert csnap["resident_bytes"] == cache_base
             assert not any(k.startswith("tenant_") for k in csnap["by_index"])
+            # the tenant policy's lazy bucket map is GC'd with the index
+            assert srv.tenant_policy.bucket_count() == 0
+            assert not any(
+                k.startswith("tenant_")
+                for k in csnap["quota_evictions_by_index"]
+            )
 
     def test_release_after_drop_cannot_resurrect_the_series(self):
         """Delete an index while its query is in flight: the release's

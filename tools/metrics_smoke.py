@@ -58,6 +58,101 @@ def _index_labels(text: str, family: str) -> set:
     return out
 
 
+def _tenant_overload(errors: list) -> None:
+    """Two-tenant QoS scenario (ISSUE 16) on its own 1-node harness:
+    one index rate-limited to 1 qps, one held to a 1000-byte HBM quota.
+    The abuser's second query must shed 429 with the X-Pilosa-Quota-*
+    headers; the hog's second distinct row must trip quota-first
+    eviction; the tenant.* gauge families and the reason-tagged
+    sched.shed series must render on a lint-clean /metrics page."""
+    import urllib.error
+
+    from pilosa_tpu.testing import ClusterHarness
+
+    with ClusterHarness(
+        1, in_memory=True, metric_poll_interval=0.0,
+        telemetry_sample_interval=0.0,
+        tenant_overrides=["smoke_abuser:qps=1", "smoke_hog:hbm-bytes=1000"],
+    ) as cluster:
+        srv = cluster[0]
+        uri = srv.node.uri
+        for idx in ("smoke_abuser", "smoke_hog"):
+            srv.api.create_index(idx)
+            srv.api.create_field(idx, "f", {"type": "set"})
+            _post(
+                uri, f"/index/{idx}/field/f/import",
+                {"rows": [1] * 8 + [2] * 8,
+                 "cols": list(range(8)) + list(range(8))},
+            )
+        # the abuser's burst token serves one query; the immediate
+        # repeat must shed with the informed headers
+        resp = _post(uri, "/index/smoke_abuser/query",
+                     {"query": "Count(Row(f=1))"})
+        assert resp["results"] == [8], resp
+        try:
+            _post(uri, "/index/smoke_abuser/query",
+                  {"query": "Count(Row(f=1))"})
+            errors.append("tenant smoke: second 1-qps query was not shed")
+        except urllib.error.HTTPError as e:
+            if e.code != 429:
+                errors.append(f"tenant smoke: expected 429, got {e.code}")
+            if e.headers.get("X-Pilosa-Quota-Limit") != "qps":
+                errors.append(
+                    "tenant smoke: 429 missing X-Pilosa-Quota-Limit=qps "
+                    f"(got {dict(e.headers)})"
+                )
+            if not e.headers.get("Retry-After"):
+                errors.append("tenant smoke: 429 missing Retry-After")
+            e.close()
+        # two distinct row operands cannot both fit a 1000-byte device
+        # quota: the second insert must evict the first (quota-first,
+        # global budget far from pressure)
+        for row in (1, 2):
+            resp = _post(uri, "/index/smoke_hog/query",
+                         {"query": f"Count(Row(f={row}))"})
+            assert resp["results"] == [8], resp
+        from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+        qev = DEVICE_CACHE.quota_evictions_by_index()
+        if qev.get("smoke_hog", 0) <= 0:
+            errors.append(
+                f"tenant smoke: no quota evictions for smoke_hog: {qev}"
+            )
+        srv.publish_cache_gauges()
+        text = _get(uri, "/metrics")
+        overview = json.loads(_get(uri, "/cluster/overview"))
+    for e in lint_against_registry(text):
+        errors.append(f"tenant /metrics: {e}")
+    if not re.search(
+        r'^pilosa_tpu_sched_shed\{[^}]*index="smoke_abuser"[^}]*'
+        r'reason="rate"[^}]*\} ',
+        text, re.M,
+    ) and not re.search(
+        r'^pilosa_tpu_sched_shed\{[^}]*reason="rate"[^}]*'
+        r'index="smoke_abuser"[^}]*\} ',
+        text, re.M,
+    ):
+        errors.append(
+            "tenant /metrics: sched.shed{index=smoke_abuser,reason=rate} "
+            "missing"
+        )
+    for fam in (
+        "pilosa_tpu_tenant_hbm_quota_bytes",
+        "pilosa_tpu_tenant_quota_evictions",
+    ):
+        if not re.search(rf'^{fam}\{{', text, re.M):
+            errors.append(f"tenant /metrics: {fam} missing")
+    row = overview.get("indexes", {}).get("smoke_hog")
+    if not row or row.get("quotaBytes") != 1000:
+        errors.append(
+            f"/cluster/overview: smoke_hog quotaBytes != 1000: {row}"
+        )
+    if row and row.get("quotaEvictions", 0) <= 0:
+        errors.append(
+            f"/cluster/overview: smoke_hog quotaEvictions stayed 0: {row}"
+        )
+
+
 def main() -> int:
     errors: list = []
     with ClusterHarness(
@@ -311,6 +406,17 @@ def main() -> int:
         errors.append(f"/cluster/overview: live peers marked stale: {overview}")
     if health.get("status") != "ok":
         errors.append(f"/cluster/health: expected ok: {health}")
+
+    # the main harness runs with NO tenant limits configured: the
+    # tenant.* gauge families must not render at all (opt-in series)
+    if re.search(r"^pilosa_tpu_tenant_", node_text, re.M):
+        errors.append(
+            "node /metrics: tenant.* series rendered without any "
+            "tenant limits configured"
+        )
+
+    # multi-tenant QoS enforcement (ISSUE 16), on its own harness
+    _tenant_overload(errors)
 
     for e in errors:
         print(f"metrics-smoke: {e}")
