@@ -32,6 +32,7 @@ from pilosa_tpu.analysis.framework import (
 )
 from pilosa_tpu.analysis.guarded_by import GuardedByPass
 from pilosa_tpu.analysis.jax_purity import JaxPurityPass
+from pilosa_tpu.analysis.lifecycle import LifecyclePass
 from pilosa_tpu.analysis.lock_hygiene import LockHygienePass
 
 __all__ = [
@@ -42,6 +43,7 @@ __all__ = [
     "GateResult",
     "GuardedByPass",
     "JaxPurityPass",
+    "LifecyclePass",
     "LockHygienePass",
     "Module",
     "Pass",
@@ -61,6 +63,7 @@ def default_passes() -> List[Pass]:
         GuardedByPass(),
         JaxPurityPass(),
         ApiInvariantsPass(),
+        LifecyclePass(),
     ]
 
 

@@ -25,6 +25,11 @@ Rules (codes):
   nothing can attribute.
 * API007 — a declared SPAN_NAMES entry no module starts: stale
   registry (same contract as API002 for STAT_NAMES).
+* API009 — a config knob no module ever reads at runtime: the field
+  name never appears as an attribute read outside `cli/config.py`
+  itself (flag tables and argparse strings don't count — only a real
+  `cfg.section.knob` access does). A knob that parses, documents, and
+  round-trips but influences nothing is dead configuration.
 
 All facts are extracted statically from the ASTs — the pass never
 imports the package, so it works on broken/half-edited trees too.
@@ -103,7 +108,7 @@ class ApiInvariantsPass(Pass):
     name = "api-invariants"
     rules = (
         "API001", "API002", "API003", "API004", "API005", "API006",
-        "API007", "API008",
+        "API007", "API008", "API009",
     )
 
     def __init__(self, docs_path: Optional[str] = None):
@@ -126,6 +131,7 @@ class ApiInvariantsPass(Pass):
             self._check_docs(config_mod, knobs, findings)
             if main_mod is not None:
                 self._check_flags(main_mod, knobs, findings)
+            self._check_knob_reads(modules, config_mod, knobs, findings)
         return findings
 
     # -- stats registry ----------------------------------------------------
@@ -460,6 +466,42 @@ class ApiInvariantsPass(Pass):
                         message=(
                             f"config knob {path!r} ({kebab!r}) is not "
                             "documented in docs/configuration.md"
+                        ),
+                    )
+                )
+
+    def _check_knob_reads(
+        self,
+        modules: Sequence[Module],
+        config_mod: Module,
+        knobs: Dict[str, int],
+        findings: List[Finding],
+    ) -> None:
+        """API009: a declared knob nothing ever reads. A knob counts as
+        read only when its field name appears as an attribute access
+        (`cfg.section.knob`, `self.knob`) in some module other than the
+        config declarations themselves — flag tables, argparse strings
+        and TOML keys are plumbing, not consumption."""
+        read: Set[str] = set()
+        for m in modules:
+            if m.rel == config_mod.rel:
+                continue
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Attribute):
+                    read.add(node.attr)
+        for path, line in sorted(knobs.items()):
+            field = path.split(".")[-1]
+            if field not in read:
+                findings.append(
+                    Finding(
+                        code="API009",
+                        path=config_mod.rel,
+                        line=line,
+                        message=(
+                            f"config knob {path!r} is declared (and "
+                            "documented, and flagged) but never read at "
+                            "runtime — no module accesses `.{0}`; wire "
+                            "it up or delete it".format(field)
                         ),
                     )
                 )

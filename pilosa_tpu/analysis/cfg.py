@@ -1,0 +1,471 @@
+"""Intraprocedural control-flow graphs for the dataflow passes.
+
+Builds a statement-level CFG per function (on top of the same parsed
+``Module`` model framework.py gives every pass), precise enough for a
+must-release analysis (analysis/lifecycle.py):
+
+* branches, loops (with ``break``/``continue``/``else``), early
+  returns, ``with`` blocks, ``match``;
+* ``try``/``except``/``finally`` with **exception edges out of every
+  statement that can raise**: a raising statement has an ``exc`` edge
+  to the innermost handler dispatch, or through the enclosing
+  ``finally`` bodies to the synthetic ``raise`` exit;
+* ``finally`` bodies are cloned per continuation kind (fallthrough /
+  raise / return / break / continue), lazily and memoized, so a
+  release inside a ``finally`` kills the resource on *every* path that
+  unwinds through it — exactly the guarantee the runtime gives;
+* ``with`` bodies get the same unwind treatment via synthetic
+  ``with_exit`` nodes (``__exit__`` runs on every way out).
+
+Edge semantics: ``succ`` edges are normal completion, ``exc`` edges
+are exception flow.  The distinction matters to clients only at effect
+application time (an acquisition that raises acquired nothing); graph
+reachability treats both uniformly.
+
+The graph is conservative in the may-direction for leak analysis: an
+exception edge exists whenever a statement *might* raise (calls,
+subscripts, attribute access, imports, asserts, binary operators,
+non-identity comparisons), and a ``with`` ``__exit__`` is never
+assumed to suppress.  Identity tests (``x is None``) get no exception
+edge, so the ubiquitous ``if x is not None: x.release()`` cleanup
+idiom stays provable.  Branch nodes record their true-branch entry so
+a dataflow client can prune branch arms that are infeasible for a
+tracked value (see ``CfgNode.true_entry``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["CfgNode", "Cfg", "build_cfg", "iter_functions", "expr_can_raise"]
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclass
+class CfgNode:
+    """One CFG node.  ``kind`` is one of:
+
+    entry/exit/raise   — synthetic function boundaries (``raise`` is
+                         the "an exception escaped" terminal);
+    stmt               — a simple statement;
+    branch             — an ``if`` test (``true_entry`` set);
+    loop / loop_exit   — a ``for``/``while`` head and its join;
+    with / with_exit   — a ``with`` enter and an ``__exit__`` run
+                         (cloned per unwind kind);
+    except / handler   — a try's handler dispatch and each clause;
+    finally            — unused marker kind kept for clients; finally
+                         bodies are real stmt nodes (cloned);
+    match              — a ``match`` subject.
+    """
+
+    nid: int
+    kind: str
+    stmt: Optional[ast.AST] = None
+    succ: Set[int] = field(default_factory=set)
+    exc: Set[int] = field(default_factory=set)
+    # for `branch` nodes: the node id the TRUE arm enters (every other
+    # successor is reached by the test evaluating false)
+    true_entry: Optional[int] = None
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def edges(self) -> Set[int]:
+        return self.succ | self.exc
+
+
+class Cfg:
+    """CFG of one function.  ``entry`` flows into the first statement;
+    normal completion reaches ``exit``; an escaping exception reaches
+    ``raise_exit``.  Statements may appear in several nodes (finally /
+    with-exit bodies are cloned per unwind kind) — clients that key
+    effects off statements should match on ``id(node.stmt)``."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.nodes: List[CfgNode] = []
+        self.entry = self._new("entry")
+        self.exit = self._new("exit")
+        self.raise_exit = self._new("raise")
+
+    def _new(self, kind: str, stmt: Optional[ast.AST] = None) -> int:
+        node = CfgNode(len(self.nodes), kind, stmt)
+        self.nodes.append(node)
+        return node.nid
+
+    def node(self, nid: int) -> CfgNode:
+        return self.nodes[nid]
+
+    def stmt_nodes(self, stmt: ast.AST) -> List[CfgNode]:
+        """Every node carrying this exact statement object (clones
+        included)."""
+        return [n for n in self.nodes if n.stmt is stmt]
+
+
+# -- can-raise predicate ----------------------------------------------------
+
+_RAISER_NODES = (
+    ast.Call,
+    ast.Subscript,
+    ast.Attribute,
+    ast.Await,
+    ast.Yield,
+    ast.YieldFrom,
+    ast.BinOp,
+)
+
+
+def _walk_expr(expr: ast.AST) -> Iterator[ast.AST]:
+    # ast.walk, but without descending into deferred code (lambda
+    # bodies run at call time, not here)
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(node, ast.Lambda) and child is node.body:
+                continue
+            stack.append(child)
+
+
+def expr_can_raise(expr: Optional[ast.AST]) -> bool:
+    """Conservative: may evaluating this expression raise?  Identity
+    comparisons, boolean/unary ops and plain name/constant loads are
+    the provably-quiet shapes; everything that can call user code
+    (including operators and attribute access) can raise."""
+    if expr is None:
+        return False
+    for node in _walk_expr(expr):
+        if isinstance(node, _RAISER_NODES):
+            return True
+        if isinstance(node, ast.Compare) and not all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            return True
+    return False
+
+
+def _stmt_can_raise(stmt: ast.stmt) -> bool:
+    """Can-raise for SIMPLE statements (compound heads are handled
+    per-shape in the builder)."""
+    if isinstance(stmt, (ast.Pass, ast.Global, ast.Nonlocal)):
+        return False
+    if isinstance(stmt, (ast.Import, ast.ImportFrom, ast.Assert, ast.Raise)):
+        return True
+    if isinstance(stmt, ast.ClassDef):
+        return True  # the class body executes at the statement
+    if isinstance(stmt, FunctionNode):
+        parts: List[ast.AST] = list(stmt.decorator_list)
+        parts += stmt.args.defaults + [
+            d for d in stmt.args.kw_defaults if d is not None
+        ]
+        return any(expr_can_raise(p) for p in parts)
+    if isinstance(stmt, ast.Assign):
+        return any(expr_can_raise(t) for t in stmt.targets) or expr_can_raise(
+            stmt.value
+        )
+    if isinstance(stmt, ast.AnnAssign):
+        return expr_can_raise(stmt.target) or expr_can_raise(stmt.value)
+    if isinstance(stmt, ast.AugAssign):
+        return True  # in-place operator dispatch
+    if isinstance(stmt, ast.Return):
+        return expr_can_raise(stmt.value)
+    if isinstance(stmt, ast.Expr):
+        return expr_can_raise(stmt.value)
+    if isinstance(stmt, ast.Delete):
+        return any(expr_can_raise(t) for t in stmt.targets)
+    return True
+
+
+_CATCH_ALL_NAMES = {"Exception", "BaseException"}
+
+
+def _handler_catches_all(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types: List[ast.expr]
+    if isinstance(handler.type, ast.Tuple):
+        types = list(handler.type.elts)
+    else:
+        types = [handler.type]
+    for t in types:
+        name = t.attr if isinstance(t, ast.Attribute) else (
+            t.id if isinstance(t, ast.Name) else ""
+        )
+        if name in _CATCH_ALL_NAMES:
+            return True
+    return False
+
+
+# -- builder ----------------------------------------------------------------
+
+_Target = Callable[[], int]
+
+
+class _Ctx:
+    """Where control transfers out of the current lexical region land.
+    Targets are thunks: resolving one may lazily build the enclosing
+    finally/with unwind clones on the way to the real destination."""
+
+    __slots__ = ("raise_", "return_", "break_", "continue_")
+
+    def __init__(
+        self,
+        raise_: _Target,
+        return_: _Target,
+        break_: Optional[_Target] = None,
+        continue_: Optional[_Target] = None,
+    ):
+        self.raise_ = raise_
+        self.return_ = return_
+        self.break_ = break_
+        self.continue_ = continue_
+
+
+class _Builder:
+    def __init__(self, cfg: Cfg):
+        self.cfg = cfg
+
+    # entries are always single nodes (the first statement of a block);
+    # outs are the dangling normal-completion node ids of the block
+    def build(self) -> None:
+        cfg = self.cfg
+        ctx = _Ctx(lambda: cfg.raise_exit, lambda: cfg.exit)
+        entry, outs = self._block(self.cfg.fn.body, ctx)
+        cfg.node(cfg.entry).succ.add(entry)
+        self._wire(outs, cfg.exit)
+
+    def _wire(self, preds: Sequence[int], target: int) -> None:
+        for p in preds:
+            self.cfg.node(p).succ.add(target)
+
+    def _block(
+        self, stmts: Sequence[ast.stmt], ctx: _Ctx
+    ) -> Tuple[int, List[int]]:
+        entry: Optional[int] = None
+        outs: List[int] = []
+        for stmt in stmts:
+            s_entry, s_outs = self._stmt(stmt, ctx)
+            if entry is None:
+                entry = s_entry
+            else:
+                self._wire(outs, s_entry)
+            outs = s_outs
+        assert entry is not None  # Python blocks are never empty
+        return entry, outs
+
+    def _unwind_ctx(self, ctx: _Ctx, make: Callable[[int], int]) -> _Ctx:
+        """Wrap `ctx` so any transfer out of the region first passes an
+        unwind path built by make(ultimate_target) — a with_exit node
+        or a finally-body clone.  One clone per transfer kind, built
+        lazily and memoized (a finally with no `return` under it never
+        grows a return clone)."""
+        memo: Dict[str, int] = {}
+
+        def via(kind: str, target: Optional[_Target]) -> Optional[_Target]:
+            if target is None:
+                return None
+
+            def thunk() -> int:
+                if kind not in memo:
+                    memo[kind] = make(target())
+                return memo[kind]
+
+            return thunk
+
+        raise_ = via("raise", ctx.raise_)
+        return_ = via("return", ctx.return_)
+        assert raise_ is not None and return_ is not None
+        return _Ctx(
+            raise_,
+            return_,
+            via("break", ctx.break_),
+            via("continue", ctx.continue_),
+        )
+
+    def _stmt(self, stmt: ast.stmt, ctx: _Ctx) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, ctx)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, ctx)
+        if isinstance(stmt, ast.Try) or (
+            hasattr(ast, "TryStar") and isinstance(stmt, ast.TryStar)
+        ):
+            return self._try(stmt, ctx)
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, ctx)
+
+        n = cfg._new("stmt", stmt)
+        if isinstance(stmt, ast.Return):
+            if expr_can_raise(stmt.value):
+                cfg.node(n).exc.add(ctx.raise_())
+            cfg.node(n).succ.add(ctx.return_())
+            return n, []
+        if isinstance(stmt, ast.Raise):
+            cfg.node(n).exc.add(ctx.raise_())
+            return n, []
+        if isinstance(stmt, ast.Break):
+            if ctx.break_ is not None:
+                cfg.node(n).succ.add(ctx.break_())
+            return n, []
+        if isinstance(stmt, ast.Continue):
+            if ctx.continue_ is not None:
+                cfg.node(n).succ.add(ctx.continue_())
+            return n, []
+        if _stmt_can_raise(stmt):
+            cfg.node(n).exc.add(ctx.raise_())
+        return n, [n]
+
+    def _if(self, stmt: ast.If, ctx: _Ctx) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        n = cfg._new("branch", stmt)
+        if expr_can_raise(stmt.test):
+            cfg.node(n).exc.add(ctx.raise_())
+        b_entry, b_outs = self._block(stmt.body, ctx)
+        cfg.node(n).succ.add(b_entry)
+        cfg.node(n).true_entry = b_entry
+        outs = list(b_outs)
+        if stmt.orelse:
+            e_entry, e_outs = self._block(stmt.orelse, ctx)
+            cfg.node(n).succ.add(e_entry)
+            outs += e_outs
+        else:
+            outs.append(n)  # test-false falls through
+        return n, outs
+
+    def _loop(self, stmt: ast.stmt, ctx: _Ctx) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        head = cfg._new("loop", stmt)
+        test = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+        if expr_can_raise(test) or not isinstance(stmt, ast.While):
+            cfg.node(head).exc.add(ctx.raise_())
+        lexit = cfg._new("loop_exit", stmt)
+        loop_ctx = _Ctx(
+            ctx.raise_, ctx.return_, lambda: lexit, lambda: head
+        )
+        b_entry, b_outs = self._block(stmt.body, loop_ctx)
+        cfg.node(head).succ.add(b_entry)
+        self._wire(b_outs, head)
+        if stmt.orelse:
+            # else runs on normal exhaustion (not break)
+            o_entry, o_outs = self._block(stmt.orelse, ctx)
+            cfg.node(head).succ.add(o_entry)
+            self._wire(o_outs, lexit)
+        else:
+            cfg.node(head).succ.add(lexit)
+        return head, [lexit]
+
+    def _with(self, stmt: ast.stmt, ctx: _Ctx) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        enter = cfg._new("with", stmt)
+        cfg.node(enter).exc.add(ctx.raise_())  # ctx exprs / __enter__
+
+        def mk_exit(target: int) -> int:
+            wx = cfg._new("with_exit", stmt)
+            cfg.node(wx).succ.add(target)
+            cfg.node(wx).exc.add(ctx.raise_())  # __exit__ itself
+            return wx
+
+        wctx = self._unwind_ctx(ctx, mk_exit)
+        b_entry, b_outs = self._block(stmt.body, wctx)
+        cfg.node(enter).succ.add(b_entry)
+        wx = cfg._new("with_exit", stmt)
+        cfg.node(wx).exc.add(ctx.raise_())
+        self._wire(b_outs, wx)
+        return enter, [wx]
+
+    def _try(self, stmt: ast.stmt, ctx: _Ctx) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        if stmt.finalbody:
+            def mk_finally(target: int) -> int:
+                f_entry, f_outs = self._block(stmt.finalbody, ctx)
+                self._wire(f_outs, target)
+                return f_entry
+
+            fctx = self._unwind_ctx(ctx, mk_finally)
+        else:
+            fctx = ctx
+
+        if stmt.handlers:
+            dispatch = cfg._new("except", stmt)
+            body_ctx = _Ctx(
+                lambda: dispatch, fctx.return_, fctx.break_, fctx.continue_
+            )
+        else:
+            dispatch = None
+            body_ctx = fctx
+
+        b_entry, b_outs = self._block(stmt.body, body_ctx)
+        normal_outs = list(b_outs)
+        if stmt.orelse:
+            # else-clause exceptions are NOT caught by this try
+            o_entry, o_outs = self._block(stmt.orelse, fctx)
+            self._wire(b_outs, o_entry)
+            normal_outs = list(o_outs)
+
+        if dispatch is not None:
+            catch_all = False
+            for handler in stmt.handlers:
+                catch_all = catch_all or _handler_catches_all(handler)
+                h = cfg._new("handler", handler)
+                cfg.node(dispatch).succ.add(h)
+                hb_entry, hb_outs = self._block(handler.body, fctx)
+                cfg.node(h).succ.add(hb_entry)
+                normal_outs += hb_outs
+            if not catch_all:
+                # an exception no clause matches escapes (through the
+                # finally, when there is one)
+                cfg.node(dispatch).exc.add(fctx.raise_())
+
+        if stmt.finalbody:
+            f_entry, f_outs = self._block(stmt.finalbody, ctx)
+            self._wire(normal_outs, f_entry)
+            return b_entry, f_outs
+        return b_entry, normal_outs
+
+    def _match(self, stmt: ast.Match, ctx: _Ctx) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        n = cfg._new("match", stmt)
+        guards = [c.guard for c in stmt.cases if c.guard is not None]
+        if expr_can_raise(stmt.subject) or any(map(expr_can_raise, guards)):
+            cfg.node(n).exc.add(ctx.raise_())
+        outs: List[int] = [n]  # no case may match
+        for case in stmt.cases:
+            c_entry, c_outs = self._block(case.body, ctx)
+            cfg.node(n).succ.add(c_entry)
+            outs += c_outs
+        return n, outs
+
+
+def build_cfg(fn: ast.AST) -> Cfg:
+    """Build the CFG of one (async) function definition."""
+    cfg = Cfg(fn)
+    _Builder(cfg).build()
+    return cfg
+
+
+def iter_functions(
+    tree: ast.AST,
+) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield (qualname, fn) for every function/method in the module,
+    nested ones included."""
+
+    def walk(node: ast.AST, prefix: str) -> Iterator[Tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FunctionNode):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from walk(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")

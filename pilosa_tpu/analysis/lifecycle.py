@@ -1,0 +1,821 @@
+"""Resource-lifecycle pass: must-release dataflow over the CFG.
+
+The reference Pilosa gets release-on-every-path structurally from Go's
+``defer``; this repo has to prove its try/finally discipline instead.
+Every acquisition named in the declarative contract registry below
+(CONTRACTS) must — on **every** CFG path out of the acquiring
+function, exception edges included — be one of:
+
+* released (a contract release method/function reaches the handle),
+* returned to the caller (ownership transfer up),
+* passed to a callee the contract declares takes ownership,
+* stored into an attribute annotated as owning, or
+* covered by an explicit annotation.
+
+Annotations (reason mandatory, same contract as ``# lock-free:`` /
+``# dispatch-ok:``; written trailing on the statement's first line or
+as a one-line comment directly above it):
+
+* ``# owns: <reason>``      — on an acquisition: don't track it (the
+  surrounding object owns it); on an attribute store: the attribute
+  owns the handle from here (its owner's shutdown path releases it).
+* ``# releases: <reason>``  — this statement releases the tracked
+  resource in a way the matcher can't see (indirect call, container
+  drain).
+* ``# transfer: <reason>``  — ownership leaves this function here
+  (cross-function ledger, callee side-table) even though the callee
+  isn't declared in the contract.
+
+Rules:
+
+* RES001 — a path to normal function exit may still hold the resource
+  (includes an acquisition stored into an unannotated attribute
+  outside ``__init__``).
+* RES002 — a path to an escaping exception may still hold it.
+* RES003 — the acquisition's handle is discarded at the call site.
+* RES004 — annotation problems: empty reason, or an annotation that
+  matched nothing (stale annotations must go, like stale baselines).
+* RES005 — contract registry and the runtime ledger
+  (utils/resources.py RESOURCE_CLASSES) out of sync, either way.
+
+Scope and precision: the analysis is intraprocedural and tracks
+single-name bindings (``x = acquire()``, including conditional
+``x = acquire() if c else None``).  An acquisition used directly as a
+``with`` context manager, returned immediately, or passed straight
+into another call is ownership transfer by construction and is not
+tracked.  ``if x is not None: x.release()`` style guards are
+understood (branch pruning on identity/truth tests of the tracked
+name, see cfg.CfgNode.true_entry).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pilosa_tpu.analysis.cfg import Cfg, CfgNode, build_cfg, iter_functions
+from pilosa_tpu.analysis.framework import (
+    Finding,
+    Module,
+    Pass,
+    dotted_name,
+    import_aliases,
+    resolve_call,
+)
+from pilosa_tpu.analysis.lock_hygiene import LOCKISH_RE
+
+__all__ = ["Contract", "CONTRACTS", "LifecyclePass", "RESOURCES_MODULE"]
+
+RESOURCES_MODULE = "pilosa_tpu/utils/resources.py"
+
+
+@dataclass(frozen=True)
+class Contract:
+    """One resource class's lifecycle contract.
+
+    ``acquire`` is a regex matched against the dotted call target of a
+    candidate acquisition (both as written and alias-resolved, so
+    ``from threading import Thread`` still matches ``threading.Thread``).
+
+    ``mode`` selects what the dataflow tracks:
+      var  — the name the acquisition is bound to (the handle);
+      site — the acquisition site itself: the resource has no local
+             handle (a pin refcount, an armed capture) and any
+             downstream release-call/annotation settles it;
+      recv — the call receiver (manual ``mu.acquire()``: the lock
+             object is both handle and release target).
+    """
+
+    resource: str
+    acquire: str
+    prefilter: Tuple[str, ...]  # cheap terminal-name gate (speed only)
+    mode: str = "var"
+    release_methods: Tuple[str, ...] = ()  # handle.m(...)
+    release_funcs: Tuple[str, ...] = ()  # f(handle) / site-mode any call
+    transfer_funcs: Tuple[str, ...] = ()  # f(handle) takes ownership
+    transfer_kwargs: Tuple[str, ...] = ()  # f(kw=handle) takes ownership
+    require_kwargs: Tuple[Tuple[str, object], ...] = ()
+    exempt_kwargs: Tuple[Tuple[str, object], ...] = ()
+    check_return: bool = True  # normal exit while held is a leak
+    check_raise: bool = True  # escaping exception while held is a leak
+    paths: Tuple[str, ...] = ()  # rel-path prefixes; () = everywhere
+
+    def acq_re(self) -> "re.Pattern[str]":
+        return _RE_CACHE.setdefault(self.acquire, re.compile(self.acquire))
+
+
+_RE_CACHE: Dict[str, "re.Pattern[str]"] = {}
+
+
+# The declarative registry.  Every `resource` here must have an entry
+# in utils/resources.py RESOURCE_CLASSES and vice versa (RES005).
+CONTRACTS: Tuple[Contract, ...] = (
+    Contract(
+        resource="sched.ticket",
+        acquire=r"(?:^|\.)(?:admit|_admit|_admit_transfer)$",
+        prefilter=("admit", "_admit", "_admit_transfer"),
+        release_methods=("release",),
+    ),
+    Contract(
+        # the extent-table handle over a set of pinned keys
+        resource="hbm.pin",
+        acquire=r"(?:^|\.)ExtentTable$",
+        prefilter=("ExtentTable",),
+        release_methods=("release",),
+        release_funcs=("release_extents",),
+        transfer_kwargs=("extents", "table"),
+    ),
+    Contract(
+        # a bare pin refcount taken without a table
+        resource="hbm.pin",
+        acquire=r"(?:^|\.)get_or_build$",
+        prefilter=("get_or_build",),
+        mode="site",
+        require_kwargs=(("pin", True),),
+        release_methods=("release",),
+        release_funcs=("unpin", "unpin_all", "release_extents"),
+    ),
+    Contract(
+        resource="hbm.pin",
+        acquire=r"(?:^|\.)pin_if_present$",
+        prefilter=("pin_if_present",),
+        mode="site",
+        release_methods=("release",),
+        release_funcs=("unpin", "unpin_all", "release_extents"),
+    ),
+    Contract(
+        # a group-commit position: the write is not acked until
+        # wait_durable(token).  check_raise off: a raised write was
+        # never acked, so there is nothing to wait for.
+        resource="wal.token",
+        acquire=r"(?:^|\.)_wal\.append(?:_many)?$|(?:^|\.)_wal_append$",
+        prefilter=("append", "append_many", "_wal_append"),
+        release_funcs=("wait_durable",),
+        check_raise=False,
+    ),
+    Contract(
+        resource="fragment.capture",
+        acquire=r"(?:^|\.)begin_streaming$",
+        prefilter=("begin_streaming",),
+        mode="site",
+        release_funcs=("end_capture",),
+    ),
+    Contract(
+        resource="fault.plane",
+        acquire=r"(?:^|\.)install_(?:injector|breakers)$",
+        prefilter=("install_injector", "install_breakers"),
+        mode="site",
+        release_funcs=("uninstall_injector", "uninstall_breakers"),
+    ),
+    Contract(
+        # tenant bucket charge: a DENIED admission must refund what an
+        # earlier bucket granted.  check_return off: tokens granted on
+        # the admit path are consumed by design.
+        resource="tenant.charge",
+        acquire=r"(?:^|\.)(?:qb|bb)\.take$",
+        prefilter=("take",),
+        mode="site",
+        release_funcs=("refund",),
+        check_return=False,
+        paths=("pilosa_tpu/sched/",),
+    ),
+    Contract(
+        resource="runtime.pool",
+        acquire=r"(?:^|\.)ThreadPoolExecutor$|(?:^|\.)threading\.Thread$",
+        prefilter=("ThreadPoolExecutor", "Thread"),
+        release_methods=("shutdown", "join"),
+        exempt_kwargs=(("daemon", True),),
+    ),
+    Contract(
+        # a tracked lock acquired outside `with` must reach .release()
+        # on every path — this is why `with` exists; bare acquires are
+        # only for lexically-unprovable shapes (and get annotated)
+        resource="lock.manual",
+        acquire=r"\.acquire$",
+        prefilter=("acquire",),
+        mode="recv",
+        release_methods=("release",),
+    ),
+)
+
+
+# -- annotations ------------------------------------------------------------
+
+_ANN_RE = re.compile(
+    r"#\s*(?P<kind>owns|releases|transfer)\s*:\s*(?P<reason>[^#\n]*)"
+)
+
+
+@dataclass
+class _Annotations:
+    # lineno -> kind; empty-reason lines are reported once and then
+    # treated as absent (they suppress nothing)
+    by_line: Dict[int, str] = field(default_factory=dict)
+    consumed: Set[int] = field(default_factory=set)
+    findings: List[Finding] = field(default_factory=list)
+
+    def claim(self, line: int) -> Optional[str]:
+        """The annotation governing the statement starting at `line`:
+        trailing on the line itself, or a comment on the line directly
+        above (for statements too long to share a line with a reason).
+        Claiming marks it consumed — unclaimed annotations are stale
+        (RES004)."""
+        for ln in (line, line - 1):
+            if ln in self.by_line:
+                self.consumed.add(ln)
+                return self.by_line[ln]
+        return None
+
+
+def _comment_lines(source: str) -> List[Tuple[int, str]]:
+    """(lineno, text) for every real comment token — docstrings and
+    string literals that merely *mention* the annotation syntax (this
+    module's own documentation, finding messages) don't count."""
+    out: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenizeError, IndentationError, SyntaxError):
+        pass  # unparseable files never reach the pass anyway
+    return out
+
+
+def _scan_annotations(module: Module) -> _Annotations:
+    ann = _Annotations()
+    for i, line in _comment_lines(module.source):
+        m = _ANN_RE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        if not m.group("reason").strip():
+            ann.findings.append(
+                Finding(
+                    "RES004",
+                    module.rel,
+                    i,
+                    f"`# {kind}:` annotation has an empty reason — "
+                    "ownership escapes must say why (same contract as "
+                    "# lock-free:)",
+                )
+            )
+            continue
+        ann.by_line[i] = kind
+    return ann
+
+
+# -- acquisition detection --------------------------------------------------
+
+
+def _kw_const(call: ast.Call, key: str) -> object:
+    for kw in call.keywords:
+        if kw.arg == key and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def _call_matches(
+    contract: Contract, call: ast.Call, aliases: Dict[str, str]
+) -> bool:
+    raw = dotted_name(call.func)
+    if raw is None:
+        return False
+    pat = contract.acq_re()
+    if not pat.search(raw):
+        resolved = resolve_call(call, aliases)
+        if resolved is None or not pat.search(resolved):
+            return False
+    for key, want in contract.require_kwargs:
+        if _kw_const(call, key) != want:
+            return False
+    for key, want in contract.exempt_kwargs:
+        if _kw_const(call, key) == want:
+            return False
+    if contract.mode == "recv":
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        recv = dotted_name(call.func.value)
+        if recv is None or not LOCKISH_RE.search(recv.split(".")[-1]):
+            return False
+    return True
+
+
+@dataclass
+class _Acq:
+    contract: Contract
+    stmt: ast.stmt
+    call: ast.Call
+    var: Optional[str]  # var mode: the bound name; recv mode: receiver
+    callee: str
+
+
+def _matching_call(
+    contract: Contract, value: Optional[ast.expr], aliases: Dict[str, str]
+) -> Optional[ast.Call]:
+    """The acquisition call when `value` is one (directly, or as either
+    arm of a conditional expression)."""
+    if isinstance(value, ast.Call) and _call_matches(contract, value, aliases):
+        return value
+    if isinstance(value, ast.IfExp):
+        for arm in (value.body, value.orelse):
+            if isinstance(arm, ast.Call) and _call_matches(
+                contract, arm, aliases
+            ):
+                return arm
+    return None
+
+
+# -- kill / transfer matching ----------------------------------------------
+
+
+def _name_in(expr: Optional[ast.AST], var: str) -> bool:
+    if expr is None:
+        return False
+    return any(
+        isinstance(n, ast.Name) and n.id == var for n in ast.walk(expr)
+    )
+
+
+def _node_exprs(node: CfgNode) -> List[ast.AST]:
+    """The code that executes AT this node.  Compound-statement heads
+    carry their whole subtree in ``stmt`` — only the head expression
+    runs at the head node (a release inside an ``if`` body must NOT
+    make the test a kill), and synthetic nodes run nothing."""
+    stmt = node.stmt
+    if stmt is None:
+        return []
+    if node.kind == "stmt":
+        return [stmt]
+    if node.kind == "branch":
+        return [stmt.test]
+    if node.kind == "loop":
+        return [stmt.test if isinstance(stmt, ast.While) else stmt.iter]
+    if node.kind == "with":
+        return [item.context_expr for item in stmt.items]
+    if node.kind == "match":
+        return [stmt.subject]
+    return []  # with_exit / except / handler / loop_exit / terminals
+
+
+def _calls_in(exprs: Sequence[ast.AST]) -> List[ast.Call]:
+    return [
+        n
+        for e in exprs
+        for n in ast.walk(e)
+        if isinstance(n, ast.Call)
+    ]
+
+
+def _kills(
+    acq: _Acq, node: CfgNode, ann: _Annotations, in_init: bool
+) -> bool:
+    """Does executing `node` settle the tracked resource (release it,
+    or transfer its ownership out of this function)?"""
+    exprs = _node_exprs(node)
+    if not exprs:
+        return False
+    stmt = node.stmt
+    line = getattr(stmt, "lineno", 0)
+    if ann.claim(line) is not None:
+        return True
+    c = acq.contract
+    if c.mode == "site":
+        for call in _calls_in(exprs):
+            name = dotted_name(call.func)
+            if name is None:
+                continue
+            term = name.split(".")[-1]
+            if term in c.release_funcs or term in c.release_methods:
+                return True
+        return False
+    if c.mode == "recv":
+        for call in _calls_in(exprs):
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in c.release_methods
+                and dotted_name(call.func.value) == acq.var
+            ):
+                return True
+        return False
+    # var mode
+    var = acq.var
+    assert var is not None
+    if isinstance(stmt, ast.Return) and _name_in(stmt.value, var):
+        return True  # ownership to the caller
+    if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Name):
+        if stmt.value.id == var and in_init:
+            # self.attr = handle inside __init__: the instance owns it
+            if all(isinstance(t, ast.Attribute) for t in stmt.targets):
+                return True
+    for call in _calls_in(exprs):
+        func = call.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in c.release_methods
+            and dotted_name(func.value) == var
+        ):
+            return True
+        name = dotted_name(func)
+        term = name.split(".")[-1] if name else ""
+        if term in c.release_funcs or term in c.transfer_funcs:
+            if any(
+                isinstance(a, ast.Name) and a.id == var for a in call.args
+            ):
+                return True
+        for kw in call.keywords:
+            if (
+                kw.arg in c.transfer_kwargs
+                and isinstance(kw.value, ast.Name)
+                and kw.value.id == var
+            ):
+                return True
+    return False
+
+
+# -- branch pruning ---------------------------------------------------------
+
+
+def _pruned_succs(node: CfgNode, var: Optional[str]) -> Set[int]:
+    """Successors reachable while `var` still holds the (non-None)
+    resource: identity/truth tests on the tracked name make one arm
+    infeasible."""
+    succs = node.succ | node.exc
+    if var is None or node.kind != "branch" or node.true_entry is None:
+        return succs
+    test = node.stmt.test if isinstance(node.stmt, ast.If) else None
+    if test is None:
+        return succs
+    true_when_held: Optional[bool] = None
+    if isinstance(test, ast.Name) and test.id == var:
+        true_when_held = True
+    elif (
+        isinstance(test, ast.UnaryOp)
+        and isinstance(test.op, ast.Not)
+        and isinstance(test.operand, ast.Name)
+        and test.operand.id == var
+    ):
+        true_when_held = False
+    elif (
+        isinstance(test, ast.Compare)
+        and isinstance(test.left, ast.Name)
+        and test.left.id == var
+        and len(test.ops) == 1
+        and len(test.comparators) == 1
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        if isinstance(test.ops[0], ast.Is):
+            true_when_held = False
+        elif isinstance(test.ops[0], ast.IsNot):
+            true_when_held = True
+    if true_when_held is True:
+        return {node.true_entry} | node.exc
+    if true_when_held is False:
+        return succs - {node.true_entry}
+    return succs
+
+
+# -- the dataflow -----------------------------------------------------------
+
+
+@dataclass
+class _Leak:
+    kind: str  # "exit" | "raise"
+    witness: int  # line of the last statement before the escape
+
+
+def _leak_paths(
+    cfg: Cfg, acq: _Acq, ann: _Annotations, in_init: bool
+) -> List[_Leak]:
+    """Forward may-analysis for one acquisition: propagate "may still
+    be held" from the acquisition's NORMAL out-edges (an acquire that
+    raises acquired nothing) until a killing statement settles it
+    (kills apply on both out-edges: the release happens even when the
+    same statement later raises).  A held state reaching exit /
+    raise_exit is a leak."""
+    kill_cache: Dict[int, bool] = {}
+
+    def kills(node: CfgNode) -> bool:
+        if node.nid not in kill_cache:
+            kill_cache[node.nid] = _kills(acq, node, ann, in_init)
+        return kill_cache[node.nid]
+
+    seeds: List[int] = []
+    for node in cfg.stmt_nodes(acq.stmt):
+        if kills(node):
+            # the acquiring statement itself settles it (e.g. an
+            # annotated acquisition line)
+            continue
+        seeds.extend(node.succ)
+
+    var = acq.var if acq.contract.mode == "var" else None
+    visited: Set[int] = set()
+    parent: Dict[int, int] = {}
+    work = list(dict.fromkeys(seeds))
+    leaks: List[_Leak] = []
+    for s in work:
+        parent.setdefault(s, -1)
+    while work:
+        nid = work.pop()
+        if nid in visited:
+            continue
+        visited.add(nid)
+        node = cfg.node(nid)
+        if nid == cfg.exit or nid == cfg.raise_exit:
+            p = parent.get(nid, -1)
+            witness = cfg.node(p).line if p >= 0 else acq.stmt.lineno
+            leaks.append(
+                _Leak("exit" if nid == cfg.exit else "raise", witness)
+            )
+            continue
+        if kills(node):
+            continue
+        for s in _pruned_succs(node, var):
+            if s not in visited:
+                parent.setdefault(s, nid)
+                work.append(s)
+    return leaks
+
+
+# -- the pass ---------------------------------------------------------------
+
+
+def _fn_prefilter(fn: ast.AST, terms: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None and name.split(".")[-1] in terms:
+                return True
+    return False
+
+
+def _resource_classes_decl(
+    module: Module,
+) -> Tuple[Set[str], int]:
+    """Keys of the RESOURCE_CLASSES dict literal + its line."""
+    for node in ast.walk(module.tree):
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not any(
+            isinstance(t, ast.Name) and t.id == "RESOURCE_CLASSES"
+            for t in targets
+        ):
+            continue
+        if isinstance(value, ast.Dict):
+            keys = {
+                k.value
+                for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            return keys, node.lineno
+    return set(), 1
+
+
+class LifecyclePass(Pass):
+    """CFG-based must-release analysis (see module docstring)."""
+
+    name = "lifecycle"
+    rules = ("RES001", "RES002", "RES003", "RES004", "RES005")
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        findings: List[Finding] = []
+        all_terms: Set[str] = set()
+        for c in CONTRACTS:
+            all_terms.update(c.prefilter)
+
+        resources_mod: Optional[Module] = None
+        for module in modules:
+            if module.rel == RESOURCES_MODULE:
+                resources_mod = module
+            findings.extend(self._run_module(module, all_terms))
+
+        findings.extend(self._cross_check(resources_mod))
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return findings
+
+    # -- registry/ledger cross-check (RES005) --------------------------
+
+    def _cross_check(self, resources_mod: Optional[Module]) -> List[Finding]:
+        contracted = {c.resource for c in CONTRACTS}
+        if resources_mod is None:
+            return [
+                Finding(
+                    "RES005",
+                    RESOURCES_MODULE,
+                    1,
+                    "runtime resource ledger module is missing — every "
+                    "contracted resource class needs a ledger entry",
+                )
+            ]
+        declared, line = _resource_classes_decl(resources_mod)
+        out: List[Finding] = []
+        for res in sorted(contracted - declared):
+            out.append(
+                Finding(
+                    "RES005",
+                    resources_mod.rel,
+                    line,
+                    f"resource class {res!r} has a lifecycle contract but "
+                    "no RESOURCE_CLASSES ledger entry — the static pass "
+                    "and the runtime ledger must stay in lockstep",
+                )
+            )
+        for res in sorted(declared - contracted):
+            out.append(
+                Finding(
+                    "RES005",
+                    resources_mod.rel,
+                    line,
+                    f"ledger class {res!r} has no lifecycle contract — "
+                    "delete the entry or add the contract",
+                )
+            )
+        return out
+
+    # -- per-module analysis -------------------------------------------
+
+    def _run_module(
+        self, module: Module, all_terms: Set[str]
+    ) -> List[Finding]:
+        ann = _scan_annotations(module)
+        findings = list(ann.findings)
+        aliases = import_aliases(module.tree)
+        active = [
+            c
+            for c in CONTRACTS
+            if not c.paths or module.rel.startswith(c.paths)
+        ]
+        if active:
+            for qual, fn in iter_functions(module.tree):
+                if not _fn_prefilter(fn, all_terms):
+                    continue
+                findings.extend(
+                    self._run_function(module, qual, fn, active, ann, aliases)
+                )
+        for line in sorted(set(ann.by_line) - ann.consumed):
+            findings.append(
+                Finding(
+                    "RES004",
+                    module.rel,
+                    line,
+                    f"stale `# {ann.by_line[line]}:` annotation — it "
+                    "suppresses no tracked acquisition on any path; "
+                    "delete it (stale escapes rot like stale baselines)",
+                )
+            )
+        return findings
+
+    def _run_function(
+        self,
+        module: Module,
+        qual: str,
+        fn: ast.AST,
+        contracts: Sequence[Contract],
+        ann: _Annotations,
+        aliases: Dict[str, str],
+    ) -> List[Finding]:
+        cfg = build_cfg(fn)
+        in_init = fn.name == "__init__"
+        seen_stmts: Dict[int, ast.stmt] = {}
+        with_stmts: List[ast.stmt] = []
+        for node in cfg.nodes:
+            if node.stmt is not None and isinstance(node.stmt, ast.stmt):
+                seen_stmts.setdefault(id(node.stmt), node.stmt)
+                if node.kind == "with":
+                    with_stmts.append(node.stmt)
+
+        findings: List[Finding] = []
+        emitted: Set[Tuple[str, int, str]] = set()
+        for stmt in seen_stmts.values():
+            for contract in contracts:
+                for acq in self._acquisitions(
+                    contract, stmt, with_stmts, aliases, ann, in_init,
+                    module.rel, qual,
+                ):
+                    if isinstance(acq, Finding):
+                        findings.append(acq)
+                        continue
+                    for leak in _leak_paths(cfg, acq, ann, in_init):
+                        if leak.kind == "exit" and not contract.check_return:
+                            continue
+                        if leak.kind == "raise" and not contract.check_raise:
+                            continue
+                        code = "RES001" if leak.kind == "exit" else "RES002"
+                        key = (code, acq.stmt.lineno, contract.resource)
+                        if key in emitted:
+                            continue
+                        emitted.add(key)
+                        how = (
+                            "reaches normal exit"
+                            if leak.kind == "exit"
+                            else "escapes with an exception"
+                        )
+                        findings.append(
+                            Finding(
+                                code,
+                                module.rel,
+                                acq.stmt.lineno,
+                                f"{contract.resource} acquired by "
+                                f"`{acq.callee}` in {qual}() may leak: a "
+                                f"path {how} (via line {leak.witness}) "
+                                "without release/transfer — release on "
+                                "every path or annotate with "
+                                "# owns:/# releases:/# transfer: <reason>",
+                            )
+                        )
+        return findings
+
+    def _acquisitions(
+        self,
+        contract: Contract,
+        stmt: ast.stmt,
+        with_stmts: Sequence[ast.stmt],
+        aliases: Dict[str, str],
+        ann: _Annotations,
+        in_init: bool,
+        rel: str,
+        qual: str,
+    ):
+        """Yield _Acq trackers and/or immediate Findings for one
+        statement under one contract."""
+        line = getattr(stmt, "lineno", 0)
+
+        def annotated() -> bool:
+            return ann.claim(line) is not None
+
+        if stmt in with_stmts:
+            return  # context manager releases by construction
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return  # ownership to the caller / unwinding anyway
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            call = _matching_call(contract, value, aliases)
+            if call is None:
+                return
+            callee = dotted_name(call.func) or "?"
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if contract.mode == "site":
+                if not annotated():
+                    yield _Acq(contract, stmt, call, None, callee)
+                return
+            if contract.mode == "recv":
+                if not annotated():
+                    recv = dotted_name(call.func.value)  # type: ignore[attr-defined]
+                    yield _Acq(contract, stmt, call, recv, callee)
+                return
+            if names:
+                if annotated():
+                    return
+                yield _Acq(contract, stmt, call, names[0], callee)
+                return
+            # bound only to attributes: the object owns the handle —
+            # provable in __init__, annotation-required elsewhere
+            if in_init or annotated():
+                return
+            yield Finding(
+                "RES001",
+                rel,
+                line,
+                f"{contract.resource} acquired by `{callee}` in {qual}() "
+                "is stored into an attribute outside __init__ without an "
+                "ownership annotation — mark the store with "
+                "# owns: <reason> (who shuts it down?) or keep a local "
+                "handle and release it on every path",
+            )
+        elif isinstance(stmt, ast.Expr):
+            call = _matching_call(contract, stmt.value, aliases)
+            if call is None:
+                return
+            callee = dotted_name(call.func) or "?"
+            if contract.mode == "site":
+                if not annotated():
+                    yield _Acq(contract, stmt, call, None, callee)
+            elif contract.mode == "recv":
+                if not annotated():
+                    recv = dotted_name(call.func.value)  # type: ignore[attr-defined]
+                    yield _Acq(contract, stmt, call, recv, callee)
+            else:
+                if annotated():
+                    return
+                yield Finding(
+                    "RES003",
+                    rel,
+                    line,
+                    f"{contract.resource} acquisition `{callee}` in "
+                    f"{qual}() discards its handle — nothing can ever "
+                    "release this; bind it and release on every path "
+                    "(or annotate with # owns:/# transfer: <reason>)",
+                )

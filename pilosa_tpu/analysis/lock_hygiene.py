@@ -97,6 +97,9 @@ _DEVICE_SYNC_ORIGINS = (
 _ALLOWED_RAW_IN = (
     "pilosa_tpu/utils/locks.py",
     "pilosa_tpu/utils/race.py",
+    # the resource ledger is checker substrate like locks/race: its one
+    # mutex must not feed the lock-order graph it helps to police
+    "pilosa_tpu/utils/resources.py",
 )
 
 # -- LOCK006: dispatch discipline -------------------------------------------

@@ -522,6 +522,7 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         cache_result_mb=cfg.cache.result_mb,
         cache_count_repair=cfg.cache.count_repair,
         import_concurrency=cfg.import_concurrency,
+        max_writes_per_request=cfg.max_writes_per_request,
         resize_transfer_concurrency=cfg.resize.transfer_concurrency,
         resize_cutover_timeout=cfg.resize.cutover_timeout,
         resize_resume_policy=cfg.resize.resume_policy,

@@ -44,8 +44,19 @@ import os
 import time
 from collections import OrderedDict
 from contextlib import contextmanager
-from typing import Callable, Dict, Hashable, Iterable, Optional, Set, Tuple
+from typing import (
+    Callable,
+    Dict,
+    Hashable,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
 
+from pilosa_tpu.utils import resources
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 from pilosa_tpu.utils.race import race_checked
 
@@ -53,9 +64,9 @@ _DEFAULT_BUDGET_MB = 4096
 
 
 def _env_budget_bytes() -> int:
-    mb = os.environ.get("PILOSA_TPU_HBM_BUDGET_MB")
+    raw = os.environ.get("PILOSA_TPU_HBM_BUDGET_MB")
     try:
-        mb = int(mb) if mb else _DEFAULT_BUDGET_MB
+        mb = int(raw) if raw else _DEFAULT_BUDGET_MB
     except ValueError:
         mb = _DEFAULT_BUDGET_MB
     return mb * 1024 * 1024
@@ -73,7 +84,7 @@ def new_owner_token() -> int:
         return _token_next
 
 
-def _nbytes(arr) -> int:
+def _nbytes(arr: object) -> int:
     nb = getattr(arr, "nbytes", None)
     if nb is not None:
         return int(nb)
@@ -112,7 +123,7 @@ class DeviceCache:
         budget_bytes: int | None = None,
         pin_timeout: float = 0.0,  # seconds; 0 = stale-pin reclaim off
         clock: Callable[[], float] = time.monotonic,
-    ):
+    ) -> None:
         self._mu = TrackedLock("devcache.mu")
         # single-flight get_or_build: waiters park here while a peer builds
         self._build_cv = TrackedCondition(self._mu, name="devcache.build_cv")
@@ -175,7 +186,7 @@ class DeviceCache:
 
     # -- core --------------------------------------------------------------
 
-    def get(self, key: Tuple):
+    def get(self, key: Tuple) -> Optional[object]:
         with self._mu:
             arr = self._entries.get(key)
             if arr is not None:
@@ -188,10 +199,10 @@ class DeviceCache:
     def put(
         self,
         key: Tuple,
-        arr,
+        arr: object,
         *,
         extent: bool = False,
-        shards=None,
+        shards: Optional[Iterable[int]] = None,
         index: Optional[str] = None,
     ) -> None:
         nb = _nbytes(arr)
@@ -203,11 +214,11 @@ class DeviceCache:
     def _put_locked(
         self,
         key: Tuple,
-        arr,
+        arr: object,
         nb: int,
         *,
         extent: bool,
-        shards=None,
+        shards: Optional[Iterable[int]] = None,
         index: Optional[str] = None,
     ) -> None:
         if key in self._entries:
@@ -235,9 +246,9 @@ class DeviceCache:
         *,
         extent: bool = False,
         pin: bool = False,
-        shards=None,
+        shards: Optional[Iterable[int]] = None,
         index: Optional[str] = None,
-    ):
+    ) -> object:
         """Return the cached array for `key`, building it at most once
         process-wide even under concurrent callers (single-flight). With
         pin=True the returned entry is pinned under the same lock hold
@@ -340,7 +351,9 @@ class DeviceCache:
                 if self._cover.get(key) is None:
                     self._drop_locked(key)
 
-    def owner_entries(self, owner: Hashable):
+    def owner_entries(
+        self, owner: Hashable
+    ) -> List[Tuple[Tuple, Optional[frozenset], bool]]:
         """Snapshot of one owner's live entries as
         [(key, coverage_or_None, is_extent)] under one lock hold — the
         merge barrier's extent reconciliation walks this to decide
@@ -359,13 +372,16 @@ class DeviceCache:
             self._extent_keys.clear()
             self._cover.clear()
             self._key_index.clear()
+            for key, n in self._pins.items():
+                for _ in range(n):
+                    resources.release("hbm.pin", key)
             self._pins.clear()
             self._pin_t0.clear()
             self._zombies.clear()
             self._bytes = 0
 
     @contextmanager
-    def deferred_eviction(self):
+    def deferred_eviction(self) -> Iterator[None]:
         """Suspend budget eviction for the duration (nestable; settles —
         evicts down to budget — when the outermost session exits). Used
         by the stacked lowering around operand staging; see _defer_evict."""
@@ -395,12 +411,15 @@ class DeviceCache:
         self._pins[key] = n + 1
         if n == 0:
             self._pin_t0[key] = self._clock()
+        resources.acquire("hbm.pin", key)
 
     def unpin(self, key: Tuple) -> None:
         """Release one pin. Unpinning an unknown key is a no-op (the pin
         may have been force-released by the stale-pin safety valve)."""
         with self._mu:
             n = self._pins.get(key, 0)
+            if n >= 1:
+                resources.release("hbm.pin", key)
             if n <= 1:
                 self._pins.pop(key, None)
                 self._pin_t0.pop(key, None)
@@ -431,7 +450,8 @@ class DeviceCache:
         ):
             # leak safety valve: a pin this old is a bug, not a dispatch;
             # force-release it so the budget cannot wedge permanently
-            self._pins.pop(key, None)
+            for _ in range(self._pins.pop(key, 0)):
+                resources.release("hbm.pin", key)
             self._pin_t0.pop(key, None)
             self.stale_pin_reclaims += 1
             return False
@@ -472,7 +492,7 @@ class DeviceCache:
             if not owner_keys:
                 del self._by_owner[key[0]]
 
-    def _evict_locked(self, keep) -> None:
+    def _evict_locked(self, keep: Optional[Tuple]) -> None:
         if self._defer_evict > 0:
             return
         if self._index_quota or self._index_quota_default > 0:
@@ -502,7 +522,7 @@ class DeviceCache:
         q = self._index_quota.get(index)
         return q if q is not None else self._index_quota_default
 
-    def _evict_over_quota_locked(self, keep) -> None:
+    def _evict_over_quota_locked(self, keep: Optional[Tuple]) -> None:
         """Per-index quota pass (LRU order within each owner). Counts
         ZOMBIE bytes against the owner — invalidated-while-pinned device
         memory is genuinely held on that tenant's behalf — but can only
@@ -659,3 +679,22 @@ DEVICE_CACHE = DeviceCache()
 
 def set_budget(budget_bytes: int) -> None:
     DEVICE_CACHE.budget_bytes = budget_bytes
+
+
+def _pin_probe() -> List[str]:
+    """Conftest leak probe (utils/resources.py): every pin staging takes
+    must be released by the plan's dispatch finally or an executor error
+    path. A leaked pin makes its bytes permanently unevictable — the
+    budget wedges a little tighter on every leak. Clears the cache on
+    failure so one leak doesn't cascade into later tests."""
+    snap = DEVICE_CACHE.stats_snapshot()
+    if snap["pinned_bytes"]:
+        DEVICE_CACHE.clear()
+        return [
+            f"device-cache extent pins leaked: {snap['pinned_bytes']} "
+            "bytes still pinned after the test"
+        ]
+    return []
+
+
+resources.register_probe("hbm.pin", _pin_probe)

@@ -29,6 +29,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import jax
 import numpy as np
 
+from pilosa_tpu.utils import resources
 from pilosa_tpu.utils.locks import TrackedRLock
 from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.core import cache as cachemod
@@ -1534,6 +1535,8 @@ class Fragment:
             self._sync_locked()
             buf = io.BytesIO()
             walmod.write_snapshot_stream(buf, self.shard, SHARD_WIDTH, self._rows)
+            if tag not in self._captures:
+                resources.acquire("fragment.capture", (id(self), tag))
             self._captures[tag] = []
             self._capture_ns[tag] = 0
             self._captures_lost.discard(tag)
@@ -1565,10 +1568,14 @@ class Fragment:
         transfer can still depend on a frozen delta."""
         with self._mu:
             if tag is None:
+                for t in self._captures:
+                    resources.release("fragment.capture", (id(self), t))
                 self._captures.clear()
                 self._capture_ns.clear()
                 self._captures_lost.clear()
             else:
+                if tag in self._captures:
+                    resources.release("fragment.capture", (id(self), tag))
                 self._captures.pop(tag, None)
                 self._capture_ns.pop(tag, None)
                 self._captures_lost.discard(tag)
@@ -1612,6 +1619,7 @@ class Fragment:
                 del self._captures[tag]
                 del self._capture_ns[tag]
                 self._captures_lost.add(tag)
+                resources.release("fragment.capture", (id(self), tag))
             else:
                 self._capture_ns[tag] = n
 

@@ -44,7 +44,7 @@ from __future__ import annotations
 
 import copy
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, Hashable, Iterable, Optional, Set, Tuple
 
 import numpy as np
 
@@ -120,8 +120,17 @@ class _Entry:
         "clocks", "maybe_stale", "nbytes",
     )
 
-    def __init__(self, key, kind, index, text, result, vector, repair_row,
-                 clocks=None):
+    def __init__(
+        self,
+        key: tuple,
+        kind: str,
+        index: str,
+        text: str,
+        result: Any,
+        vector: tuple,
+        repair_row: Optional[int],
+        clocks: Optional[tuple] = None,
+    ) -> None:
         self.key = key
         self.kind = kind
         self.index = index
@@ -159,7 +168,7 @@ class ResultCache:
     """LRU byte-budgeted store of versioned query results (one
     process-global instance, RESULT_CACHE, like core/devcache.py)."""
 
-    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES) -> None:
         self._mu = TrackedLock("resultcache.mu")
         self._budget = int(budget_bytes)
         self._repair_enabled = True
@@ -200,10 +209,10 @@ class ResultCache:
 
     def configure(
         self,
-        budget_bytes=_UNSET,
-        repair=_UNSET,
-        tenant_default_bytes=_UNSET,
-        tenant_overrides=_UNSET,
+        budget_bytes: Any = _UNSET,
+        repair: Any = _UNSET,
+        tenant_default_bytes: Any = _UNSET,
+        tenant_overrides: Any = _UNSET,
     ) -> None:
         """Install the server's [cache] knobs (cli/config.py ->
         server/node.py) and the [tenants] per-index cache quotas.
@@ -234,7 +243,9 @@ class ResultCache:
 
     # -- lookup / store -----------------------------------------------------
 
-    def get(self, key, vector, recount: bool = True):
+    def get(
+        self, key: tuple, vector: Optional[tuple], recount: bool = True
+    ) -> Tuple[bool, Any]:
         """(found, result). A hit requires the entry's stored vector to
         EQUAL the caller's freshly collected one — identical fragment
         versions mean identical content, so the stored result is what a
@@ -259,7 +270,9 @@ class ResultCache:
             return True, result
         return True, copy.deepcopy(result)
 
-    def get_by_clock(self, key, clocks):
+    def get_by_clock(
+        self, key: tuple, clocks: Optional[tuple]
+    ) -> Tuple[bool, Any]:
         """(found, result): the O(#views) fast path — serve when the
         caller's freshly read per-view mutation clocks equal the
         entry's. Sound because every fragment-version bump also bumps
@@ -283,7 +296,7 @@ class ResultCache:
             return True, result
         return True, copy.deepcopy(result)
 
-    def refresh_clocks(self, key, clocks) -> None:
+    def refresh_clocks(self, key: tuple, clocks: Optional[tuple]) -> None:
         """Arm the clock fast path after a successful exact-vector
         revalidation. `clocks` MUST have been read before the vector
         the caller just matched — a write landing in between then keeps
@@ -304,7 +317,7 @@ class ResultCache:
         with self._mu:
             self._counters["misses"] += 1
 
-    def repairable(self, key) -> bool:
+    def repairable(self, key: tuple) -> bool:
         """Whether a miss on `key` is worth a repair attempt: a live
         Count entry with a repair row, and repair enabled. The caller
         then runs the read barrier (which fires note_merges) and
@@ -315,7 +328,7 @@ class ResultCache:
             e = self._entries.get(key)
             return e is not None and e.repair_row is not None
 
-    def note_candidate(self, key) -> bool:
+    def note_candidate(self, key: tuple) -> bool:
         """Record a sighting of an RPC-vector key; True when the key was
         already seen (worth paying the version round trips now)."""
         with self._mu:
@@ -331,7 +344,7 @@ class ResultCache:
 
     def put(
         self,
-        key,
+        key: tuple,
         kind: str,
         index: str,
         text: str,
@@ -423,7 +436,7 @@ class ResultCache:
                     if not rows:
                         self._interest.pop(ikey, None)
 
-    def _drop_locked(self, key, evict: bool = False) -> None:
+    def _drop_locked(self, key: tuple, evict: bool = False) -> None:
         e = self._entries.pop(key, None)
         if e is not None:
             self._unindex_locked(e)
@@ -467,7 +480,7 @@ class ResultCache:
         revalidation keeps either choice exact."""
         self.note_mutations(token, (shard,))
 
-    def note_mutations(self, token: int, shards) -> None:
+    def note_mutations(self, token: int, shards: Iterable[int]) -> None:
         with self._mu:
             keys = self._by_token.get(token)
             if not keys:
@@ -493,7 +506,7 @@ class ResultCache:
                     # possible recompute its full device bytes
                     e.maybe_stale = True
 
-    def note_merges(self, token: int, merges) -> None:
+    def note_merges(self, token: int, merges: Iterable[Any]) -> None:
         """The merge barrier just applied staged deltas for fragments of
         the view owning `token` (View.sync_pending). Patch every covered
         repairable Count entry in place — count(new) = count(old) +
@@ -514,7 +527,9 @@ class ResultCache:
                     continue
                 self._apply_merges_locked(e, token, by_shard)
 
-    def _apply_merges_locked(self, e: _Entry, token: int, by_shard) -> None:
+    def _apply_merges_locked(
+        self, e: _Entry, token: int, by_shard: Dict[int, Any]
+    ) -> None:
         new_vector = list(e.vector)
         changed = False
         count = e.result if e.kind == "count" else None
@@ -594,7 +609,7 @@ class ResultCache:
                     self._drop_locked(key)
             self._quota_evictions_index.pop(index, None)
 
-    def drop_scope(self, scope) -> None:
+    def drop_scope(self, scope: Hashable) -> None:
         """Drop every entry keyed under one Index's cache scope (rank
         cache recalculation: TopN order can change with no version
         bump)."""
@@ -630,7 +645,7 @@ class ResultCache:
 
     # -- introspection ------------------------------------------------------
 
-    def has_text(self, scope, text: str) -> bool:
+    def has_text(self, scope: Optional[Hashable], text: str) -> bool:
         """Whether a HIT-LIKELY entry is stored for (scope, text) — the
         admission cost estimator's probe (sched/cost.py). Cheap by
         design (no version walk), but entries that observed a covered

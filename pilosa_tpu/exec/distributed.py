@@ -100,10 +100,20 @@ class DistributedExecutor(Executor):
         pools (HTTP handler threads share this executor)."""
         with self._pool_mu:
             if self._pool is None:
+                # owns: released by close() from NodeServer.stop()
                 self._pool = ThreadPoolExecutor(
                     max_workers=16, thread_name_prefix=f"fanout-{self.local_id}"
                 )
             return self._pool
+
+    def close(self) -> None:
+        """Release the lazy fan-out pool. NodeServer.stop() calls this;
+        before it did, every server start/stop cycle stranded up to 16
+        idle fanout-* threads for the life of the process."""
+        with self._pool_mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # ------------------------------------------------------------------
     # fan-out plumbing

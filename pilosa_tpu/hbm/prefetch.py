@@ -36,7 +36,11 @@ from pilosa_tpu.utils.race import race_checked
     "dropped",
 ))
 class Prefetcher:
-    def __init__(self, depth: int = 4, logger: Optional[Callable] = None):
+    def __init__(
+        self,
+        depth: int = 4,
+        logger: Optional[Callable[[str], None]] = None,
+    ) -> None:
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1")
         self.depth = depth

@@ -31,7 +31,7 @@ from __future__ import annotations
 import os
 import threading
 from contextlib import contextmanager
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
 
 from pilosa_tpu.core.devcache import DEVICE_CACHE
 from pilosa_tpu.utils import tracing
@@ -70,7 +70,7 @@ _counters: Dict[str, int] = {
 # an index); dropped by drop_index() when the index is deleted so a
 # churning tenant set cannot leak counter entries
 _restage_by_index: Dict[str, int] = {}
-_prefetched_keys: set = set()
+_prefetched_keys: Set[Tuple] = set()
 
 _tls = threading.local()
 
@@ -148,7 +148,7 @@ def note_extent_patch(batches: int = 0) -> None:
 
 
 @contextmanager
-def prefetching():
+def prefetching() -> Iterator[None]:
     """Mark this thread as the prefetch worker: extents it stages are
     remembered, and a later query hit on one counts as a prefetch hit."""
     _tls.active = True
@@ -243,7 +243,7 @@ def _stage(
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
     parts: bool = False,
-):
+) -> object:
     """Assemble one device operand from per-extent cache entries.
 
     build_slice(lo, hi) -> host ndarray covering shard positions [lo, hi)
@@ -288,7 +288,7 @@ def _stage_inner(
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
     parts: bool = False,
-):
+) -> object:
     import jax
 
     from pilosa_tpu.parallel import mesh as pmesh
@@ -301,7 +301,7 @@ def _stage_inner(
         built: List[bool] = []
         key = key_base if versions is None else key_base + ("mono", versions)
 
-        def build_all():
+        def build_all() -> object:
             built.append(True)
             arr = pmesh.put_stack(build_slice(0, n_shards))
             return arr
@@ -310,10 +310,17 @@ def _stage_inner(
             key, build_all, extent=True, pin=True, shards=shards,
             index=index,
         )
-        _note_upload(
-            int(getattr(arr, "nbytes", 0)), key, bool(built), index=index
-        )
+        try:
+            _note_upload(
+                int(getattr(arr, "nbytes", 0)), key, bool(built), index=index
+            )
+        except BaseException:
+            # accounting must not leak the pin: an unpinned failure
+            # leaves the entry evictable instead of wedged forever
+            DEVICE_CACHE.unpin(key)
+            raise
         if table is not None:
+            # transfer: pin moves to the caller's ExtentTable.release()
             table.add([key])
         else:
             DEVICE_CACHE.unpin(key)
@@ -335,7 +342,7 @@ def _stage_inner(
     # pass-1 pins on extents the loop has not reached yet): a build
     # failure mid-loop must release all of them, not just the visited ones
     held: List[Tuple] = [k for k, r in zip(keys, resident) if r]
-    out_parts = []
+    out_parts: List[object] = []
     try:
         for (lo, hi), key, was_resident in zip(spans, keys, resident):
             arr = None
@@ -352,9 +359,13 @@ def _stage_inner(
                         int(getattr(arr, "nbytes", 0)), key, built=False
                     )
             if arr is None:
-                built = []
+                freshly_built: List[bool] = []
 
-                def build(lo=lo, hi=hi, built=built):
+                def build(
+                    lo: int = lo,
+                    hi: int = hi,
+                    built: List[bool] = freshly_built,
+                ) -> object:
                     built.append(True)
                     return jax.device_put(build_slice(lo, hi))
 
@@ -365,7 +376,7 @@ def _stage_inner(
                 )
                 held.append(key)
                 _note_upload(
-                    int(getattr(arr, "nbytes", 0)), key, bool(built),
+                    int(getattr(arr, "nbytes", 0)), key, bool(freshly_built),
                     index=index,
                 )
             out_parts.append(arr)
@@ -373,16 +384,22 @@ def _stage_inner(
         DEVICE_CACHE.unpin_all(held)
         raise
     if table is not None:
+        # transfer: pins move to the caller's ExtentTable.release()
         table.add(held)
-    if parts:
-        assembled = tuple(out_parts)
-    else:
-        assembled = (
-            out_parts[0]
-            if len(out_parts) == 1
-            else jax.numpy.concatenate(out_parts, axis=shard_axis)
-        )
-    if table is None:
+        held = []
+    try:
+        if parts:
+            assembled = tuple(out_parts)
+        else:
+            assembled = (
+                out_parts[0]
+                if len(out_parts) == 1
+                else jax.numpy.concatenate(out_parts, axis=shard_axis)
+            )
+    finally:
+        # tableless callers keep their pins only for the assembly
+        # itself — released even when concatenate raises (an OOM here
+        # used to strand every staged extent pinned)
         DEVICE_CACHE.unpin_all(held)
     return assembled
 
@@ -396,7 +413,7 @@ def stage_row_stack(
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
     parts: bool = False,
-):
+) -> object:
     """uint32[S, W] operand: extents slice axis 0 (the shard axis).
     `index` attributes the staged bytes to their owning index for the
     per-tenant residency/restage telemetry; `parts` skips assembly and
@@ -416,7 +433,7 @@ def stage_plane_stack(
     shards: Optional[Tuple[int, ...]] = None,
     index: Optional[str] = None,
     parts: bool = False,
-):
+) -> object:
     """uint32[D, S, W] operand: extents slice axis 1; every extent carries
     all D planes for its shard range (one slice pages the whole magnitude
     ladder for those shards together — they are always used together).

@@ -45,6 +45,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from pilosa_tpu.sched.cost import QueryCost, ZERO_COST
 from pilosa_tpu.sched.tenants import TenantPolicy
+from pilosa_tpu.utils import resources
 from pilosa_tpu.utils.locks import TrackedCondition, TrackedLock
 from pilosa_tpu.utils.race import race_checked
 from pilosa_tpu.utils.stats import Histogram
@@ -157,11 +158,13 @@ class Ticket:
         self.granted_at = granted_at  # controller-clock time of the grant
         self.leg = leg  # internal fan-out leg (separate admission lane)
         self.waited = waited  # seconds spent queued before the grant
+        resources.acquire("sched.ticket", id(self))
 
     def release(self) -> None:
         if self._released:
             return
         self._released = True
+        resources.release("sched.ticket", id(self))
         self._controller._release(self)
 
     def done_batching(self) -> None:
@@ -1195,3 +1198,20 @@ class AdmissionController:
             self.stats.with_tags(f"index:{idx}").gauge(
                 "sched.index_inflight_bytes", v
             )
+
+
+def _idle_probe() -> List[str]:
+    """Conftest leak probe (utils/resources.py): every live controller
+    must be idle between tests — a shed or finished query that leaves a
+    queue entry or a held concurrency slot behind would starve every
+    later query on that node."""
+    leaked = leaked_state()
+    if leaked:
+        return [
+            "admission controller(s) left non-idle (id, queued, inflight): "
+            f"{leaked}"
+        ]
+    return []
+
+
+resources.register_probe("sched.ticket", _idle_probe)

@@ -255,6 +255,7 @@ class TenantPolicy:
             now = self._clock()
             qb, bb = self._buckets_locked(index, lim)
             if qb is not None:
+                # owns: charge window is pure arithmetic; refill heals it
                 wait = qb.take(1.0, now)
                 if wait > 0.0:
                     return QuotaDenial(
@@ -267,6 +268,7 @@ class TenantPolicy:
                 # same single-oversized-entry rule the byte budget and
                 # devcache apply — otherwise that query could NEVER run
                 need = min(float(device_bytes), bb.burst)
+                # owns: charge window is pure arithmetic; refill heals it
                 wait = bb.take(need, now)
                 if wait > 0.0:
                     if qb is not None:

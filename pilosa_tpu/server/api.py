@@ -89,6 +89,18 @@ class API:
     def cluster(self):
         return self.server.cluster
 
+    def _check_write_count(self, n: int) -> None:
+        """Reject an import larger than max-writes-per-request (-> HTTP
+        400, reference http/handler.go maxWritesPerRequest): one huge
+        request would hold the import pool and the WAL group-commit
+        window hostage; clients are expected to batch."""
+        limit = getattr(self.server, "max_writes_per_request", 0)
+        if limit and n > limit:
+            raise ApiError(
+                f"import of {n} writes exceeds max-writes-per-request "
+                f"({limit}); split the request into smaller batches"
+            )
+
     def _validate(self, method: str, write: bool = False) -> None:
         state = self.server.state
         if state == STATE_NORMAL:
@@ -568,6 +580,8 @@ class API:
         import time as _time
 
         self._validate("import_bits", write=True)
+        if not local_only:  # replica frames are slices of a capped request
+            self._check_write_count(len(cols))
         idx, f = self._index_field(index, field)
         rows, cols = self._translate_import(idx, f, rows, cols)
         stats = self.server.stats.with_tags(f"index:{index}")
@@ -750,6 +764,8 @@ class API:
         import time as _time
 
         self._validate("import_values", write=True)
+        if not local_only:  # replica frames are slices of a capped request
+            self._check_write_count(len(cols))
         idx, f = self._index_field(index, field)
         _, cols = self._translate_import(idx, f, None, cols)
         values = np.asarray(values, dtype=np.int64)

@@ -35,6 +35,7 @@ import time
 import urllib.error
 from typing import Callable, Dict, List, Optional, Tuple
 
+from pilosa_tpu.utils import resources
 from pilosa_tpu.utils.locks import TrackedLock
 from pilosa_tpu.core import wal as walmod
 
@@ -570,6 +571,8 @@ _global_breakers: Optional[BreakerRegistry] = None
 def install_injector(inj: FaultInjector) -> None:
     global _global_injector
     with _global_mu:
+        if _global_injector is None:
+            resources.acquire("fault.plane", "FaultInjector")
         _global_injector = inj
     # the process-wide install also arms the durable-write-path hooks
     # (core/wal.py cannot import the server layer, so the injector is
@@ -580,6 +583,8 @@ def install_injector(inj: FaultInjector) -> None:
 def uninstall_injector() -> None:
     global _global_injector
     with _global_mu:
+        if _global_injector is not None:
+            resources.release("fault.plane", "FaultInjector")
         _global_injector = None
     walmod.set_fault_hook(None)
 
@@ -591,14 +596,41 @@ def global_injector() -> Optional[FaultInjector]:
 def install_breakers(reg: BreakerRegistry) -> None:
     global _global_breakers
     with _global_mu:
+        if _global_breakers is None:
+            resources.acquire("fault.plane", "BreakerRegistry")
         _global_breakers = reg
 
 
 def uninstall_breakers() -> None:
     global _global_breakers
     with _global_mu:
+        if _global_breakers is not None:
+            resources.release("fault.plane", "BreakerRegistry")
         _global_breakers = None
 
 
 def global_breakers() -> Optional[BreakerRegistry]:
     return _global_breakers
+
+
+def _fault_plane_probe() -> List[str]:
+    """Conftest leak probe (utils/resources.py): a test that installs a
+    process-global FaultInjector or BreakerRegistry and forgets to
+    uninstall it would silently poison every later test's internode
+    traffic — uninstall and fail loudly instead."""
+    leaked = []
+    if global_injector() is not None:
+        uninstall_injector()
+        leaked.append("FaultInjector")
+    if global_breakers() is not None:
+        uninstall_breakers()
+        leaked.append("BreakerRegistry")
+    if leaked:
+        return [
+            f"test left a global {' and '.join(leaked)} installed "
+            "(faults.uninstall_injector()/uninstall_breakers() missing)"
+        ]
+    return []
+
+
+resources.register_probe("fault.plane", _fault_plane_probe)

@@ -109,6 +109,7 @@ class NodeServer:
         cache_result_mb: int = 64,  # result-cache LRU budget, MB; 0 disables
         cache_count_repair: bool = True,  # in-place Count repair on bursts
         import_concurrency: int = 8,  # parallel replica-import RPCs per call
+        max_writes_per_request: int = 5000,  # bits/values per import; 0 = no cap
         resize_transfer_concurrency: int = 4,  # parallel fragment fetches
         resize_cutover_timeout: float = 30.0,  # catch-up barrier bound, s
         resize_resume_policy: str = "resume",  # resume|abort on failed leg
@@ -297,6 +298,7 @@ class NodeServer:
         # of one serial HTTP round-trip per shard. Threads spawn lazily,
         # so an idle pool costs nothing.
         self.import_concurrency = max(1, int(import_concurrency))
+        self.max_writes_per_request = max(0, int(max_writes_per_request))
         self._import_pool = None
         self._import_pool_mu = TrackedLock("node.import_pool_mu")
         # separate SMALL pool for the routing step (argsort/split): the
@@ -858,6 +860,7 @@ class NodeServer:
             if self._import_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
+                # owns: stop() swaps the pool out and shuts it down
                 self._import_pool = ThreadPoolExecutor(
                     max_workers=self.import_concurrency,
                     thread_name_prefix="pilosa-tpu-import",
@@ -874,6 +877,7 @@ class NodeServer:
             if self._route_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
+                # owns: stop() swaps the pool out and shuts it down
                 self._route_pool = ThreadPoolExecutor(
                     max_workers=min(4, self.import_concurrency),
                     thread_name_prefix="pilosa-tpu-route",
@@ -902,6 +906,7 @@ class NodeServer:
             pool.shutdown(wait=False)
         if rpool is not None:
             rpool.shutdown(wait=False)
+        self.executor.close()  # lazy fan-out pool (see DistributedExecutor)
         if self.prefetcher is not None:
             self.prefetcher.stop()  # joins the warm worker before teardown
         if self._httpd is not None:
@@ -1678,13 +1683,21 @@ class NodeServer:
         same source fragment never steal each other's records). Returns
         the snapshot blob."""
         blob = frag.begin_streaming(tag)
-        now = time.monotonic()
-        with self._transfer_mu:
-            self._sweep_captures_locked(now)
-            self._transfer_captures[(tag,) + tuple(key)] = {
-                "frag": frag,
-                "expires": now + CAPTURE_LEASE,
-            }
+        try:
+            now = time.monotonic()
+            with self._transfer_mu:
+                self._sweep_captures_locked(now)
+                # transfer: lease table owns it (sweep expires, drain ends)
+                self._transfer_captures[(tag,) + tuple(key)] = {
+                    "frag": frag,
+                    "expires": now + CAPTURE_LEASE,
+                }
+        except BaseException:
+            # a capture armed but never registered has no lease — nothing
+            # would ever drain or expire it, and it buffers every write
+            # to the fragment until overflow; disarm before propagating
+            frag.end_capture(tag)
+            raise
         return blob
 
     def drain_fragment_capture(self, tag: str, key: tuple) -> bytes:

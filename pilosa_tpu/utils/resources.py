@@ -1,0 +1,236 @@
+"""Unified resource-leak ledger (runtime half of the lifecycle gate).
+
+Every resource class the static must-release pass knows about
+(pilosa_tpu/analysis/lifecycle.py, rules RES001-RES005) is declared
+here, in RESOURCE_CLASSES.  The two registries cross-check each other:
+RES005 fails the gate when a contract exists without a ledger entry or
+a ledger entry exists without a contract, so neither side can drift.
+
+Under PILOSA_TPU_RESOURCE_CHECK=1 every instrumented acquire/release
+records a balance per resource class plus the acquiring stack, and the
+single autouse conftest guard fails any test that ends with a nonzero
+balance — printing the stack of the acquisition that leaked.  With the
+flag unset (the default, and plain tier-1) acquire/release are
+early-return no-ops: zero overhead on hot paths, exactly the
+LOCK_CHECK/RACE_CHECK pattern (utils/locks.py, utils/race.py).
+
+Independent of the flag, subsystems may register *probes*: always-on
+live-state checks run between tests (admission idle-ness, devcache
+pinned bytes, fault-plane installs).  These carry the exact failure
+semantics of the three pre-unification conftest guards, including
+their cleanup side effects, so a leak in one test cannot cascade into
+the next.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Callable, Dict, Hashable, List, Optional, Tuple
+
+__all__ = [
+    "RESOURCE_CLASSES",
+    "enabled",
+    "enable",
+    "disable",
+    "acquire",
+    "release",
+    "balance",
+    "balances",
+    "outstanding",
+    "drain",
+    "register_probe",
+    "probes",
+    "check_and_reset",
+]
+
+# One entry per resource class the static pass enforces.  Keys are the
+# contract names in analysis/lifecycle.py; values document what a unit
+# of the resource is and what releasing it means.  "static-only"
+# classes have no runtime instrumentation (their acquire is invisible
+# at runtime or already guarded elsewhere) but still must be declared
+# so RES005 keeps the two registries in lockstep.
+RESOURCE_CLASSES: Dict[str, str] = {
+    "sched.ticket": (
+        "admission grant: one concurrency slot + the query's device-byte "
+        "weight, held until Ticket.release()"
+    ),
+    "hbm.pin": (
+        "device-cache pin refcount on one extent/operand key; pinned bytes "
+        "are unevictable until unpin/unpin_all/release_extents"
+    ),
+    "fragment.capture": (
+        "armed live-transfer write capture (begin_streaming tag), buffering "
+        "every mutation until end_capture or overflow"
+    ),
+    "fault.plane": (
+        "process-global FaultInjector/BreakerRegistry install; poisons all "
+        "internode traffic until uninstalled"
+    ),
+    "wal.token": (
+        "static-only: group-commit position from wal.append/append_many; a "
+        "write is not acked until wait_durable(token)"
+    ),
+    "tenant.charge": (
+        "static-only: tenant token-bucket charge (qb/bb.take); a denied "
+        "admission must refund what the earlier bucket granted"
+    ),
+    "runtime.pool": (
+        "static-only: ThreadPoolExecutor / non-daemon Thread; must be "
+        "shutdown/joined or owned by an annotated attribute"
+    ),
+    "lock.manual": (
+        "static-only: tracked lock acquired outside `with`; must reach "
+        ".release() on every path"
+    ),
+}
+
+_STACK_LIMIT = 16
+
+_enabled = os.environ.get("PILOSA_TPU_RESOURCE_CHECK", "") == "1"
+
+# Raw (untracked) mutex on purpose: the ledger is checker substrate —
+# it must not feed the lock-order graph it helps to police, and it
+# never calls out while held.  See _ALLOWED_RAW_IN in lock_hygiene.
+_mu = threading.Lock()
+
+# cls -> token -> stack of formatted acquisition tracebacks (a token
+# acquired twice, e.g. a pin refcount, carries one stack per hold)
+_outstanding: Dict[str, Dict[Hashable, List[str]]] = {}
+
+_probes: Dict[str, Callable[[], List[str]]] = {}
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Turn balance recording on (tests)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _stack() -> str:
+    return "".join(traceback.format_stack(limit=_STACK_LIMIT)[:-2])
+
+
+def acquire(cls: str, token: Hashable = None) -> None:
+    """Record one acquisition of `cls` (no-op unless enabled)."""
+    if not _enabled:
+        return
+    stack = _stack()
+    with _mu:
+        _outstanding.setdefault(cls, {}).setdefault(token, []).append(stack)
+
+
+def release(cls: str, token: Hashable = None) -> None:
+    """Record one release (no-op unless enabled).  Releasing a token
+    with no recorded acquisition is ignored rather than driven
+    negative: the acquire may predate enable(), and idempotent release
+    paths (Ticket.release, ExtentTable.release) call through here at
+    most once by construction."""
+    if not _enabled:
+        return
+    with _mu:
+        per = _outstanding.get(cls)
+        if per is None:
+            return
+        stacks = per.get(token)
+        if not stacks:
+            return
+        stacks.pop()
+        if not stacks:
+            del per[token]
+        if not per:
+            del _outstanding[cls]
+
+
+def balance(cls: str) -> int:
+    """Outstanding acquisitions of one class."""
+    with _mu:
+        per = _outstanding.get(cls, {})
+        return sum(len(stacks) for stacks in per.values())
+
+
+def balances() -> Dict[str, int]:
+    """Nonzero balances by class."""
+    with _mu:
+        return {
+            cls: sum(len(stacks) for stacks in per.values())
+            for cls, per in _outstanding.items()
+            if per
+        }
+
+
+def outstanding(cls: Optional[str] = None) -> List[Tuple[str, Hashable, str]]:
+    """(cls, token, acquisition stack) for every outstanding hold."""
+    out: List[Tuple[str, Hashable, str]] = []
+    with _mu:
+        for c, per in _outstanding.items():
+            if cls is not None and c != cls:
+                continue
+            for token, stacks in per.items():
+                for stack in stacks:
+                    out.append((c, token, stack))
+    return out
+
+
+def drain() -> Dict[str, int]:
+    """Clear all recorded state, returning what the balances were.
+    Tests that seed leaks on purpose drain() before returning."""
+    with _mu:
+        out = {
+            cls: sum(len(stacks) for stacks in per.values())
+            for cls, per in _outstanding.items()
+            if per
+        }
+        _outstanding.clear()
+        return out
+
+
+def register_probe(cls: str, probe: Callable[[], List[str]]) -> None:
+    """Register an always-on live-state probe for a resource class.
+    Probes run on every check_and_reset() regardless of the env flag;
+    each returns a list of failure messages (empty = healthy) and may
+    clean up leaked state so one failure cannot cascade into later
+    tests.  Re-registration replaces (module reload in tests)."""
+    if cls not in RESOURCE_CLASSES:
+        raise ValueError(f"probe for undeclared resource class {cls!r}")
+    _probes[cls] = probe
+
+
+def probes() -> Dict[str, Callable[[], List[str]]]:
+    return dict(_probes)
+
+
+def check_and_reset() -> List[str]:
+    """The conftest guard: run every probe, then (when enabled) report
+    and clear any nonzero recorded balance with the leaked acquisition
+    stacks.  Returns failure messages; empty means healthy."""
+    failures: List[str] = []
+    for cls in sorted(_probes):
+        failures.extend(_probes[cls]())
+    if not _enabled:
+        return failures
+    with _mu:
+        for cls in sorted(_outstanding):
+            per = _outstanding[cls]
+            n = sum(len(stacks) for stacks in per.values())
+            if not n:
+                continue
+            # one representative stack is enough to find the leak;
+            # every hold of every token is counted in the balance
+            token, stacks = next(iter(per.items()))
+            failures.append(
+                f"resource ledger imbalance: {cls} balance={n} "
+                f"(first leaked token {token!r}, acquired at):\n{stacks[0]}"
+            )
+        _outstanding.clear()
+    return failures

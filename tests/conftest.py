@@ -78,38 +78,37 @@ def _race_guard():
 
 
 @pytest.fixture(autouse=True)
-def _sched_leak_guard():
-    """State-leak guard for admission control: every AdmissionController
-    alive after a test must be idle — a shed or finished query that
-    leaves a queue entry or a held concurrency slot behind would starve
-    every later query on that node."""
+def _resource_leak_guard():
+    """Unified state-leak guard (utils/resources.py). Two layers:
+
+    - always-on probes, one per runtime-guarded resource class, with the
+      exact semantics of the three guards this fixture replaced: every
+      live AdmissionController must be idle (a leaked queue entry or
+      held slot starves every later query), device-cache pinned bytes
+      must be zero (a leaked pin is permanently unevictable; the cache
+      is cleared on failure so one leak doesn't cascade), and no
+      process-global FaultInjector/BreakerRegistry may remain installed
+      (uninstalled on failure for the same reason);
+    - under PILOSA_TPU_RESOURCE_CHECK=1, per-class acquire/release
+      balances — any nonzero balance fails the test with the leaked
+      acquisition's stack. The dedicated CI job runs the concurrency
+      subset with it; plain tier-1 pays zero overhead.
+    """
     yield
-    from pilosa_tpu.sched import admission
+    # importing here (not at conftest top) keeps collection light and
+    # matches the replaced guards' lazy-import timing; each import
+    # registers that subsystem's probe with the ledger
+    from pilosa_tpu.core import devcache  # noqa: F401
+    from pilosa_tpu.sched import admission  # noqa: F401
+    from pilosa_tpu.server import faults  # noqa: F401
+    from pilosa_tpu.utils import resources
 
-    leaked = admission.leaked_state()
-    if leaked:
+    failures = resources.check_and_reset()
+    if failures:
         pytest.fail(
-            "admission controller(s) left non-idle (id, queued, inflight): "
-            f"{leaked}"
-        )
-
-
-@pytest.fixture(autouse=True)
-def _hbm_pin_leak_guard():
-    """State-leak guard for HBM extent pins (pilosa_tpu/hbm/): every pin
-    staging takes must be released by the plan's dispatch finally or an
-    executor error path. A leaked pin makes its bytes permanently
-    unevictable — the budget wedges a little tighter on every leak."""
-    yield
-    from pilosa_tpu.core.devcache import DEVICE_CACHE
-
-    snap = DEVICE_CACHE.stats_snapshot()
-    if snap["pinned_bytes"]:
-        # clean up so one leak doesn't cascade into later tests
-        DEVICE_CACHE.clear()
-        pytest.fail(
-            f"device-cache extent pins leaked: {snap['pinned_bytes']} "
-            "bytes still pinned after the test"
+            f"resource leak(s) detected ({len(failures)}):\n"
+            + "\n\n".join(failures),
+            pytrace=False,
         )
 
 
@@ -128,29 +127,6 @@ def _result_cache_isolation():
     resultcache.RESULT_CACHE.configure(
         budget_bytes=resultcache.DEFAULT_BUDGET_BYTES, repair=True
     )
-
-
-@pytest.fixture(autouse=True)
-def _fault_plane_leak_guard():
-    """State-leak guard: a test that installs a process-global
-    FaultInjector or BreakerRegistry (faults.install_injector /
-    install_breakers) and forgets to uninstall it would silently poison
-    every later test's internode traffic — fail loudly instead."""
-    yield
-    from pilosa_tpu.server import faults
-
-    leaked = []
-    if faults.global_injector() is not None:
-        faults.uninstall_injector()
-        leaked.append("FaultInjector")
-    if faults.global_breakers() is not None:
-        faults.uninstall_breakers()
-        leaked.append("BreakerRegistry")
-    if leaked:
-        pytest.fail(
-            f"test left a global {' and '.join(leaked)} installed "
-            "(faults.uninstall_injector()/uninstall_breakers() missing)"
-        )
 
 
 def pytest_configure(config):

@@ -1,0 +1,386 @@
+"""Resource-lifecycle machinery: CFG shapes, the runtime ledger, and
+regression tests for the leaks the static pass found.
+
+Three halves of the same gate (docs/development.md "Resource ownership
+contracts"):
+
+1. analysis/cfg.py — the statement-level CFG the must-release pass
+   walks. Each test pins one control-flow shape's edge structure
+   (finally clones, exception edges, loop exits, with-unwind), because
+   a missing edge silently turns a real leak into a clean report.
+2. utils/resources.py — the runtime ledger behind the autouse conftest
+   guard: balances + acquisition stacks under PILOSA_TPU_RESOURCE_CHECK,
+   always-on probes, cheap passthrough otherwise.
+3. The error-path leak fixes themselves (hbm/residency.py staging pins,
+   server/node.py capture lease registration, exec/distributed.py
+   fan-out pool), each exercised through its real failure injection.
+
+Rule-level seeded-violation coverage for RES001-RES005 lives in
+test_static_analysis.py.
+"""
+
+import ast
+import textwrap
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.analysis.cfg import build_cfg
+from pilosa_tpu.core.devcache import DEVICE_CACHE
+from pilosa_tpu.core.fragment import Fragment, TransferCaptureLost
+from pilosa_tpu.hbm import residency as hbm_res
+from pilosa_tpu.parallel import mesh as pmesh
+from pilosa_tpu.server.node import NodeServer
+from pilosa_tpu.utils import resources
+
+# ---------------------------------------------------------------------------
+# CFG shapes
+# ---------------------------------------------------------------------------
+
+
+def fn_cfg(src: str):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef))
+    return build_cfg(fn)
+
+
+def reach(cfg, start: int) -> set:
+    """Node ids reachable from `start` over succ+exc edges."""
+    seen, work = {start}, [start]
+    while work:
+        for m in cfg.node(work.pop()).edges():
+            if m not in seen:
+                seen.add(m)
+                work.append(m)
+    return seen
+
+
+def lines(cfg, nids) -> set:
+    return {cfg.node(n).line for n in nids}
+
+
+def node_at(cfg, line: int, kind: str = None):
+    hits = [
+        n
+        for n in cfg.nodes
+        if n.line == line and (kind is None or n.kind == kind)
+    ]
+    assert hits, f"no node at line {line} (kind={kind})"
+    return hits[0]
+
+
+class TestCfgShapes:
+    def test_try_finally_runs_on_normal_and_raise_paths(self):
+        cfg = fn_cfg(
+            """
+            def f(work, cleanup):
+                try:
+                    work()
+                finally:
+                    cleanup()
+            """
+        )
+        # the finally body is cloned per unwind kind: the cleanup()
+        # statement appears in more than one node
+        cleanups = [n for n in cfg.nodes if n.line == 6 and n.kind == "stmt"]
+        assert len(cleanups) >= 2
+        # the raising path out of work() goes THROUGH a cleanup clone
+        work = node_at(cfg, 4, "stmt")
+        assert work.exc, "work() must have an exception edge"
+        assert all(cfg.node(t).line == 6 for t in work.exc)
+        # both terminals are reachable, each via a cleanup node
+        assert cfg.exit in reach(cfg, cfg.entry)
+        assert cfg.raise_exit in reach(cfg, cfg.entry)
+
+    def test_except_edge_catch_all_stops_escape(self):
+        cfg = fn_cfg(
+            """
+            def f(work):
+                try:
+                    work()
+                except BaseException:
+                    x = 1
+            """
+        )
+        # the only raiser is caught by a catch-all: no escape at all
+        assert cfg.raise_exit not in reach(cfg, cfg.entry)
+
+    def test_except_edge_narrow_handler_still_escapes(self):
+        cfg = fn_cfg(
+            """
+            def f(work):
+                try:
+                    work()
+                except ValueError:
+                    x = 1
+            """
+        )
+        work = node_at(cfg, 4, "stmt")
+        assert work.exc
+        # a ValueError handler doesn't catch everything: the dispatch
+        # keeps an escape route to the raise exit
+        escape = reach(cfg, next(iter(work.exc)))
+        assert cfg.raise_exit in escape
+        # ... and the handler body is also reachable from the dispatch
+        assert 6 in lines(cfg, escape)
+
+    def test_loop_break_jumps_past_the_body(self):
+        cfg = fn_cfg(
+            """
+            def f(xs, body, tail):
+                for x in xs:
+                    if x:
+                        break
+                    body()
+                tail()
+            """
+        )
+        brk = next(
+            n for n in cfg.nodes if isinstance(n.stmt, ast.Break)
+        )
+        after = reach(cfg, brk.nid)
+        assert 7 in lines(cfg, after)  # tail() runs
+        assert 6 not in lines(cfg, after)  # body() skipped
+        # break exits through the loop's join node, not the loop head
+        assert all(cfg.node(t).kind == "loop_exit" for t in brk.succ)
+
+    def test_early_return_goes_straight_to_exit(self):
+        cfg = fn_cfg(
+            """
+            def f(flag, rest):
+                if flag:
+                    return 1
+                rest()
+            """
+        )
+        ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+        assert ret.succ == {cfg.exit}
+        assert 5 not in lines(cfg, reach(cfg, ret.nid))
+
+    def test_with_unwinds_through_exit_on_raise(self):
+        cfg = fn_cfg(
+            """
+            def f(cm, work, tail):
+                with cm() as h:
+                    work()
+                tail()
+            """
+        )
+        work = node_at(cfg, 4, "stmt")
+        assert work.exc
+        # the exception edge lands on a with_exit clone (__exit__ runs),
+        # and from there only the raise exit is reachable — not tail()
+        for t in work.exc:
+            assert cfg.node(t).kind == "with_exit"
+            unwound = reach(cfg, t)
+            assert cfg.raise_exit in unwound
+            assert 5 not in lines(cfg, unwound)
+        # the normal path still goes through a (different) with_exit
+        normal = reach(cfg, next(iter(work.succ)))
+        assert 5 in lines(cfg, normal)
+
+    def test_identity_test_has_no_exception_edge(self):
+        cfg = fn_cfg(
+            """
+            def f(x):
+                if x is not None:
+                    x.close()
+            """
+        )
+        assert not node_at(cfg, 3, "branch").exc
+
+    def test_equality_test_does_have_an_exception_edge(self):
+        cfg = fn_cfg(
+            """
+            def f(x):
+                if x == 0:
+                    return 1
+            """
+        )
+        assert node_at(cfg, 3, "branch").exc
+
+
+# ---------------------------------------------------------------------------
+# the runtime ledger
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ledger():
+    """Ledger enabled for the test, restored (and drained) after — the
+    autouse conftest guard must see a clean slate either way."""
+    was = resources.enabled()
+    resources.drain()
+    resources.enable()
+    yield resources
+    resources.drain()
+    if not was:
+        resources.disable()
+
+
+class TestResourceLedger:
+    def test_balance_round_trip(self, ledger):
+        ledger.acquire("hbm.pin", ("k", 1))
+        ledger.acquire("hbm.pin", ("k", 1))  # refcount: two holds, one token
+        ledger.acquire("hbm.pin", ("k", 2))
+        assert ledger.balance("hbm.pin") == 3
+        ledger.release("hbm.pin", ("k", 1))
+        assert ledger.balance("hbm.pin") == 2
+        ledger.release("hbm.pin", ("k", 1))
+        ledger.release("hbm.pin", ("k", 2))
+        assert ledger.balance("hbm.pin") == 0
+        assert ledger.balances() == {}
+
+    def test_unmatched_release_is_ignored_not_negative(self, ledger):
+        ledger.release("hbm.pin", ("never", "acquired"))
+        assert ledger.balance("hbm.pin") == 0
+        ledger.acquire("hbm.pin", "t")
+        ledger.release("hbm.pin", "t")
+        ledger.release("hbm.pin", "t")  # idempotent second release
+        assert ledger.balance("hbm.pin") == 0
+
+    def test_outstanding_carries_acquisition_stacks(self, ledger):
+        ledger.acquire("sched.ticket", 42)
+        ((cls, token, stack),) = ledger.outstanding("sched.ticket")
+        assert (cls, token) == ("sched.ticket", 42)
+        # the stack points at THIS test, not at the ledger internals
+        assert "test_outstanding_carries_acquisition_stacks" in stack
+        ledger.release("sched.ticket", 42)
+
+    def test_check_and_reset_reports_then_clears(self, ledger):
+        ledger.acquire("fragment.capture", "tag")
+        failures = ledger.check_and_reset()
+        assert any(
+            "fragment.capture" in f and "balance=1" in f for f in failures
+        ), failures
+        assert ledger.balances() == {}  # reported leaks are cleared
+        assert not [
+            f for f in ledger.check_and_reset() if "imbalance" in f
+        ]
+
+    def test_disabled_ledger_records_nothing(self):
+        was = resources.enabled()
+        resources.disable()
+        try:
+            resources.acquire("hbm.pin", "cheap")
+            assert resources.balance("hbm.pin") == 0
+            assert resources.outstanding() == []
+        finally:
+            if was:
+                resources.enable()
+
+    def test_probe_for_undeclared_class_rejected(self):
+        with pytest.raises(ValueError):
+            resources.register_probe("not.a.class", lambda: [])
+
+    def test_probes_run_even_when_disabled(self):
+        was = resources.enabled()
+        resources.disable()
+        resources.register_probe("runtime.pool", lambda: ["pool probe hit"])
+        try:
+            assert "pool probe hit" in resources.check_and_reset()
+        finally:
+            resources._probes.pop("runtime.pool", None)
+            if was:
+                resources.enable()
+
+    def test_static_contracts_match_ledger_registry(self):
+        # RES005 in miniature: the import-time registries really are in
+        # lockstep (the gate test covers the parsed-source version)
+        from pilosa_tpu.analysis.lifecycle import CONTRACTS
+
+        assert {c.resource for c in CONTRACTS} == set(
+            resources.RESOURCE_CLASSES
+        )
+
+
+# ---------------------------------------------------------------------------
+# the leaks the pass found (regression: each via its real failure path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def staging_env():
+    """Single-device staging with clean cache state, like test_hbm's
+    paging_env but scoped to the leak regressions."""
+    old_mesh = pmesh.active_mesh()
+    pmesh.set_active_mesh(None)
+    old_rows = hbm_res.extent_rows()
+    DEVICE_CACHE.clear()
+    hbm_res.reset_stats()
+    yield
+    hbm_res.configure(extent_rows=old_rows)
+    DEVICE_CACHE.clear()
+    hbm_res.reset_stats()
+    pmesh.set_active_mesh(old_mesh)
+
+
+class TestLeakRegressions:
+    def test_monolithic_stage_unpins_when_accounting_raises(
+        self, staging_env, monkeypatch
+    ):
+        """residency._stage_inner (monolithic): a raise in _note_upload
+        used to leave the freshly built entry pinned forever."""
+        hbm_res.configure(extent_rows=0)  # force the monolithic path
+
+        def boom(*a, **k):
+            raise RuntimeError("accounting exploded")
+
+        monkeypatch.setattr(hbm_res, "_note_upload", boom)
+        build = lambda lo, hi: np.zeros((hi - lo, 8), np.uint32)  # noqa: E731
+        with pytest.raises(RuntimeError, match="accounting exploded"):
+            hbm_res.stage_row_stack(("leak", "mono"), 2, build)
+        assert DEVICE_CACHE.pinned_bytes == 0
+
+    def test_extent_stage_unpins_when_assembly_raises(self, staging_env):
+        """residency._stage_inner (multi-extent): a raise in the final
+        concatenate used to strand every staged extent pinned when no
+        ExtentTable was passed."""
+        hbm_res.configure(extent_rows=1)
+
+        def ragged(lo, hi):
+            # per-extent widths differ -> concatenate along axis 0 fails
+            return np.zeros((hi - lo, 8 + lo), np.uint32)
+
+        with pytest.raises((ValueError, TypeError)):
+            hbm_res.stage_row_stack(("leak", "ragged"), 2, ragged)
+        assert DEVICE_CACHE.pinned_bytes == 0
+
+    def test_capture_disarmed_when_lease_registration_fails(
+        self, monkeypatch
+    ):
+        """node.begin_fragment_capture: a raise between arming the
+        capture and registering its lease used to leave the capture
+        buffering writes forever — no lease to expire it, no entry to
+        drain it."""
+        srv = NodeServer(None, "capreg-leak-test")
+        try:
+            frag = Fragment(None, "i", "f", "standard", 0).open()
+
+            def boom(now):
+                raise RuntimeError("sweep exploded")
+
+            monkeypatch.setattr(srv, "_sweep_captures_locked", boom)
+            with pytest.raises(RuntimeError, match="sweep exploded"):
+                srv.begin_fragment_capture(
+                    "j:dest", ("i", "f", "standard", 0), frag
+                )
+            assert srv._transfer_captures == {}
+            with pytest.raises(TransferCaptureLost):
+                frag.drain_capture("j:dest")  # disarmed, not buffering
+        finally:
+            srv.stop()
+
+    def test_node_stop_closes_the_fanout_pool(self):
+        """DistributedExecutor: the lazy fan-out pool used to outlive
+        its server — every start/stop cycle stranded idle threads."""
+        srv = NodeServer(None, "poolclose-test")
+        try:
+            pool = srv.executor._fanout_pool()
+            assert srv.executor._pool is pool
+        finally:
+            srv.stop()
+        assert srv.executor._pool is None
+        with pytest.raises(RuntimeError):
+            pool.submit(print)  # shut down: rejects new work
+        srv.executor.close()  # idempotent

@@ -552,3 +552,30 @@ def test_devcache_counters_exported_on_metrics_and_debug_vars():
             assert key in dbg, key
         # a query ran: the cache saw at least one lookup
         assert dbg["devcache.hits"] + dbg["devcache.misses"] > 0
+
+
+def test_import_rejects_oversized_write_request():
+    """max-writes-per-request (cli/config.py) is enforced at the API
+    import boundary: an oversized request is a 400-class ApiError, not
+    a pool-hogging mega-import. Internal replica frames (local_only)
+    are slices of an already-capped request and stay exempt."""
+    from pilosa_tpu.server.api import ApiError
+
+    from pilosa_tpu.server.node import NodeServer
+
+    srv = NodeServer(None, "maxwrites", max_writes_per_request=8)
+    try:
+        srv.api.create_index("mw")
+        srv.api.create_field("mw", "f", {"type": "set"})
+        cols = list(range(9))
+        with pytest.raises(ApiError, match="max-writes-per-request"):
+            srv.api.import_bits("mw", "f", [0] * 9, cols)
+        with pytest.raises(ApiError, match="max-writes-per-request"):
+            srv.api.import_values("mw", "f", cols, list(range(9)))
+        # at the cap is fine; the internal replica path ignores the cap
+        srv.api.import_bits("mw", "f", [0] * 8, cols[:8])
+        srv.api.import_bits("mw", "f", [0] * 9, cols, local_only=True)
+        (cnt,) = srv.api.query("mw", "Count(Row(f=0))")
+        assert cnt == 9
+    finally:
+        srv.stop()

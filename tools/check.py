@@ -104,8 +104,12 @@ def main(argv: List[str] | None = None) -> int:
                 "pilosa_tpu/analysis",
                 "pilosa_tpu/utils/locks.py",
                 "pilosa_tpu/utils/race.py",
+                "pilosa_tpu/utils/resources.py",
                 "pilosa_tpu/sched",
                 "pilosa_tpu/core/wal.py",
+                "pilosa_tpu/core/devcache.py",
+                "pilosa_tpu/core/resultcache.py",
+                "pilosa_tpu/hbm",
             ],
         )
     rc |= run_ast_passes(baseline=not args.no_baseline)
