@@ -240,6 +240,102 @@ def replicated_bench(seconds=None, writers=8, sync_interval=0.0):
         shutil.rmtree(base, ignore_errors=True)
 
 
+def tier_bench():
+    """Tiered-storage families (ISSUE 18): demote throughput, cold
+    first-query hydration latency, and a beyond-RAM run — corpus bigger
+    than the configured host budget, hot working set served from local
+    fragments at unchanged latency while the cold rest lives in the
+    store. The store is a LocalDirStore behind a SlowStoreWrapper (5 ms
+    per op), modeling a same-region object store's round trip rather
+    than pretending local-disk numbers are remote numbers."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW
+    from pilosa_tpu.tier import TierManager, TierPolicy
+    from pilosa_tpu.tier.store import LocalDirStore, SlowStoreWrapper
+
+    n_shards = 16
+    n_rows = 4
+    hot = list(range(4))  # the working set the budget must keep local
+    rng = np.random.default_rng(21)
+    base = tempfile.mkdtemp(prefix="pilosa-benchtier-")
+    try:
+        h = Holder(os.path.join(base, "data")).open()
+        idx = h.create_index_if_not_exists("tb")
+        f = idx.create_field_if_not_exists("f", FieldOptions())
+        for s in range(n_shards):
+            for row in range(n_rows):
+                f.import_row_words(
+                    row, s,
+                    rng.integers(0, 2**32, WORDS_PER_ROW, dtype=np.uint32),
+                )
+        v = f.views["standard"]
+        for fr in v.fragments.values():
+            fr.snapshot()
+        store = SlowStoreWrapper(
+            LocalDirStore(os.path.join(base, "store")), 0.005
+        )
+        tier = TierManager(store, TierPolicy("cold"), h,
+                           fetch_concurrency=4)
+
+        def hot_read():
+            for s in hot:
+                v.fragments[s].row_positions(1)
+
+        hot_ms_baseline = _median_ms(hot_read, 5)
+
+        # demote throughput: serialize + upload (2 slow puts each) +
+        # capture-drain check + local delete, all 16 fragments
+        frags = [v.fragments[s] for s in sorted(v.fragments)]
+        t0 = time.perf_counter()
+        for fr in frags:
+            assert tier.demote_fragment(v, fr)
+        demote_s = time.perf_counter() - t0
+        demote_bytes = tier.counters()["demote_bytes"]
+        local_total = demote_bytes  # snapshots mirror local bytes here
+
+        # cold first-query latency: each shard's FIRST read pays one
+        # verified store fetch + adopt (single-flight); median per shard
+        lat = []
+        for s in range(n_shards):
+            t0 = time.perf_counter()
+            tier.hydrate(v, s)
+            lat.append((time.perf_counter() - t0) * 1000)
+        lat.sort()
+
+        # beyond-RAM: budget ~1/3 of the corpus; the hot subset is
+        # touched last so LRU budget pressure demotes the cold rest
+        for s in range(n_shards):
+            if s not in hot:
+                tier.touch_many(v, (s,))
+        tier.touch_many(v, hot)
+        tier.host_budget_bytes = local_total // 3
+        cold_n = tier.demote_tick()
+        assert cold_n >= n_shards // 2, cold_n  # corpus really > budget
+        for s in hot:
+            assert s in v.fragments, s  # working set stayed local
+        hot_ms_under_budget = _median_ms(hot_read, 5)
+        h.close()
+        return {
+            "tier_demote_mbps": round(demote_bytes / demote_s / 1e6, 1),
+            "tier_hydrate_cold_query_ms": round(lat[len(lat) // 2], 3),
+            "tier_hydrate_cold_query_p95_ms": round(
+                lat[int(len(lat) * 0.95)], 3
+            ),
+            "tier_corpus_bytes": int(local_total),
+            "tier_beyond_budget_cold_fragments": int(cold_n),
+            "tier_hot_query_ms_baseline": round(hot_ms_baseline, 3),
+            "tier_hot_query_ms_under_budget": round(hot_ms_under_budget, 3),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main():
     os.environ.setdefault("PILOSA_TPU_HBM_BUDGET_MB", "16384")
     # bigger tally tiles at bench scale: fewer filtered-TopN chunk dispatches
@@ -1049,6 +1145,13 @@ def main():
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         replicated = {"replicated_error": f"{type(e).__name__}: {e}"[:200]}
 
+    # tiered storage (ISSUE 18): demote throughput, cold-query hydration
+    # latency, beyond-budget serving — against a slow-wrapped local store
+    try:
+        tier_metrics = tier_bench()
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        tier_metrics = {"tier_error": f"{type(e).__name__}: {e}"[:200]}
+
     # config 5 stand-in: virtual-mesh scaling curve in a CPU subprocess
     # (hermetic from the TPU tunnel; same env recipe as tests/conftest.py)
     env = dict(os.environ)
@@ -1160,6 +1263,7 @@ def main():
                     "patch_cascade_patches": patch_cascade_patches,
                     "patch_cascade_batches": patch_cascade_batches,
                     **replicated,
+                    **tier_metrics,
                     "timeq_range_ms": round(timeq_range_ms, 3),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),

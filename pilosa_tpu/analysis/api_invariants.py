@@ -76,6 +76,7 @@ _SECTION_CLASSES = {
     "MeshConfig": "mesh",
     "CacheConfig": "cache",
     "ResizeConfig": "resize",
+    "TierConfig": "tier",
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",
     "TracingConfig": "tracing",

@@ -157,6 +157,24 @@ class ResizeConfig:
 
 
 @dataclass
+class TierConfig:
+    # tiered storage (pilosa_tpu/tier/; docs/configuration.md "Tiered
+    # storage"): idle fragments demote to immutable snapshot objects in
+    # a shared object store (upload strictly before local delete) and
+    # hydrate on demand through the batch admission lane — datasets
+    # larger than host RAM + local disk stay queryable, and joining
+    # nodes bootstrap from stored snapshots instead of peer-streaming
+    # every byte. "" store-path disables the whole plane.
+    store_path: str = ""  # shared object-store directory; "" = tier off
+    placement: str = "hot"  # default placement: hot | warm | cold
+    # per-index placement overrides, "index:placement=cold" entries
+    overrides: List[str] = field(default_factory=list)
+    demote_after: float = 300.0  # idle seconds before a cold-placement demote
+    host_budget_bytes: int = 0  # local snap+wal byte budget; 0 = unlimited
+    fetch_concurrency: int = 4  # concurrent store transfers per node
+
+
+@dataclass
 class AntiEntropyConfig:
     interval: float = 0.0  # seconds; 0 disables the loop
 
@@ -228,6 +246,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
     resize: ResizeConfig = field(default_factory=ResizeConfig)
+    tier: TierConfig = field(default_factory=TierConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -310,6 +329,7 @@ class Config:
             ("mesh", self.mesh),
             ("cache", self.cache),
             ("resize", self.resize),
+            ("tier", self.tier),
             ("anti-entropy", self.anti_entropy),
             ("metric", self.metric),
             ("tracing", self.tracing),

@@ -267,6 +267,39 @@ def _build_parser() -> argparse.ArgumentParser:
         "immediately",
     )
     sp.add_argument(
+        "--tier-store-path",
+        help="shared object-store directory for tiered storage — idle "
+        "fragments demote to immutable snapshot objects there and "
+        "hydrate on demand (empty disables the tier plane)",
+    )
+    sp.add_argument(
+        "--tier-placement", choices=["hot", "warm", "cold"],
+        help="default fragment placement: hot (host + device), warm "
+        "(host only, device residency shed when idle), cold (demoted "
+        "to the object store when idle)",
+    )
+    sp.add_argument(
+        "--tier-overrides", nargs="*",
+        help="per-index placement overrides, one entry per index: "
+        "'idx:placement=cold'",
+    )
+    sp.add_argument(
+        "--tier-demote-after", type=float,
+        help="idle seconds before a cold-placement fragment demotes to "
+        "the object store",
+    )
+    sp.add_argument(
+        "--tier-host-budget-bytes", type=int,
+        help="local snapshot+WAL byte budget; beyond it the tier ticker "
+        "demotes least-recently-used fragments regardless of idle time "
+        "(0 = unlimited)",
+    )
+    sp.add_argument(
+        "--tier-fetch-concurrency", type=int,
+        help="concurrent object-store transfers per node (demote "
+        "uploads + hydration fetches share the bound)",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -363,6 +396,12 @@ _FLAG_KNOBS = {
     "resize_transfer_concurrency": ("resize", "transfer_concurrency"),
     "resize_cutover_timeout": ("resize", "cutover_timeout"),
     "resize_resume_policy": ("resize", "resume_policy"),
+    "tier_store_path": ("tier", "store_path"),
+    "tier_placement": ("tier", "placement"),
+    "tier_overrides": ("tier", "overrides"),
+    "tier_demote_after": ("tier", "demote_after"),
+    "tier_host_budget_bytes": ("tier", "host_budget_bytes"),
+    "tier_fetch_concurrency": ("tier", "fetch_concurrency"),
     "anti_entropy_interval": ("anti_entropy", "interval"),
     "metric_service": ("metric", "service"),
     "metric_host": ("metric", "host"),
@@ -526,6 +565,12 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         resize_transfer_concurrency=cfg.resize.transfer_concurrency,
         resize_cutover_timeout=cfg.resize.cutover_timeout,
         resize_resume_policy=cfg.resize.resume_policy,
+        tier_store_path=os.path.expanduser(cfg.tier.store_path) if cfg.tier.store_path else "",
+        tier_placement=cfg.tier.placement,
+        tier_overrides=cfg.tier.overrides,
+        tier_demote_after=cfg.tier.demote_after,
+        tier_host_budget_bytes=cfg.tier.host_budget_bytes,
+        tier_fetch_concurrency=cfg.tier.fetch_concurrency,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

@@ -1542,6 +1542,28 @@ class Fragment:
             self._captures_lost.discard(tag)
             return buf.getvalue()
 
+    def begin_capture_if_version(self, tag: str, version: int) -> bool:
+        """Arm a `tag` write capture WITHOUT serializing, iff the
+        fragment is still at `version` — the tier's snapshot-bootstrap
+        offer path: the destination fetches the already-uploaded
+        snapshot object (taken at `version`) from the object store, so
+        object + capture is exact only if nothing mutated since the
+        currency check. The version re-check and the arming share one
+        lock hold, which is what closes that race; on False the caller
+        falls back to classic peer streaming."""
+        with self._mu:
+            if self.version != version:
+                return False
+            self._sync_locked()
+            if self.version != version:
+                return False  # the sync itself merged a staged delta
+            if tag not in self._captures:
+                resources.acquire("fragment.capture", (id(self), tag))
+            self._captures[tag] = []
+            self._capture_ns[tag] = 0
+            self._captures_lost.discard(tag)
+            return True
+
     def drain_capture(self, tag: str = "default") -> bytes:
         """Phase 2: pop one tag's captured write records as one WAL-framed
         byte stream (the read barrier — concurrent writers to THIS

@@ -78,6 +78,12 @@ class View:
         # either patches them in place to the merged version or drops
         # them (sync_pending -> _reconcile_extents)
         self._dirty_staged: set = set()
+        # tiered storage (pilosa_tpu/tier/): when set, shards missing
+        # from `fragments` may be COLD — demoted to the object store —
+        # and every lookup that would treat absence as emptiness must
+        # consult the resolver first (resolve() hydrates on demand,
+        # single-flight). None = tier disabled, zero overhead.
+        self.cold_resolver = None
 
     def open(self) -> "View":
         """Load existing fragments from disk (view.go:120 openFragments)."""
@@ -114,6 +120,19 @@ class View:
         CreateFragmentIfNotExists)."""
         with self._mu:
             frag = self.fragments.get(shard)
+        if frag is not None:
+            return frag
+        res = self.cold_resolver
+        if res is not None:
+            # the shard may be demoted: creating a fresh empty fragment
+            # here would SHADOW the stored snapshot and lose it on the
+            # next hydrate — resolve (and possibly fetch) outside the
+            # view lock, since hydration blocks on store I/O
+            frag = res.resolve(self, shard)
+            if frag is not None:
+                return frag
+        with self._mu:
+            frag = self.fragments.get(shard)
             if frag is None:
                 frag = Fragment(
                     self._fragment_path(shard),
@@ -145,9 +164,23 @@ class View:
             self.mutation_clock += 1
         DEVICE_CACHE.invalidate_owner_shard(self._stack_token, shard)
         RESULT_CACHE.note_mutation(self._stack_token, shard)
+        res = self.cold_resolver
+        if res is not None:
+            # writes count as activity for the tier's LRU demote clock —
+            # a write-hot fragment must never look idle to the ticker
+            res.touch_many(self, (shard,))
 
     def fragment_if_exists(self, shard: int) -> Optional[Fragment]:
-        return self.fragments.get(shard)
+        frag = self.fragments.get(shard)
+        if frag is not None:
+            return frag
+        res = self.cold_resolver
+        if res is not None:
+            # "exists" includes cold: a demoted fragment still HAS the
+            # data (in the object store) — hydrate rather than report
+            # absence, which reads as zeros to every caller
+            return res.resolve(self, shard)
+        return None
 
     def delete_fragment(self, shard: int) -> bool:
         """Drop one shard's fragment: close it, delete its on-disk files
@@ -170,7 +203,93 @@ class View:
 
     def available_shards(self) -> List[int]:
         with self._mu:
-            return sorted(self.fragments)
+            shards = set(self.fragments)
+        res = self.cold_resolver
+        if res is not None:
+            # cold shards are still AVAILABLE — they hydrate on access;
+            # omitting them would silently shrink every query's shard
+            # span the moment a fragment demotes
+            shards |= res.cold_shards(self)
+        return sorted(shards)
+
+    def evict_fragment(self, shard: int, end_capture_tag=None) -> bool:
+        """Tier demotion eviction: detach + close + delete the local
+        files of a shard whose snapshot object is already DURABLE in the
+        tier store. Unlike delete_fragment the data still exists (cold),
+        so only this shard's device entries drop — version-keyed stack
+        extents and cached results covering OTHER shards stay exact, and
+        the result cache is untouched (content is unchanged, so serving
+        a covering cached result remains correct)."""
+        with self._mu:
+            frag = self.fragments.pop(shard, None)
+        if frag is None:
+            return False
+        if end_capture_tag is not None:
+            # ends the demote capture AFTER detach: the lifted write
+            # barrier exposes nothing — new lookups resolve through the
+            # cold set, and stragglers holding this ref get 503 retries
+            # until the barrier TTL, whose retry hydrates
+            frag.end_capture(end_capture_tag)
+        frag.close()  # frees the fragment's own device-cache residency
+        # deletion order is load-bearing: the .snap goes LAST so a crash
+        # mid-eviction leaves either a complete local fragment or
+        # nothing — never a bare artifact that would reopen as an empty
+        # shadow of the stored object
+        for p in (frag.wal_path, frag.cache_path, frag.snap_path):
+            if p is not None:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        DEVICE_CACHE.invalidate_owner_shard(self._stack_token, shard)
+        return True
+
+    def adopt_fragment(self, shard: int, blob: bytes,
+                       on_ready=None) -> Fragment:
+        """Tier hydration target: materialize a demoted fragment from
+        its snapshot object (`to_bytes` output). Any retained WAL tail —
+        a crash between a hydration's local snapshot and its WAL
+        truncate can leave one — replays AFTER the snapshot applies (its
+        records postdate the upload by construction), so it is collected
+        up front; left in place, open() would replay it UNDER the
+        from_bytes replacement and lose it.
+
+        The fragment is PUBLISHED (inserted into `fragments`) only after
+        its contents are complete and `on_ready` ran — callers hold no
+        other reference, so `on_ready` (the tier's bootstrap-watch
+        capture arming) observes a state no write can have moved yet."""
+        path = self._fragment_path(shard)
+        tail: list = []
+        if path is not None and os.path.exists(path + ".wal"):
+            tail = list(walmod.replay_wal(path + ".wal"))
+            os.remove(path + ".wal")
+        frag = Fragment(
+            path,
+            self.index,
+            self.field,
+            self.name,
+            shard,
+            mutex=self.mutex,
+            max_op_n=self.max_op_n,
+            cache_type=self.cache_type,
+            cache_size=self.cache_size,
+        ).open()
+        frag.from_bytes(blob)
+        if tail:
+            frag.apply_transfer_records(walmod.encode_records(tail))
+        if on_ready is not None:
+            on_ready(frag)
+        with self._mu:
+            existing = self.fragments.get(shard)
+            if existing is not None:
+                # lost a (single-flight-guarded, so unexpected) race:
+                # the published fragment wins; ours was never visible
+                frag.end_capture(None)
+                frag.close()
+                return existing
+            frag.on_mutate = lambda s=shard: self._on_fragment_mutate(s)
+            self.fragments[shard] = frag
+        return frag
 
     # -- stacked operands for the compiled query path ----------------------
     #
@@ -183,6 +302,26 @@ class View:
     # miss and the affected slices rebuild lazily. Callers on the compiled
     # query path pass their lowering's ExtentTable so the staged extents
     # stay pinned through the plan's dispatch.
+
+    def _frags_for(self, shards: tuple) -> list:
+        """Fragment list for a shard span, hydrating any COLD member
+        through the tier resolver (single-flight; a missing shard with
+        no cold copy stays None and reads as zeros, as before). Also
+        feeds the tier's LRU touch clock so hot working sets never look
+        idle to the demote ticker."""
+        with self._mu:
+            frags = [self.fragments.get(s) for s in shards]
+        res = self.cold_resolver
+        if res is not None:
+            if any(f is None for f in frags):
+                cold = res.cold_shards(self)
+                for i, s in enumerate(shards):
+                    if frags[i] is None and s in cold:
+                        frags[i] = res.resolve(self, s)
+            res.touch_many(
+                self, [s for s, f in zip(shards, frags) if f is not None]
+            )
+        return frags
 
     def _stack_key(self, kind: str, ident, shards: tuple) -> tuple:
         # fragment versions are NOT part of the base key: staging appends
@@ -423,8 +562,7 @@ class View:
         from pilosa_tpu.hbm import residency as hbm_res
 
         shards = tuple(shards)
-        with self._mu:
-            frags = [self.fragments.get(s) for s in shards]
+        frags = self._frags_for(shards)
         if all(f is None for f in frags):
             return None
         # merge the staged burst (all touched fragments, one pass) and
@@ -513,8 +651,7 @@ class View:
 
         row_ids = tuple(row_ids)
         shards = tuple(shards)
-        with self._mu:
-            frags = [self.fragments.get(s) for s in shards]
+        frags = self._frags_for(shards)
         if all(f is None for f in frags):
             return None
         self.sync_pending(frags=frags)
@@ -565,7 +702,10 @@ class View:
         """Absolute columns of a row across all shards (host; for exports)."""
         cols = []
         for shard in self.available_shards():
-            p = self.fragments[shard].row_positions(row_id)
+            frag = self.fragment_if_exists(shard)  # hydrates cold shards
+            if frag is None:
+                continue
+            p = frag.row_positions(row_id)
             if len(p):
                 cols.append(p.astype(np.uint64) + np.uint64(shard) * np.uint64(SHARD_WIDTH))
         return np.concatenate(cols) if cols else np.empty(0, np.uint64)

@@ -137,6 +137,15 @@ def stats_snapshot() -> Dict[str, int]:
         }
 
 
+def eviction_pressure() -> int:
+    """Cumulative extent-eviction bytes the device cache has shed — the
+    tier plane's demotion-pressure signal (tier/manager.py demote_tick):
+    growth between ticks means the working set exceeds the device
+    budget, so idle cold-placement fragments demote at half their idle
+    threshold instead of waiting out the full clock."""
+    return int(DEVICE_CACHE.stats_snapshot().get("evicted_extent_bytes", 0))
+
+
 def note_extent_patch(batches: int = 0) -> None:
     """Book one in-place device-side extent patch (core/view.py
     _patch_entry): a write that kept its covering extent resident.

@@ -125,6 +125,19 @@ class QueryCost:
 ZERO_COST = QueryCost()
 
 
+def hydrate_cost(nbytes: int) -> QueryCost:
+    """Admission cost of one tier hydration (pilosa_tpu/tier/): the
+    object fetch is a DCN-class transfer of the snapshot object, not a
+    device staging — no device bytes, one 'sweep' to weigh it in the
+    batch lane, and the transport bill priced like a cross-group leg so
+    deadline feasibility accounts for the fetch latency."""
+    return QueryCost(
+        device_bytes=0,
+        sweeps=1,
+        transport_ms=collective_ms(max(0, int(nbytes)), "dcn"),
+    )
+
+
 def _bsi_planes(idx: Any, field_name: Optional[str]) -> int:
     """Row-stack equivalents a BSI reference to `field_name` holds at
     PEAK: the plane-streamed lowering (exec/bsistream.py) stages and

@@ -723,6 +723,28 @@ class InternalClient:
             query["capture"] = capture
         return self._do("GET", uri, "/internal/fragment/data", query=query)
 
+    def tier_offer(
+        self, uri: str, index: str, field: str, view: str, shard: int, tag: str
+    ) -> dict:
+        """Ask a source node whether one transfer leg can ride the
+        shared object store instead of peer byte-streaming (snapshot
+        bootstrap). The source arms its capture / hydration watch
+        before answering, so a "cold"/"snapshot" reply plus the offered
+        object plus subsequent fragment_delta drains is exact. 404 on
+        pre-tier peers — the caller falls back to streaming."""
+        return self._json(
+            "GET",
+            uri,
+            "/internal/tier/offer",
+            query={
+                "index": index,
+                "field": field,
+                "view": view,
+                "shard": shard,
+                "tag": tag,
+            },
+        ) or {}
+
     # -- translate replication (http/translator.go:44) ---------------------
 
     def available_shards(self, uri: str, index: str) -> Dict[str, List[int]]:

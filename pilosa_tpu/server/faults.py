@@ -344,6 +344,18 @@ class _WalRule:
         self.skip = skip
 
 
+class _StoreRule:
+    __slots__ = ("kind", "point", "key", "times", "delay", "skip")
+
+    def __init__(self, kind, point, key, times, delay, skip):
+        self.kind = kind
+        self.point = point  # prefix match on the store fault point name
+        self.key = key  # substring match on the object key
+        self.times = times
+        self.delay = delay
+        self.skip = skip
+
+
 class FaultInjector:
     """Deterministic chaos: rules match (uri prefix, path prefix) and fire
     either unconditionally, a fixed number of `times`, or with seeded
@@ -392,6 +404,7 @@ class FaultInjector:
         self._sleep = sleep
         self._rules: List[_Rule] = []
         self._wal_rules: List[_WalRule] = []
+        self._store_rules: List[_StoreRule] = []
         self.injected: Dict[str, int] = {}
 
     # -- rule management ---------------------------------------------------
@@ -439,6 +452,34 @@ class FaultInjector:
             )
         return self
 
+    def add_store_rule(
+        self,
+        kind: str,
+        point: Optional[str] = None,
+        key: Optional[str] = None,
+        times: Optional[int] = None,
+        delay: float = 0.0,
+        skip: int = 0,
+    ) -> "FaultInjector":
+        """Arm an object-store fault (ISSUE 18 satellite): `point`
+        prefix-matches the tier store fault point ("store.put",
+        "store.get", "store.head", "store.list", "store.delete", plus
+        the TierManager protocol windows "tier.demote.pre_delete" /
+        "tier.hydrate.pre_apply"), `key` substring-matches the object
+        key. Kinds: "error" (StoreError — the demote aborts / the fetch
+        fails loudly), "slow" (sleep `delay` then proceed),
+        "torn-object" (the store persists/returns truncated bytes —
+        checksum verification must catch it), "missing-object" (the
+        object is gone), "kill" (SIGKILL at the exact point — the
+        demote/hydrate crash-kill matrix)."""
+        if kind not in ("error", "slow", "torn-object", "missing-object", "kill"):
+            raise ValueError(f"unknown store fault kind {kind!r}")
+        with self._mu:
+            self._store_rules.append(
+                _StoreRule(kind, point, key, times, delay, skip)
+            )
+        return self
+
     def partition(self, uri: str) -> "FaultInjector":
         """Cut this client off from `uri` entirely (one-directional, the
         client side of a network partition)."""
@@ -452,6 +493,7 @@ class FaultInjector:
             if uri is None:
                 self._rules = []
                 self._wal_rules = []
+                self._store_rules = []
                 return
             key = uri.rstrip("/")
             self._rules = [
@@ -558,6 +600,48 @@ class FaultInjector:
         if fire == "short-write":
             raise walmod.ShortWriteFault(f"[injected] short write ({point})")
 
+    def on_store(self, point: str, key: str = "") -> Optional[str]:
+        """The tier/store.py fault hook (installed process-wide by
+        `install_injector`): called at every object-store fault point.
+        Raises StoreError, sleeps, SIGKILLs, or returns a directive the
+        store honors ("torn" / "missing")."""
+        from pilosa_tpu.tier.store import StoreError
+
+        delay = 0.0
+        fire: Optional[str] = None
+        with self._mu:
+            for r in self._store_rules:
+                if r.point is not None and not point.startswith(r.point):
+                    continue
+                if r.key is not None and r.key not in key:
+                    continue
+                if r.times is not None and r.times <= 0:
+                    continue
+                if r.skip > 0:
+                    r.skip -= 1
+                    continue
+                if r.times is not None:
+                    r.times -= 1
+                self.injected[r.kind] = self.injected.get(r.kind, 0) + 1
+                if r.kind == "slow":
+                    delay = max(delay, r.delay)
+                    continue
+                fire = r.kind
+                break
+        if delay > 0:
+            self._sleep(delay)
+        if fire is None:
+            return None
+        if fire == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if fire == "error":
+            raise StoreError(f"[injected] store error ({point}: {key})")
+        if fire == "torn-object":
+            return "torn"
+        if fire == "missing-object":
+            return "missing"
+        return None
+
 
 # ---------------------------------------------------------------------------
 # process-wide installs (tests); the conftest leak-guard checks these
@@ -574,10 +658,13 @@ def install_injector(inj: FaultInjector) -> None:
         if _global_injector is None:
             resources.acquire("fault.plane", "FaultInjector")
         _global_injector = inj
-    # the process-wide install also arms the durable-write-path hooks
-    # (core/wal.py cannot import the server layer, so the injector is
-    # pushed down rather than pulled up)
+    # the process-wide install also arms the durable-write-path and
+    # object-store hooks (core/wal.py and tier/store.py cannot import
+    # the server layer, so the injector is pushed down, not pulled up)
     walmod.set_fault_hook(inj.on_wal)
+    from pilosa_tpu.tier import store as tier_store
+
+    tier_store.set_fault_hook(inj.on_store)
 
 
 def uninstall_injector() -> None:
@@ -587,6 +674,9 @@ def uninstall_injector() -> None:
             resources.release("fault.plane", "FaultInjector")
         _global_injector = None
     walmod.set_fault_hook(None)
+    from pilosa_tpu.tier import store as tier_store
+
+    tier_store.set_fault_hook(None)
 
 
 def global_injector() -> Optional[FaultInjector]:

@@ -971,6 +971,106 @@ class Handler(BaseHTTPRequestHandler):
             if ticket is not None:
                 ticket.release()
 
+    # -- tiered storage (object-store cold fragments) ----------------------
+
+    def _tier(self):
+        tier = self.node.tier
+        if tier is None:
+            raise NotFoundError("tiered storage is not enabled on this node")
+        return tier
+
+    def _tier_view(self):
+        """Resolve the (view, shard) a tier control call names; 400 on
+        malformed params (naming the parameter), 404 on unknown
+        index/field/view."""
+        iname = self._str_param("index")
+        fname = self._str_param("field")
+        vname = self.query.get("view", "standard")
+        shard = self._int_param("shard")
+        idx = self.node.holder.index(iname)
+        if idx is None:
+            raise NotFoundError(f"index not found: {iname}")
+        f = idx.field(fname)
+        if f is None:
+            raise NotFoundError(f"field not found: {fname}")
+        v = f.views.get(vname)
+        if v is None:
+            raise NotFoundError(f"view not found: {vname}")
+        return v, shard
+
+    @route("GET", "/internal/tier/status")
+    def get_tier_status(self):
+        self._reply(self._tier().status())
+
+    @route("GET", "/internal/tier/offer")
+    def get_tier_offer(self):
+        """Snapshot-bootstrap offer for one transfer leg (see
+        NodeServer.tier_offer). Deliberately NOT 404 on untiered nodes:
+        a mixed cluster answers {"mode": "stream"} so the joiner falls
+        back without special-casing."""
+        iname = self._str_param("index")
+        fname = self._str_param("field")
+        vname = self.query.get("view", "standard")
+        shard = self._int_param("shard")
+        tag = self._str_param("tag")
+        self._reply(self.node.tier_offer(iname, fname, vname, shard, tag))
+
+    @route("POST", "/internal/tier/demote")
+    def post_tier_demote(self):
+        """Manually demote one fragment to the object store. 200 with
+        demoted=false when the demote was skipped or aborted (already
+        cold, already in flight, or a write raced the upload)."""
+        tier = self._tier()
+        v, shard = self._tier_view()
+        frag = v.fragments.get(shard)
+        if frag is None:
+            already = tier.is_cold(v, shard)
+            self._reply({"demoted": False, "cold": already})
+            return
+        ok = tier.demote_fragment(v, frag, reason="manual")
+        self._reply({"demoted": bool(ok), "cold": tier.is_cold(v, shard)})
+
+    @route("POST", "/internal/tier/hydrate")
+    def post_tier_hydrate(self):
+        """Manually hydrate one cold fragment (prewarm). Rides the same
+        single-flight path as a cold query."""
+        tier = self._tier()
+        v, shard = self._tier_view()
+        frag = tier.hydrate(v, shard)
+        self._reply({"hydrated": frag is not None,
+                     "cold": tier.is_cold(v, shard)})
+
+    @route("POST", "/internal/tier/placement")
+    def post_tier_placement(self):
+        """Set (or clear, with placement="") one index's placement
+        override; 400 names the malformed field."""
+        tier = self._tier()
+        d = self._json_body_dict()
+        index = self._body_str(d, "index")
+        placement = d.get("placement")
+        if not isinstance(placement, str):
+            raise BadParam(
+                f"body field 'placement' must be a string, got {placement!r}"
+            )
+        if placement == "":
+            tier.policy.drop_index(index)
+        else:
+            try:
+                tier.policy.set_override(index, placement)
+            except ValueError as e:
+                raise BadParam(str(e)) from None
+        self._reply({"index": index,
+                     "placement": tier.policy.placement(index)})
+
+    @route("POST", "/internal/tier/sync")
+    def post_tier_sync(self):
+        """Run one snapshot-sync pass (anti-entropy over stored
+        objects); ?deep=true verifies stored bytes by checksum and
+        re-uploads corrupt/torn objects."""
+        tier = self._tier()
+        deep = self._bool_param("deep", False)
+        self._reply(tier.sync_snapshots(deep=deep))
+
     @route("POST", "/internal/translate/keys")
     def post_translate_keys(self):
         d = self._json_body()

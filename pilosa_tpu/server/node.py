@@ -118,6 +118,13 @@ class NodeServer:
         trace_ring: int = 1024,  # spans kept in the per-node ring
         telemetry_sample_interval: float = 5.0,  # timeline tick, s; 0=off
         telemetry_ring: int = 720,  # utilization samples kept per node
+        tier_store_path: str = "",  # object-store dir; "" disables the tier
+        tier_store=None,  # injected ObjectStore (tests/harness); wins over path
+        tier_placement: str = "hot",  # default per-index placement
+        tier_overrides: Sequence[str] = (),  # "idx:placement=cold"
+        tier_demote_after: float = 300.0,  # idle seconds before demotion; 0 off
+        tier_host_budget_bytes: int = 0,  # local snap+wal byte cap; 0 = no cap
+        tier_fetch_concurrency: int = 4,  # parallel object-store transfers
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -393,6 +400,40 @@ class NodeServer:
         self._resize_abort = threading.Event()
         self._resize_thread: Optional[threading.Thread] = None
 
+        # tiered storage (pilosa_tpu/tier/): per-node manager over a
+        # (possibly shared) object store. The STORE may be shared across
+        # nodes — snapshot bootstrap depends on it — but the manager is
+        # strictly per node: in-process harness nodes share index names,
+        # and a global cold set would alias them.
+        self.tier = None
+        self._tier_thread = None
+        self.tier_demote_interval = 0.0
+        store = tier_store
+        if store is None and tier_store_path:
+            from pilosa_tpu.tier.store import LocalDirStore
+
+            store = LocalDirStore(tier_store_path)
+        if store is not None:
+            from pilosa_tpu.tier import TierManager, TierPolicy
+
+            self.tier = TierManager(
+                store,
+                TierPolicy(tier_placement, tier_overrides),
+                self.holder,
+                demote_after=tier_demote_after,
+                host_budget_bytes=tier_host_budget_bytes,
+                fetch_concurrency=tier_fetch_concurrency,
+                scheduler=self.scheduler,
+                tracer=self.tracer,
+            )
+            if tier_demote_after > 0 or tier_host_budget_bytes > 0:
+                # tick a few times per idle window so demotion lands
+                # within ~demote-after of true idleness without a
+                # dedicated knob; clamped so tests stay responsive and
+                # production stays cheap
+                base = tier_demote_after / 4 if tier_demote_after > 0 else 5.0
+                self.tier_demote_interval = min(30.0, max(0.5, base))
+
         from pilosa_tpu.server.api import API
 
         self.api = API(self)
@@ -555,6 +596,14 @@ class NodeServer:
                 self.mesh_group_name, self.node.id, self.holder
             )
         self.holder.open()
+        if self.tier is not None:
+            # rebuild the cold set from the store (self-describing: a
+            # manifest whose fragment has no local copy is cold — covers
+            # every demote/hydrate crash window) and attach the resolver
+            # to the views that need it
+            n_cold = self.tier.load_cold_set()
+            if n_cold:
+                self.logger(f"tier: {n_cold} cold fragments from store")
         from pilosa_tpu.server.handler import make_http_server
 
         host, port = self.bind.rsplit(":", 1)
@@ -607,7 +656,24 @@ class NodeServer:
                 daemon=True,
             )
             self._telemetry_thread.start()
+        if self.tier is not None and self.tier_demote_interval > 0:
+            self._tier_thread = threading.Thread(
+                target=self._tier_demote_loop,
+                name=f"tier-{self.node.id}",
+                daemon=True,
+            )
+            self._tier_thread.start()
         return self
+
+    def _tier_demote_loop(self) -> None:
+        """Tier demotion ticker: idle cold-placement fragments demote to
+        the object store, warm fragments shed device residency, and
+        budget pressure demotes LRU until local bytes fit."""
+        while not self._closing.wait(self.tier_demote_interval):
+            try:
+                self.tier.demote_tick()
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self._ticker_error("tier-demote", e)
 
     def _telemetry_loop(self) -> None:
         """Always-on utilization timeline ticker: refresh residency
@@ -776,6 +842,40 @@ class NodeServer:
                 self.stats.with_tags("cache:result", f"index:{idx}").gauge(
                     "tenant.quota_evictions", n
                 )
+        # tiered storage (pilosa_tpu/tier/): cumulative demote/hydrate/
+        # bootstrap/sync counters plus per-index cold-set gauges. An
+        # index whose cold set drained publishes a final zero then
+        # leaves the working set, like hbm.resident_bytes above.
+        if self.tier is not None:
+            tc = self.tier.counters()
+            self.stats.gauge("tier.demotions", tc["demotions"])
+            self.stats.gauge("tier.demote_bytes", tc["demote_bytes"])
+            self.stats.gauge("tier.demote_aborts", tc["demote_aborts"])
+            self.stats.gauge("tier.hydrations", tc["hydrations"])
+            self.stats.gauge("tier.fetches", tc["fetches"])
+            self.stats.gauge("tier.fetch_bytes", tc["fetch_bytes"])
+            self.stats.gauge("tier.bootstrap_objects",
+                             tc["bootstrap_objects"])
+            self.stats.gauge("tier.bootstrap_bytes", tc["bootstrap_bytes"])
+            self.stats.gauge("tier.ae_repairs", tc["ae_repairs"])
+            self.stats.gauge("tier.sync_uploads", tc["sync_uploads"])
+            tsum = self.tier.index_summary()
+            tstale = getattr(self, "_tier_idx_published", set()) - set(tsum)
+            self._tier_idx_published = set(tsum)
+            for idx, row in tsum.items():
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tier.cold_fragments", row["cold_fragments"]
+                )
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tier.local_bytes", row["local_bytes"]
+                )
+            for idx in tstale:
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tier.cold_fragments", 0
+                )
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "tier.local_bytes", 0
+                )
 
     def drop_index_telemetry(self, index: str) -> None:
         """Label GC for a deleted index: remove every per-index metric
@@ -810,6 +910,18 @@ class NodeServer:
         cache_published = getattr(self, "_cache_idx_published", None)
         if cache_published is not None:
             cache_published.discard(index)
+        # tier GC: cold-set entries, the placement override, AND the
+        # stored snapshot objects (snap/<index>/...) all die with the
+        # index — a deleted tenant's data must not linger in the store
+        if self.tier is not None:
+            removed = self.tier.drop_index(index)
+            if removed:
+                self.logger(
+                    f"tier: removed {removed} stored objects for {index!r}"
+                )
+            published = getattr(self, "_tier_idx_published", None)
+            if published is not None:
+                published.discard(index)
 
     def _ticker_error(self, ticker: str, exc: BaseException) -> None:
         """Background tickers must survive any failure, but never silently:
@@ -913,6 +1025,9 @@ class NodeServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._tier_thread is not None:
+            self._tier_thread.join(timeout=5.0)
+            self._tier_thread = None
         self.holder.close()
         self.stats.close()  # statsd clients own a UDP socket
 
@@ -1167,6 +1282,15 @@ class NodeServer:
                 self.try_sync_holder()
             except Exception as e:  # noqa: BLE001 - keep the ticker alive
                 self._ticker_error("anti-entropy", e)
+            if self.tier is not None:
+                try:
+                    # anti-entropy extended to snapshot objects: the
+                    # shallow pass uploads missing/stale manifests so the
+                    # store keeps mirroring local state (deep verify is
+                    # on demand via POST /internal/tier/sync?deep=true)
+                    self.tier.sync_snapshots(deep=False)
+                except Exception as e:  # noqa: BLE001
+                    self._ticker_error("tier-sync", e)
 
     def sync_holder(self) -> int:
         """One full anti-entropy pass: for every local fragment whose shard
@@ -1700,6 +1824,82 @@ class NodeServer:
             raise
         return blob
 
+    def tier_offer(self, iname: str, fname: str, vname: str, shard: int, tag: str) -> dict:
+        """Source-side snapshot-bootstrap offer for one transfer leg.
+        Instead of streaming the fragment's bytes peer-to-peer, the
+        destination asks whether a current snapshot object already sits
+        in the shared store. Three answers:
+
+        - "cold": the fragment is demoted — the stored object IS its
+          exact contents (a cold fragment has provably taken zero
+          writes). A None-frag lease entry plus a hydration watch keep
+          the delta plane exact: drains return an empty delta while
+          cold, and if the fragment hydrates mid-transfer the watch
+          arms a capture BEFORE the fragment publishes, so no write can
+          slip between the object the joiner fetched and the capture.
+        - "snapshot": the fragment is live but its manifest still
+          matches its contents; `begin_capture_if_version` re-verifies
+          currency and arms the capture atomically — any interleaved
+          write flunks the version check and falls back to streaming.
+        - "stream": no current object; use the classic byte-streaming
+          path."""
+        key = (iname, fname, vname, shard)
+        if self.tier is None:
+            return {"mode": "stream"}
+        mode, meta, live_version = self.tier.offer(*key)
+        if mode == "stream" or meta is None:
+            return {"mode": "stream"}
+        now = time.monotonic()
+        if mode == "snapshot":
+            idx = self.holder.index(iname)
+            f = idx.field(fname) if idx is not None else None
+            v = f.views.get(vname) if f is not None else None
+            frag = v.fragments.get(shard) if v is not None else None
+            if frag is None or not frag.begin_capture_if_version(tag, live_version):
+                return {"mode": "stream"}
+            with self._transfer_mu:
+                self._sweep_captures_locked(now)
+                self._transfer_captures[(tag,) + key] = {
+                    "frag": frag,
+                    "expires": now + CAPTURE_LEASE,
+                }
+            return {"mode": "snapshot", "meta": meta}
+        with self._transfer_mu:
+            self._sweep_captures_locked(now)
+            self._transfer_captures[(tag,) + key] = {
+                "frag": None,
+                "expires": now + CAPTURE_LEASE,
+            }
+        armed = self.tier.watch_hydration(
+            key, tag, lambda frag: self._arm_watched_capture(tag, key, frag)
+        )
+        if not armed:
+            # raced a hydration: the key is no longer cold and no watch
+            # will ever fire — retract the lease and stream classically
+            with self._transfer_mu:
+                self._transfer_captures.pop((tag,) + key, None)
+            return {"mode": "stream"}
+        return {"mode": "cold", "meta": meta}
+
+    def _arm_watched_capture(self, tag: str, key: tuple, frag) -> None:
+        """Hydration-watch callback for a cold-mode bootstrap offer.
+        Runs pre-publish (adopt_fragment's on_ready), so the capture is
+        armed before any write can reach the fragment — the joiner's
+        fetched object plus this capture's delta is exact. An expired
+        lease means the joiner is gone; leave the fragment untouched."""
+        now = time.monotonic()
+        with self._transfer_mu:
+            ent = self._transfer_captures.get((tag,) + tuple(key))
+            if ent is None or now >= ent["expires"]:
+                return
+            if frag.begin_capture_if_version(tag, frag.version):
+                ent["frag"] = frag
+            else:
+                # cannot happen on an unpublished fragment, but if it
+                # ever did, a dropped lease turns the next drain into a
+                # 410 -> full snapshot refetch, which is always safe
+                self._transfer_captures.pop((tag,) + tuple(key), None)
+
     def drain_fragment_capture(self, tag: str, key: tuple) -> bytes:
         """Pop one transfer leg's captured writes (WAL-framed bytes).
         Raises TransferCaptureLost (-> HTTP 410) when the capture is gone
@@ -1715,13 +1915,23 @@ class NodeServer:
                 ent["expires"] = now + CAPTURE_LEASE
         if ent is None:
             raise TransferCaptureLost(f"no active capture for {key} ({tag})")
+        if ent["frag"] is None:
+            # cold-mode bootstrap watch (tier_offer): the fragment is
+            # still demoted, so it has provably taken zero writes — an
+            # empty delta is exact, not a fallback
+            from pilosa_tpu.core import wal as wal_mod
+
+            return wal_mod.encode_records([])
         return ent["frag"].drain_capture(tag)
 
     def _sweep_captures_locked(self, now: float) -> None:
         for key, ent in list(self._transfer_captures.items()):
             if now >= ent["expires"]:
                 del self._transfer_captures[key]
-                ent["frag"].end_capture(key[0])
+                if ent["frag"] is not None:
+                    ent["frag"].end_capture(key[0])
+                elif self.tier is not None:
+                    self.tier.unwatch(key[0])
 
     def _transfer_tag(self, job: str) -> str:
         """This node's capture tag for one job's transfer legs."""
@@ -1741,7 +1951,8 @@ class NodeServer:
             frags = [
                 ent["frag"]
                 for k, ent in self._transfer_captures.items()
-                if k[0] == job or k[0].startswith(job + ":")
+                if (k[0] == job or k[0].startswith(job + ":"))
+                and ent["frag"] is not None
             ]
         for f in frags:
             f.block_writes(ttl)
@@ -1766,7 +1977,10 @@ class NodeServer:
             else:
                 self._resize_ledger.pop(job, None)
         for k, ent in ents:
-            ent["frag"].end_capture(k[0])
+            if ent["frag"] is not None:
+                ent["frag"].end_capture(k[0])
+            elif self.tier is not None:
+                self.tier.unwatch(k[0])
         return len(ents)
 
     def resize_cleanup(self, job: str, aborting: bool = False) -> int:
@@ -1945,10 +2159,16 @@ class NodeServer:
             # own post-cutover — skip the leg instead of failing the job
             self.logger(f"resize fetch {iname}/{fname}: field gone, skipping")
             return None
-        blob = self.client.retrieve_fragment(
-            src_uri, iname, fname, vname, shard,
-            capture=self._transfer_tag(job) if capture else None,
-        )
+        blob = None
+        via_tier = False
+        if capture and not merge_existing and self.tier is not None:
+            blob = self._tier_fetch_leg(job, key, src_uri)
+            via_tier = blob is not None
+        if blob is None:
+            blob = self.client.retrieve_fragment(
+                src_uri, iname, fname, vname, shard,
+                capture=self._transfer_tag(job) if capture else None,
+            )
         v = f._view_create(vname)
         existing = v.fragment_if_exists(shard)
         created = existing is None
@@ -1966,9 +2186,42 @@ class NodeServer:
             ledger["fetched"][key] = src_uri
             if created:
                 ledger["created"].add(key)
-        self.stats.count("resize.fragments_streamed", 1)
-        self.stats.count("resize.bytes_streamed", len(blob))
+        if not via_tier:
+            # tier-path legs count tier.bootstrap_* (in bootstrap_fetch)
+            # instead — the snapshot-bootstrap acceptance criterion
+            # compares the two byte counters
+            self.stats.count("resize.fragments_streamed", 1)
+            self.stats.count("resize.bytes_streamed", len(blob))
         return len(blob)
+
+    def _tier_fetch_leg(self, job: str, key: tuple, src_uri: str) -> Optional[bytes]:
+        """Try the snapshot-bootstrap path for one transfer leg: ask the
+        source to offer the fragment as a stored object (arming its
+        capture or hydration watch on the way out), then fetch the
+        object from the shared store instead of streaming the bytes
+        from the peer. Returns the verified blob, or None to fall back
+        to classic streaming (source untiered, offer said stream, or
+        the store fetch failed — in which case the classic retrieve
+        re-arms the same tag and the transfer stays exact)."""
+        from pilosa_tpu.tier.store import StoreError
+
+        iname, fname, vname, shard = key
+        try:
+            offer = self.client.tier_offer(
+                src_uri, iname, fname, vname, shard, self._transfer_tag(job)
+            )
+        except ClientError as e:
+            if e.status != 404:
+                self.logger(f"tier offer {key}: {e}; streaming")
+            return None
+        meta = offer.get("meta")
+        if offer.get("mode") not in ("cold", "snapshot") or not meta:
+            return None
+        try:
+            return self.tier.bootstrap_fetch(meta)
+        except StoreError as e:
+            self.logger(f"tier bootstrap fetch {key}: {e}; streaming")
+            return None
 
     def _drain_or_refetch(self, job: str, ledger: dict, key: tuple, src_uri: str) -> int:
         """Drain one leg's capture. ANY drain failure recovers by

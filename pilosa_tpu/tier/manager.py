@@ -1,0 +1,773 @@
+"""TierManager: per-node tiered-storage control plane.
+
+Composes the existing machinery into the cold tier (ISSUE/ROADMAP
+"beyond-RAM capacity"):
+
+  demote   — upload a fragment's snapshot object (the `begin_streaming`
+             consistency point: serialize + arm capture atomically),
+             durably, BEFORE the local copy is deleted; any write that
+             lands during the upload aborts the demote (the capture sees
+             it), and the final window is closed with the cutover write
+             barrier (`block_writes` -> TransferCutover -> client 503
+             retry, which then hydrates).
+  hydrate  — first access to a cold fragment fetches the object through
+             a single-flight gate (the devcache `_building` + condvar
+             idiom: concurrent queries coalesce on ONE fetch), admitted
+             through the `batch` WFQ class so hydration can't starve
+             interactive traffic, verified against the checksum in the
+             object name, then adopted back into the view.
+  bootstrap— a joining node fetches snapshot objects from the store and
+             catches up via the capture/delta codec instead of
+             peer-streaming every byte (server/node.py transfer legs).
+  sync     — anti-entropy extended to snapshot objects: stale/missing
+             manifests re-upload; deep mode fetches and verifies stored
+             bytes against the live fragment and repairs mismatches.
+
+One manager per NodeServer (never module-global: the in-process cluster
+harness runs several nodes that share index names; only the STORE is
+shared, which is exactly what bootstrap needs)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from pilosa_tpu.core import wal as walmod
+from pilosa_tpu.sched import cost as costmod
+from pilosa_tpu.tier import store as storemod
+from pilosa_tpu.tier.policy import (
+    PLACEMENT_COLD,
+    PLACEMENT_HOT,
+    PLACEMENT_WARM,
+    TierPolicy,
+)
+from pilosa_tpu.tier.store import ObjectCorrupt, ObjectStore, StoreError
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.locks import (
+    TrackedCondition,
+    TrackedLock,
+    TrackedSemaphore,
+)
+
+logger = logging.getLogger("pilosa_tpu.tier")
+
+# (index, field, view, shard) — the tier plane's unit of placement
+Key = Tuple[str, str, str, int]
+
+# how long demote freezes the fragment's write funnels while it checks
+# the capture ran dry (writers raise TransferCutover -> 503 + retry;
+# the retry hydrates, so no acked write is ever dropped)
+DEMOTE_BLOCK_TTL = 2.0
+
+# hydration admits through the batch WFQ lane while the QUERY thread may
+# itself hold an interactive slot — a bounded deadline turns the nested
+# wait into a 429 (honest shed) instead of a hold-and-wait deadlock when
+# every slot is a cold query waiting on hydration
+HYDRATE_ADMIT_DEADLINE = 10.0
+
+COUNTER_NAMES = (
+    "demotions", "demote_bytes", "demote_aborts",
+    "hydrations", "fetches", "fetch_bytes",
+    "bootstrap_objects", "bootstrap_bytes",
+    "ae_repairs", "sync_uploads",
+)
+
+
+def content_checksum(blob: bytes) -> str:
+    return hashlib.blake2b(blob, digest_size=8).hexdigest()
+
+
+def object_key(index: str, field: str, view: str, shard: int,
+               version: int, digest: str) -> str:
+    return f"snap/{index}/{field}/{view}/{shard}/{version}-{digest}"
+
+
+def manifest_key(index: str, field: str, view: str, shard: int) -> str:
+    return f"snap/{index}/{field}/{view}/{shard}/LATEST"
+
+
+def index_prefix(index: str) -> str:
+    return f"snap/{index}/"
+
+
+class TierManager:
+    """Owns the cold set, the LRU touch clock, the single-flight
+    hydration gate, and the store protocol. Doubles as every View's
+    `cold_resolver` (resolve / cold_shards / touch_many)."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        policy: TierPolicy,
+        holder,
+        *,
+        demote_after: float = 300.0,
+        host_budget_bytes: int = 0,
+        fetch_concurrency: int = 4,
+        scheduler=None,
+        tracer=None,
+    ):
+        self.store = store
+        self.policy = policy
+        self.holder = holder
+        self.demote_after = float(demote_after)
+        self.host_budget_bytes = int(host_budget_bytes)
+        self.scheduler = scheduler
+        self.tracer = tracer
+        self._mu = TrackedLock("tier.mu")
+        self._cv = TrackedCondition(self._mu, name="tier.hydrate_cv")
+        # cold set: fragments whose only copy is the snapshot object
+        self._cold: Dict[Key, dict] = {}
+        # per-view shadow of the cold set so available_shards() is O(cold
+        # shards of THIS view), not a scan of the whole cold dict
+        self._cold_by_view: Dict[Tuple[str, str, str], Set[int]] = {}
+        # single-flight: keys with a fetch in flight (devcache idiom)
+        self._hydrating: Set[Key] = set()
+        # bootstrap watches (cold-mode offers): tag -> callback per cold
+        # key; when the key hydrates, each callback runs with the fresh
+        # fragment BEFORE it is published to the view — the node arms the
+        # joiner's write capture there, so a write that lands after the
+        # source re-warms still reaches the joiner via delta drains
+        self._watches: Dict[Key, Dict[str, object]] = {}
+        # keys with a demote in flight (demote is idempotent-per-key)
+        self._demoting: Set[Key] = set()
+        # LRU clock: last access per key (hydrate, mutation, stack read);
+        # unknown keys default to boot so a freshly started node does not
+        # demote everything on its first tick
+        self._touch: Dict[Key, float] = {}
+        self._boot_t = time.monotonic()
+        # upload memo: key -> (fragment version at upload, checksum).
+        # Fragment versions are process-local (they restart at open), so
+        # this is ONLY a same-process shortcut — currency across restarts
+        # is always re-proven by serializing and comparing checksums.
+        self._clean: Dict[Key, Tuple[int, str]] = {}
+        # bounds concurrent store transfers (fetch-concurrency knob)
+        self._xfer_sem = TrackedSemaphore(
+            "tier.xfer_sem", max(1, int(fetch_concurrency))
+        )
+        self._stats_mu = TrackedLock("tier.stats_mu")
+        self._counters: Dict[str, int] = {n: 0 for n in COUNTER_NAMES}
+        # hbm demotion-pressure watermark: cumulative device-cache
+        # eviction bytes at the last tick (hbm/residency.py
+        # eviction_pressure) — growth halves the idle threshold
+        self._evict_pressure_mark = 0
+
+    # -- counters ----------------------------------------------------------
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._stats_mu:
+            self._counters[name] += n
+
+    def counters(self) -> Dict[str, int]:
+        with self._stats_mu:
+            return dict(self._counters)
+
+    # -- key helpers -------------------------------------------------------
+
+    @staticmethod
+    def _frag_key(frag) -> Key:
+        return (frag.index, frag.field, frag.view, frag.shard)
+
+    @staticmethod
+    def _view_key(view, shard: int) -> Key:
+        return (view.index, view.field, view.name, shard)
+
+    def start_span(self, name: str):
+        """Span factory riding the node's tracer when one is injected
+        (named like the tracer method so the span-registry contract sees
+        the literal call sites below)."""
+        if self.tracer is not None:
+            return self.tracer.start_span(name)
+        return tracing.start_span(name)
+
+    # -- manifest / upload -------------------------------------------------
+
+    def _load_manifest(self, key: Key) -> Optional[dict]:
+        try:
+            raw = self.store.get(manifest_key(*key))
+        except storemod.ObjectMissing:
+            return None
+        try:
+            meta = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None  # torn manifest: treat as absent, sync re-uploads
+        if not isinstance(meta, dict) or "object" not in meta:
+            return None
+        return meta
+
+    def _upload(self, key: Key, blob: bytes, version: int) -> dict:
+        """Durably persist the snapshot object, then flip LATEST at it.
+        Order matters: the manifest must never point at an object that
+        could not survive a crash (store puts are fsync-durable)."""
+        digest = content_checksum(blob)
+        okey = object_key(*key, version, digest)
+        meta = {
+            "object": okey,
+            "version": int(version),
+            "checksum": digest,
+            "bytes": len(blob),
+        }
+        with self._xfer_sem:
+            self.store.put(okey, blob)
+            self.store.put(
+                manifest_key(*key),
+                json.dumps(meta, sort_keys=True).encode("utf-8"),
+            )
+        self._clean[key] = (int(version), digest)
+        return meta
+
+    def _fetch_verified(self, meta: dict) -> bytes:
+        """Fetch + verify one snapshot object against the checksum in its
+        name/manifest. A corrupt or torn object FAILS the fetch loudly —
+        hydrating a prefix of a fragment would be silent data loss."""
+        with self._xfer_sem:
+            blob = self.store.get(meta["object"])
+        if content_checksum(blob) != meta["checksum"]:
+            raise ObjectCorrupt(
+                f"{meta['object']}: stored bytes do not match checksum"
+            )
+        return blob
+
+    # -- demote ------------------------------------------------------------
+
+    def demote_fragment(self, view, frag, *, reason: str = "manual") -> bool:
+        """Upload-then-evict one fragment. Returns True when the local
+        copy was dropped; False when the demote was skipped (already in
+        flight) or aborted (a write raced the upload — the caller/ticker
+        simply retries later, with the object left behind as a harmless
+        stale snapshot the sync pass will refresh)."""
+        key = self._frag_key(frag)
+        with self._mu:
+            if key in self._demoting or key in self._cold:
+                return False
+            self._demoting.add(key)
+        try:
+            return self._demote(view, frag, key, reason)
+        finally:
+            with self._mu:
+                self._demoting.discard(key)
+
+    def _demote(self, view, frag, key: Key, reason: str) -> bool:
+        span = self.start_span("tier.demote")
+        with span:
+            span.set_tag("index", key[0])
+            span.set_tag("shard", key[3])
+            span.set_tag("reason", reason)
+            # 1. local durability first: materialize the .snap and
+            # truncate the WAL so the upload source IS the consistency
+            # point (and a crash anywhere below reopens locally, clean)
+            if frag.path is not None:
+                frag.snapshot()
+            # 2. serialize + arm capture atomically: the blob plus the
+            # captured delta is exactly the fragment's state at any
+            # later drain point
+            tag = "tier-demote"
+            blob = frag.begin_streaming(tag)
+            try:
+                version = frag.version
+                try:
+                    meta = self._upload(key, blob, version)
+                except StoreError as exc:
+                    frag.end_capture(tag)
+                    self._bump("demote_aborts")
+                    logger.warning("tier: demote upload failed for %s: %s",
+                                   key, exc)
+                    return False
+                # 3. close the write window: freeze the mutation funnels,
+                # then check the capture ran dry. A non-empty drain means a
+                # write landed mid-upload -> the object is stale -> abort
+                # (writers frozen after this point get TransferCutover ->
+                # 503 retry; the retry hydrates, so nothing acked is lost).
+                frag.block_writes(DEMOTE_BLOCK_TTL)
+                delta = frag.drain_capture(tag)
+                if delta != walmod.encode_records([]):
+                    frag.unblock_writes()
+                    frag.end_capture(tag)
+                    self._bump("demote_aborts")
+                    span.set_tag("aborted", "write-raced-upload")
+                    return False
+                # 4. flip the key cold BEFORE detaching: a lookup arriving
+                # between detach and here would otherwise create a fresh
+                # EMPTY fragment that shadows the stored snapshot
+                with self._mu:
+                    self._cold[key] = meta
+                    self._cold_by_view.setdefault(key[:3], set()).add(key[3])
+                    self._touch.pop(key, None)
+                view.cold_resolver = self
+                # 5. kill-matrix window: uploaded + registered, local copy
+                # still intact — SIGKILL here must reopen locally (the cold
+                # scan skips keys with local fragments)
+                storemod.fault_point("tier.demote.pre_delete", meta["object"])
+                # 6. drop the local copy (capture ends inside: the fragment
+                # is already detached, so the lifted write barrier exposes
+                # nothing — new lookups resolve through the cold set)
+                # releases: evict_fragment(end_capture_tag=tag) ends the capture
+                evicted = view.evict_fragment(frag.shard, end_capture_tag=tag)
+                if not evicted:
+                    # raced a delete_fragment: disarm and undo the cold
+                    # registration (the deleted fragment's capture would
+                    # otherwise leak its tracked resource)
+                    frag.end_capture(tag)
+                    with self._mu:
+                        self._cold.pop(key, None)
+                        self._cold_by_view.get(key[:3], set()).discard(key[3])
+                    return False
+                self._bump("demotions")
+                self._bump("demote_bytes", len(blob))
+                span.set_tag("bytes", len(blob))
+                return True
+            except BaseException:
+                # a kill directive never returns, but an injected error
+                # (or any surprise) must disarm before propagating — an
+                # orphaned capture buffers every write until overflow
+                # (end_capture is idempotent, so re-disarming after the
+                # evict already released it is harmless)
+                frag.end_capture(tag)
+                raise
+
+    # -- View.cold_resolver protocol --------------------------------------
+
+    def cold_shards(self, view) -> Set[int]:
+        with self._mu:
+            return set(self._cold_by_view.get(
+                (view.index, view.field, view.name), ()))
+
+    def is_cold(self, view, shard: int) -> bool:
+        with self._mu:
+            return self._view_key(view, shard) in self._cold
+
+    def touch_many(self, view, shards) -> None:
+        now = time.monotonic()
+        with self._mu:
+            for s in shards:
+                self._touch[self._view_key(view, s)] = now
+
+    def touch_fragment(self, frag) -> None:
+        with self._mu:
+            self._touch[self._frag_key(frag)] = time.monotonic()
+
+    def resolve(self, view, shard: int):
+        """View-side hook: return the hydrated fragment for a cold
+        shard, or None when the shard is simply absent (cheap miss —
+        one dict probe under tier.mu)."""
+        key = self._view_key(view, shard)
+        with self._mu:
+            if key not in self._cold and key not in self._hydrating:
+                return None
+        return self.hydrate(view, shard)
+
+    # -- hydrate -----------------------------------------------------------
+
+    def hydrate(self, view, shard: int):
+        """Fetch + adopt one cold fragment, single-flight: the first
+        caller fetches; concurrent callers wait on the condvar and then
+        read the adopted fragment out of the view (counter-asserted:
+        N concurrent cold queries -> exactly one store fetch)."""
+        key = self._view_key(view, shard)
+        with self._mu:
+            while key in self._hydrating:
+                self._cv.wait()
+            meta = self._cold.get(key)
+            if meta is None:
+                # the winner (or a racing write path) already hydrated
+                return view.fragments.get(shard)
+            self._hydrating.add(key)
+        try:
+            frag = self._hydrate(view, shard, key, meta)
+        finally:
+            with self._mu:
+                self._hydrating.discard(key)
+                self._cv.notify_all()
+        return frag
+
+    def _hydrate(self, view, shard: int, key: Key, meta: dict):
+        ticket = None
+        if self.scheduler is not None:
+            from pilosa_tpu.sched.admission import CLASS_BATCH
+
+            ticket = self.scheduler.admit(
+                cls=CLASS_BATCH,
+                cost=costmod.hydrate_cost(int(meta.get("bytes") or 0)),
+                deadline=HYDRATE_ADMIT_DEADLINE,
+            )
+        try:
+            span = self.start_span("tier.hydrate")
+            with span:
+                span.set_tag("index", key[0])
+                span.set_tag("shard", shard)
+                blob = self._fetch_verified(meta)
+                self._bump("fetches")
+                self._bump("fetch_bytes", len(blob))
+                span.set_tag("bytes", len(blob))
+                # kill-matrix window: object fetched, nothing local yet —
+                # SIGKILL here must leave the key cold (re-hydrate retries)
+                storemod.fault_point("tier.hydrate.pre_apply",
+                                     meta["object"])
+
+                def on_ready(f, key=key):
+                    # bootstrap watches fire pre-publish: the fragment's
+                    # state still equals the object a joiner fetched, so
+                    # the armed capture is exact from byte zero
+                    with self._mu:
+                        watchers = dict(self._watches.pop(key, {}))
+                    for cb in watchers.values():
+                        try:
+                            cb(f)
+                        except Exception as exc:  # noqa: BLE001
+                            logger.warning(
+                                "tier: hydration watch failed for %s: %s",
+                                key, exc)
+
+                frag = view.adopt_fragment(shard, blob, on_ready=on_ready)
+        finally:
+            if ticket is not None:
+                ticket.release()
+        with self._mu:
+            self._cold.pop(key, None)
+            self._cold_by_view.get(key[:3], set()).discard(key[3])
+            self._touch[key] = time.monotonic()
+        self._bump("hydrations")
+        return frag
+
+    # -- cold-set recovery -------------------------------------------------
+
+    def load_cold_set(self) -> int:
+        """Rebuild the cold set from the store at node start: every
+        manifest whose fragment has NO local copy is cold. Self-describing
+        recovery covers every crash window — killed before local delete
+        (local copy present -> not cold), killed mid-hydration (no local
+        copy -> still cold)."""
+        n = 0
+        try:
+            keys = self.store.list("snap/")
+        except StoreError as exc:
+            logger.warning("tier: cold-set scan failed: %s", exc)
+            return 0
+        for skey in keys:
+            if not skey.endswith("/LATEST"):
+                continue
+            parts = skey.split("/")
+            if len(parts) != 6 or not parts[4].isdigit():
+                continue
+            key: Key = (parts[1], parts[2], parts[3], int(parts[4]))
+            view = self._find_view(key)
+            if view is None:
+                continue  # index/field/view gone: GC sweeps the prefix
+            if view.fragments.get(key[3]) is not None:
+                continue  # local copy survived: not cold
+            meta = self._load_manifest(key)
+            if meta is None:
+                continue
+            with self._mu:
+                self._cold[key] = meta
+                self._cold_by_view.setdefault(key[:3], set()).add(key[3])
+            view.cold_resolver = self
+            n += 1
+        return n
+
+    def _find_view(self, key: Key):
+        idx = self.holder.index(key[0])
+        if idx is None:
+            return None
+        fld = idx.field(key[1])
+        if fld is None:
+            return None
+        return fld.views.get(key[2])
+
+    # -- demotion ticker ---------------------------------------------------
+
+    def _local_bytes(self, frag) -> int:
+        """Host footprint of one fragment: its on-disk snapshot + WAL
+        (in-memory fragments report 0 — budget pressure is a disk/host
+        capacity knob and in-memory harnesses demote via the endpoint
+        or the idle clock instead)."""
+        import os
+
+        n = 0
+        for p in (frag.snap_path, frag.wal_path):
+            if p is not None:
+                try:
+                    n += os.path.getsize(p)
+                except OSError:
+                    pass
+        return n
+
+    def demote_tick(self, now: Optional[float] = None) -> int:
+        """One pass of the demotion policy (the node ticker):
+
+        1. cold-placement fragments idle past `demote-after` demote,
+           oldest first;
+        2. warm-placement fragments idle past `demote-after` shed their
+           DEVICE residency (host copy stays);
+        3. while local bytes exceed `host-budget-bytes`, demote LRU —
+           cold placement first, then warm; hot never auto-demotes."""
+        now = time.monotonic() if now is None else now
+        demoted = 0
+        threshold = self.demote_after
+        try:
+            from pilosa_tpu.hbm import residency
+
+            evicted = residency.eviction_pressure()
+        except Exception:  # noqa: BLE001 — pressure is advisory
+            evicted = 0
+        if evicted > self._evict_pressure_mark:
+            # the device cache is churning extents: the working set
+            # exceeds the device budget, so idle fragments demote at
+            # half the idle threshold to free capacity faster
+            self._evict_pressure_mark = evicted
+            threshold = self.demote_after / 2.0
+        candidates: List[Tuple[float, str, object, object]] = []
+        local_total = 0
+        for view, frag in self._walk_fragments():
+            if view.cold_resolver is None:
+                # lazy resolver attach: views are created deep inside
+                # Field, so the ticker is where the tier meets them —
+                # needed for the touch clock even before anything demotes
+                view.cold_resolver = self
+            placement = self.policy.placement(frag.index)
+            size = self._local_bytes(frag)
+            local_total += size
+            if placement == PLACEMENT_HOT:
+                continue
+            key = self._frag_key(frag)
+            with self._mu:
+                last = self._touch.get(key, self._boot_t)
+            idle = now - last
+            if self.demote_after > 0 and idle >= threshold:
+                if placement == PLACEMENT_COLD:
+                    candidates.append((last, PLACEMENT_COLD, view, frag))
+                else:
+                    # warm: host-only — shed the device extents covering
+                    # this shard (version-keyed entries would re-stage on
+                    # next read anyway; this frees the HBM now)
+                    from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+                    DEVICE_CACHE.invalidate_owner_shard(
+                        view._stack_token, frag.shard)
+                    DEVICE_CACHE.invalidate_owner(frag._token)
+        candidates.sort(key=lambda c: c[0])
+        for _last, _p, view, frag in candidates:
+            if self.demote_fragment(view, frag, reason="idle"):
+                demoted += 1
+                local_total -= self._local_bytes_estimate(frag)
+        if self.host_budget_bytes > 0 and local_total > self.host_budget_bytes:
+            demoted += self._budget_pressure(now, local_total)
+        return demoted
+
+    def _local_bytes_estimate(self, frag) -> int:
+        # after a demote the files are gone; the caller only needs the
+        # running total to go DOWN, so re-measuring (0) is fine
+        return 0
+
+    def _budget_pressure(self, now: float, local_total: int) -> int:
+        """Demote LRU until local bytes fit the host budget: cold
+        placement ranks before warm (cold opted in to the object store;
+        warm is the reluctant overflow valve), hot never demotes."""
+        ranked: List[Tuple[int, float, object, object, int]] = []
+        for view, frag in self._walk_fragments():
+            placement = self.policy.placement(frag.index)
+            if placement == PLACEMENT_HOT:
+                continue
+            key = self._frag_key(frag)
+            with self._mu:
+                last = self._touch.get(key, self._boot_t)
+            rank = 0 if placement == PLACEMENT_COLD else 1
+            ranked.append((rank, last, view, frag, self._local_bytes(frag)))
+        ranked.sort(key=lambda c: (c[0], c[1]))
+        demoted = 0
+        for _rank, _last, view, frag, size in ranked:
+            if local_total <= self.host_budget_bytes:
+                break
+            if self.demote_fragment(view, frag, reason="budget"):
+                demoted += 1
+                local_total -= size
+        return demoted
+
+    def _walk_fragments(self):
+        for idx in self.holder.indexes():
+            for fld in idx.fields(include_hidden=True):
+                for view in list(fld.views.values()):
+                    for frag in list(view.fragments.values()):
+                        yield view, frag
+
+    # -- anti-entropy over snapshot objects --------------------------------
+
+    def fragment_is_current(self, frag, meta: dict) -> Optional[int]:
+        """Version at which the stored snapshot exactly matches the live
+        fragment, or None. The in-process (version, checksum) memo makes
+        the common no-op O(1); otherwise prove it by serializing (a
+        version bump during the serialize voids the proof — the caller's
+        `begin_capture_if_version` re-checks atomically anyway)."""
+        key = self._frag_key(frag)
+        v = frag.version
+        memo = self._clean.get(key)
+        if memo is not None and memo == (v, meta.get("checksum")):
+            return v
+        blob = frag.to_bytes()
+        if content_checksum(blob) == meta.get("checksum") and frag.version == v:
+            self._clean[key] = (v, meta["checksum"])
+            return v
+        return None
+
+    def sync_snapshots(self, deep: bool = False) -> Dict[str, int]:
+        """Upload missing/stale snapshot objects for every local
+        fragment (the anti-entropy extension): after a pass, the store
+        mirrors local state, which is what makes snapshot bootstrap and
+        deep verification meaningful. `deep` additionally FETCHES each
+        stored object and verifies its bytes against the live fragment,
+        re-uploading on mismatch (bit-rot / torn-put repair)."""
+        uploaded = repaired = checked = 0
+        for view, frag in self._walk_fragments():
+            key = self._frag_key(frag)
+            checked += 1
+            try:
+                meta = self._load_manifest(key)
+                if meta is None or self.fragment_is_current(frag, meta) is None:
+                    blob = frag.to_bytes()
+                    self._upload(key, blob, frag.version)
+                    self._bump("sync_uploads")
+                    uploaded += 1
+                    continue
+                if deep:
+                    try:
+                        self._fetch_verified(meta)
+                    except StoreError:
+                        # stored bytes diverged from their own checksum
+                        # (torn put, bit rot): the live fragment is the
+                        # source of truth — re-upload
+                        blob = frag.to_bytes()
+                        self._upload(key, blob, frag.version)
+                        self._bump("ae_repairs")
+                        repaired += 1
+            except StoreError as exc:
+                logger.warning("tier: sync failed for %s: %s", key, exc)
+        return {"checked": checked, "uploaded": uploaded,
+                "repaired": repaired}
+
+    # -- bootstrap (server/node.py transfer legs) --------------------------
+
+    def offer(self, index: str, field: str, view_name: str,
+              shard: int) -> Tuple[str, Optional[dict], Optional[int]]:
+        """What a joiner should do for one fragment, as
+        (mode, manifest, live_version):
+
+        ("cold", meta, None)   — demoted here; fetch the object; deltas
+                                 arrive only if the source re-warms (a
+                                 hydration watch arms the capture then).
+        ("snapshot", meta, v)  — live AND the stored snapshot matches
+                                 the state at in-process version `v`;
+                                 fetch the object + drain the capture
+                                 the source arms atomically with
+                                 `begin_capture_if_version(tag, v)`.
+        ("stream", None, None) — no current object; classic streaming.
+        """
+        key: Key = (index, field, view_name, shard)
+        with self._mu:
+            meta = self._cold.get(key)
+        if meta is not None:
+            return "cold", meta, None
+        view = self._find_view(key)
+        frag = view.fragments.get(shard) if view is not None else None
+        if frag is None:
+            return "stream", None, None
+        try:
+            meta = self._load_manifest(key)
+        except StoreError:
+            return "stream", None, None
+        if meta is None:
+            return "stream", None, None
+        version = self.fragment_is_current(frag, meta)
+        if version is None:
+            return "stream", None, None
+        return "snapshot", meta, version
+
+    def watch_hydration(self, key: Key, tag: str, callback) -> bool:
+        """Register a cold-mode bootstrap watch: when `key` hydrates,
+        `callback(frag)` runs BEFORE the fragment publishes to its view
+        (no write can precede the armed capture). False when the key is
+        no longer cold — the caller must fall back to peer streaming,
+        since writes may already have diverged it from the object."""
+        with self._mu:
+            if key not in self._cold:
+                return False
+            self._watches.setdefault(key, {})[tag] = callback
+            return True
+
+    def unwatch(self, tag: str) -> None:
+        with self._mu:
+            for key in list(self._watches):
+                self._watches[key].pop(tag, None)
+                if not self._watches[key]:
+                    del self._watches[key]
+
+    def bootstrap_fetch(self, meta: dict) -> bytes:
+        """Joiner-side object fetch, counted separately from hydration:
+        the acceptance criterion compares these bytes against
+        resize.bytes_streamed on the peer-streaming path."""
+        blob = self._fetch_verified(meta)
+        self._bump("bootstrap_objects")
+        self._bump("bootstrap_bytes", len(blob))
+        return blob
+
+    # -- GC / summaries ----------------------------------------------------
+
+    def drop_index(self, index: str) -> int:
+        """Index-delete GC: forget the index's cold keys and touch
+        entries, drop its placement override, and sweep its stored
+        objects (snap/<index>/...)."""
+        with self._mu:
+            for key in [k for k in self._cold if k[0] == index]:
+                self._cold.pop(key, None)
+            for vkey in [v for v in self._cold_by_view if v[0] == index]:
+                self._cold_by_view.pop(vkey, None)
+            for key in [k for k in self._touch if k[0] == index]:
+                self._touch.pop(key, None)
+            for key in [k for k in self._watches if k[0] == index]:
+                self._watches.pop(key, None)
+        for key in [k for k in self._clean if k[0] == index]:
+            self._clean.pop(key, None)
+        self.policy.drop_index(index)
+        try:
+            return self.store.delete_prefix(index_prefix(index))
+        except StoreError as exc:
+            logger.warning("tier: object GC failed for %r: %s", index, exc)
+            return 0
+
+    def cold_count(self) -> int:
+        with self._mu:
+            return len(self._cold)
+
+    def index_summary(self) -> Dict[str, Dict[str, int]]:
+        """Per-index gauges for telemetry: cold fragment count + local
+        (host) bytes of the fragments still resident."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._mu:
+            for key in self._cold:
+                out.setdefault(key[0], {"cold_fragments": 0,
+                                        "local_bytes": 0})
+                out[key[0]]["cold_fragments"] += 1
+        for _view, frag in self._walk_fragments():
+            out.setdefault(frag.index, {"cold_fragments": 0,
+                                        "local_bytes": 0})
+            out[frag.index]["local_bytes"] += self._local_bytes(frag)
+        return out
+
+    def status(self) -> dict:
+        """The /internal/tier/status payload."""
+        with self._mu:
+            cold = [
+                {"index": k[0], "field": k[1], "view": k[2],
+                 "shard": k[3], "bytes": int(m.get("bytes") or 0)}
+                for k, m in sorted(self._cold.items())
+            ]
+        return {
+            "placementDefault": self.policy.default,
+            "placementOverrides": self.policy.to_entries(),
+            "demoteAfter": self.demote_after,
+            "hostBudgetBytes": self.host_budget_bytes,
+            "coldFragments": cold,
+            "counters": self.counters(),
+        }

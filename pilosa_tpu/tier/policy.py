@@ -1,0 +1,113 @@
+"""Per-index tier placement policy.
+
+Three placements, one per index (docs/configuration.md "Tiered
+storage"):
+
+  hot  — host row store + HBM extents; never auto-demoted.
+  warm — host row store only; the demote ticker sheds its device
+         extents, and budget pressure may push it to the object store
+         after every cold candidate is gone.
+  cold — object-store resident once idle: fragments untouched for
+         `demote-after` seconds upload their snapshot objects and drop
+         the local copy; the first query hydrates them back on demand.
+
+The default placement applies to EVERY index; `overrides` entries of the
+form "index:placement=cold" replace it per index (the same entry grammar
+as [tenants] overrides, parsed once at boot and adjustable at runtime
+via the placement endpoint)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from pilosa_tpu.utils.locks import TrackedLock
+
+PLACEMENT_HOT = "hot"
+PLACEMENT_WARM = "warm"
+PLACEMENT_COLD = "cold"
+PLACEMENTS = (PLACEMENT_HOT, PLACEMENT_WARM, PLACEMENT_COLD)
+
+
+def validate_placement(value: str) -> str:
+    v = (value or "").strip().lower()
+    if v not in PLACEMENTS:
+        raise ValueError(
+            f"placement must be one of {'/'.join(PLACEMENTS)}, got {value!r}"
+        )
+    return v
+
+
+def parse_overrides(entries: Optional[Iterable[str]]) -> Dict[str, str]:
+    """Parse "index:placement=cold[;...]" entries (the [tenants] override
+    grammar; only the `placement` knob exists here). Unknown knobs and
+    malformed entries raise — a typo'd override silently defaulting an
+    index hot would defeat the capacity plan it encodes."""
+    out: Dict[str, str] = {}
+    for entry in entries or ():
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(
+                f"tier override {entry!r} must be 'index:placement=<p>'"
+            )
+        index, knobs = entry.split(":", 1)
+        index = index.strip()
+        if not index:
+            raise ValueError(f"tier override {entry!r} names no index")
+        for kv in knobs.split(";"):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"tier override {entry!r}: {kv!r} is not knob=value"
+                )
+            knob, value = (s.strip() for s in kv.split("=", 1))
+            if knob != "placement":
+                raise ValueError(
+                    f"tier override {entry!r}: unknown knob {knob!r} "
+                    "(only 'placement')"
+                )
+            out[index] = validate_placement(value)
+    return out
+
+
+class TierPolicy:
+    """Resolved placement per index: default + overrides, runtime
+    adjustable (the /internal/tier/placement endpoint) and GC'd with the
+    index."""
+
+    def __init__(
+        self,
+        default: str = PLACEMENT_HOT,
+        overrides: Optional[Iterable[str]] = None,
+    ):
+        self.default = validate_placement(default)
+        self._mu = TrackedLock("tier.policy_mu")
+        self._overrides: Dict[str, str] = parse_overrides(overrides)
+
+    def placement(self, index: str) -> str:
+        with self._mu:
+            return self._overrides.get(index, self.default)
+
+    def set_override(self, index: str, placement: str) -> None:
+        placement = validate_placement(placement)
+        with self._mu:
+            self._overrides[index] = placement
+
+    def drop_index(self, index: str) -> None:
+        with self._mu:
+            self._overrides.pop(index, None)
+
+    def overrides_snapshot(self) -> Dict[str, str]:
+        with self._mu:
+            return dict(self._overrides)
+
+    def to_entries(self) -> List[str]:
+        """Back to the config entry grammar (for /internal/tier/status)."""
+        with self._mu:
+            return [
+                f"{idx}:placement={p}"
+                for idx, p in sorted(self._overrides.items())
+            ]

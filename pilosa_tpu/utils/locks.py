@@ -455,3 +455,14 @@ def TrackedCondition(
     if lock is None:
         lock = TrackedRLock(name)
     return threading.Condition(lock)  # type: ignore[arg-type]
+
+
+def TrackedSemaphore(name: str, value: int = 1) -> threading.BoundedSemaphore:
+    """Bounded counting semaphore. Semaphores are resource gates, not
+    mutexes — acquisition order between instances carries no deadlock
+    meaning, so there is no order-tracked variant; the factory exists so
+    every concurrency primitive is constructed here (LOCK001) and holds
+    stay discoverable by name. Never hold one across another primitive's
+    wait."""
+    _ = name  # reserved for a future held-set integration
+    return threading.BoundedSemaphore(value)

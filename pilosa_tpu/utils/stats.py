@@ -173,6 +173,23 @@ STAT_NAMES = frozenset(
         "resize.cutover_ms",
         "resize.cutover_rejects",
         "resize.aborts",
+        # tiered storage (pilosa_tpu/tier/): demotion to the object
+        # store, on-demand hydration (fetches counts STORE round trips —
+        # the single-flight assertion reads it), snapshot-based joiner
+        # bootstrap (compared against resize.bytes_streamed), and the
+        # anti-entropy snapshot sync; plus per-index cold-set gauges
+        "tier.demotions",
+        "tier.demote_bytes",
+        "tier.demote_aborts",
+        "tier.hydrations",
+        "tier.fetches",
+        "tier.fetch_bytes",
+        "tier.bootstrap_objects",
+        "tier.bootstrap_bytes",
+        "tier.ae_repairs",
+        "tier.sync_uploads",
+        "tier.cold_fragments",
+        "tier.local_bytes",
     }
 )
 
@@ -215,6 +232,8 @@ STAT_LABELS: Dict[str, Tuple[str, ...]] = {
     "tenant.cache_quota_bytes": ("index",),
     "tenant.inflight_quota_bytes": ("index",),
     "tenant.quota_evictions": ("cache", "index"),
+    "tier.cold_fragments": ("index",),
+    "tier.local_bytes": ("index",),
     "mesh.fallback": ("reason",),
     # federation meta-gauges (server/telemetry.py writes these into the
     # merged registry directly; the "cluster." prefix covers the names)

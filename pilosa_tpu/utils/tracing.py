@@ -85,6 +85,13 @@ SPAN_NAMES = frozenset(
         # the coordinator's atomic topology cutover: schema refresh to
         # joiners + the required-ack install broadcast
         "resize.cutover",
+        # tiered storage (pilosa_tpu/tier/manager.py): one fragment
+        # demotion — snapshot upload, capture drain, local eviction;
+        # tags: index / shard / bytes / reason (idle, budget, manual)
+        "tier.demote",
+        # one single-flight cold-fragment hydration — object fetch,
+        # checksum verify, adopt; tags: index / shard / bytes
+        "tier.hydrate",
     }
 )
 

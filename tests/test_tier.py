@@ -1,0 +1,497 @@
+"""Tiered storage (ISSUE 18): demote/hydrate protocol, single-flight
+gate, anti-entropy over snapshot objects, index-delete GC, beyond-budget
+serving, the /internal/tier/* control surface, and the snapshot-
+bootstrap byte counter-assert.
+
+Reference model: the tier plane composes existing machinery — the
+`begin_streaming` capture-during-serialize consistency point
+(core/fragment.py), the devcache single-flight build idiom, and the
+resize transfer legs — so these tests pin the COMPOSITION contracts:
+upload-durable-before-delete, write-races-upload aborts, exactly one
+store fetch per cold key under concurrency, and bootstrap bytes moving
+store-side instead of peer-side."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.server.node import NodeServer
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import ClusterHarness
+from pilosa_tpu.tier import TierManager, TierPolicy
+from pilosa_tpu.tier.store import MemoryStore, ObjectCorrupt
+
+
+def http_json(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else {}
+
+
+def wait_job(uri, want="DONE", timeout=60.0):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        job = http_json("GET", f"{uri}/cluster/resize/job")
+        if job["state"] != "RUNNING":
+            assert job["state"] == want, job
+            return job
+        time.sleep(0.05)
+    raise AssertionError("resize job did not finish")
+
+
+def make_holder(tmp_path=None):
+    h = Holder(None if tmp_path is None else str(tmp_path)).open()
+    idx = h.create_index_if_not_exists("t")
+    f = idx.create_field_if_not_exists("f", FieldOptions())
+    return h, f
+
+
+def import_shards(f, n_shards, row=0, salt=1):
+    cols = [s * SHARD_WIDTH + salt + (s % 7) for s in range(n_shards)]
+    f.import_bits(np.array([row] * len(cols), np.uint64),
+                  np.array(cols, np.uint64))
+    return cols
+
+
+def make_tier(holder, store=None, placement="cold", **kw):
+    store = store if store is not None else MemoryStore()
+    return store, TierManager(store, TierPolicy(placement), holder, **kw)
+
+
+# ---------------------------------------------------------------------------
+# demote -> hydrate round trip
+# ---------------------------------------------------------------------------
+
+
+def test_demote_hydrate_bit_identical(tmp_path):
+    """Every demoted fragment's hydrated state equals its pre-demote
+    bytes exactly; while cold, the shard stays AVAILABLE (queries
+    hydrate on access) and its local files are gone."""
+    h, f = make_holder(tmp_path)
+    cols = import_shards(f, 3)
+    v = f.views["standard"]
+    shards = sorted(v.fragments)
+    before = {s: v.fragments[s].to_bytes() for s in shards}
+    store, tier = make_tier(h)
+
+    for s in shards:
+        assert tier.demote_fragment(v, v.fragments[s]) is True
+    assert v.fragments == {}
+    assert tier.cold_count() == len(shards)
+    # cold shards still count as available: a demote must never shrink
+    # a query's shard span
+    assert v.available_shards() == shards
+    # the store holds object + manifest per fragment
+    assert len(store.list("snap/t/f/standard/")) == 2 * len(shards)
+
+    got = sorted(int(c) for c in v.row_positions(0))
+    assert got == sorted(cols)
+    assert tier.cold_count() == 0
+    for s in shards:
+        assert v.fragments[s].to_bytes() == before[s], s
+    c = tier.counters()
+    assert c["demotions"] == len(shards)
+    assert c["hydrations"] == len(shards)
+    assert c["demote_bytes"] == sum(len(b) for b in before.values())
+
+
+def test_demote_deletes_local_files(tmp_path):
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+    frag = v.fragments[0]
+    frag.snapshot()
+    paths = [p for p in (frag.snap_path, frag.wal_path, frag.cache_path)
+             if p is not None]
+    import os
+
+    assert any(os.path.exists(p) for p in paths)
+    _store, tier = make_tier(h)
+    assert tier.demote_fragment(v, frag)
+    assert not any(os.path.exists(p) for p in paths)
+
+
+def test_hydrate_single_flight_exactly_one_fetch(tmp_path):
+    """N concurrent cold readers coalesce on ONE store fetch (the
+    acceptance counter-assert): the winner fetches, everyone else waits
+    on the condvar and reads the adopted fragment."""
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+    _store, tier = make_tier(h)
+    before = v.fragments[0].to_bytes()
+    assert tier.demote_fragment(v, v.fragments[0])
+
+    start = threading.Barrier(8)
+    results, errors = [], []
+
+    def reader():
+        try:
+            start.wait()
+            frag = tier.hydrate(v, 0)
+            results.append(frag.to_bytes())
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    assert len(results) == 8
+    assert all(b == before for b in results)
+    c = tier.counters()
+    assert c["fetches"] == 1, c
+    assert c["hydrations"] == 1, c
+
+
+def test_demote_aborts_when_write_races_upload(tmp_path):
+    """A write landing DURING the upload voids the object: the armed
+    capture sees it at the post-upload drain check and the demote
+    aborts — fragment stays local, writes unblocked, and a later quiet
+    demote succeeds with the raced write included."""
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+
+    class RacingStore(MemoryStore):
+        fired = False
+
+        def put(self, key, data):
+            if not self.fired and not key.endswith("/LATEST"):
+                self.fired = True
+                v.fragments[0].set_bit(5, 123)
+            super().put(key, data)
+
+    store, tier = make_tier(h, store=RacingStore())
+    frag = v.fragments[0]
+    assert tier.demote_fragment(v, frag) is False
+    assert tier.counters()["demote_aborts"] == 1
+    assert 0 in v.fragments and tier.cold_count() == 0
+    # the write window reopened: more writes land fine
+    assert frag.set_bit(6, 7)
+    before = frag.to_bytes()
+    # quiet retry succeeds and the stored object carries both writes
+    assert tier.demote_fragment(v, frag) is True
+    hydrated = tier.hydrate(v, 0)
+    assert hydrated.to_bytes() == before
+    got = hydrated.row_positions(5)
+    assert 123 in got.tolist()
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy over snapshot objects
+# ---------------------------------------------------------------------------
+
+
+def test_sync_uploads_missing_and_stale_snapshots(tmp_path):
+    h, f = make_holder(tmp_path)
+    import_shards(f, 2)
+    v = f.views["standard"]
+    store, tier = make_tier(h)
+    r = tier.sync_snapshots()
+    assert r["uploaded"] == 2 and r["repaired"] == 0
+    # no-op when current (the (version, checksum) memo short-circuits)
+    r = tier.sync_snapshots()
+    assert r["uploaded"] == 0
+    # a write makes one stale: exactly that one re-uploads
+    v.fragments[0].set_bit(3, 3)
+    r = tier.sync_snapshots()
+    assert r["uploaded"] == 1
+    assert tier.counters()["sync_uploads"] == 3
+
+
+def test_deep_sync_detects_and_repairs_corrupt_object(tmp_path):
+    """AE over objects (satellite): a checksum mismatch on the stored
+    bytes is detected by the deep pass and repaired from the live
+    fragment; a hydrate of the repaired object verifies clean."""
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+    store, tier = make_tier(h)
+    tier.sync_snapshots()
+    (okey,) = [k for k in store.list("snap/") if not k.endswith("/LATEST")]
+    # bit-rot the stored object in place
+    store._objects[okey] = b"\x00" + store._objects[okey][1:]
+    meta = json.loads(store.get(
+        "snap/t/f/standard/0/LATEST").decode("utf-8"))
+    with pytest.raises(ObjectCorrupt):
+        tier._fetch_verified(meta)
+    r = tier.sync_snapshots(deep=True)
+    assert r["repaired"] == 1
+    assert tier.counters()["ae_repairs"] == 1
+    # repaired: fetch now verifies, and a demote->hydrate round-trips
+    before = v.fragments[0].to_bytes()
+    assert tier.demote_fragment(v, v.fragments[0])
+    assert tier.hydrate(v, 0).to_bytes() == before
+
+
+# ---------------------------------------------------------------------------
+# beyond-budget serving (the capacity lever)
+# ---------------------------------------------------------------------------
+
+
+def test_budget_pressure_demotes_lru_and_queries_still_answer(tmp_path):
+    """With host-budget-bytes below the corpus size, the ticker demotes
+    LRU until the local set fits — and queries keep answering correctly
+    by hydrating on demand (beyond-RAM acceptance shape)."""
+    h, f = make_holder(tmp_path)
+    cols = import_shards(f, 4)
+    v = f.views["standard"]
+    for frag in v.fragments.values():
+        frag.snapshot()  # materialize .snap so local bytes are real
+    _store, tier = make_tier(h, host_budget_bytes=1)
+    demoted = tier.demote_tick()
+    assert demoted >= 3  # nearly everything left; budget is 1 byte
+    assert tier.cold_count() == demoted
+    got = sorted(int(c) for c in v.row_positions(0))
+    assert got == sorted(cols)
+
+
+def test_hot_placement_never_auto_demotes(tmp_path):
+    h, f = make_holder(tmp_path)
+    import_shards(f, 2)
+    v = f.views["standard"]
+    for frag in v.fragments.values():
+        frag.snapshot()
+    _store, tier = make_tier(h, placement="hot", host_budget_bytes=1)
+    assert tier.demote_tick() == 0
+    assert tier.cold_count() == 0
+    assert len(v.fragments) == 2
+
+
+def test_load_cold_set_skips_keys_with_local_copies(tmp_path):
+    """Self-describing recovery: a manifest whose fragment still has a
+    local copy is NOT cold (the kill-before-delete window), while one
+    without is (the kill-mid-hydration window)."""
+    h, f = make_holder(tmp_path)
+    import_shards(f, 2)
+    v = f.views["standard"]
+    store, tier = make_tier(h)
+    tier.sync_snapshots()  # both keys have stored objects, both local
+    assert tier.demote_fragment(v, v.fragments[0])  # shard 0 cold
+
+    # a fresh manager over the same holder+store (restart analog)
+    _, tier2 = make_tier(h, store=store)
+    assert tier2.load_cold_set() == 1
+    assert tier2.cold_count() == 1
+    assert tier2.is_cold(v, 0) and not tier2.is_cold(v, 1)
+
+
+# ---------------------------------------------------------------------------
+# HTTP control surface + param coercion (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tiered_node():
+    with ClusterHarness(1, in_memory=True, tier_store=MemoryStore(),
+                        tier_placement="cold") as c:
+        api = c[0].api
+        api.create_index("ti")
+        api.create_field("ti", "f", {"type": "set"})
+        cols = [s * SHARD_WIDTH + 2 for s in range(3)]
+        api.import_bits("ti", "f", [0] * len(cols), cols)
+        yield c, cols
+
+
+def _demote_params(shard=0):
+    return f"index=ti&field=f&shard={shard}"
+
+
+def test_tier_http_demote_status_hydrate(tiered_node):
+    c, cols = tiered_node
+    uri = c[0].node.uri
+    r = http_json("POST", f"{uri}/internal/tier/demote?{_demote_params(0)}")
+    assert r == {"demoted": True, "cold": True}
+    st = http_json("GET", f"{uri}/internal/tier/status")
+    assert st["placementDefault"] == "cold"
+    assert [cf["shard"] for cf in st["coldFragments"]] == [0]
+    assert st["counters"]["demotions"] == 1
+    # a query over the cold shard hydrates and answers exactly
+    (cnt,) = c[0].api.query("ti", "Count(Row(f=0))")
+    assert cnt == len(cols)
+    st = http_json("GET", f"{uri}/internal/tier/status")
+    assert st["coldFragments"] == []
+    assert st["counters"]["hydrations"] == 1
+    # explicit prewarm of a re-demoted shard
+    http_json("POST", f"{uri}/internal/tier/demote?{_demote_params(1)}")
+    r = http_json("POST", f"{uri}/internal/tier/hydrate?{_demote_params(1)}")
+    assert r == {"hydrated": True, "cold": False}
+
+
+def test_tier_http_placement_roundtrip(tiered_node):
+    c, _cols = tiered_node
+    uri = c[0].node.uri
+    r = http_json("POST", f"{uri}/internal/tier/placement",
+                  {"index": "ti", "placement": "hot"})
+    assert r == {"index": "ti", "placement": "hot"}
+    st = http_json("GET", f"{uri}/internal/tier/status")
+    assert st["placementOverrides"] == ["ti:placement=hot"]
+    # clearing restores the default
+    r = http_json("POST", f"{uri}/internal/tier/placement",
+                  {"index": "ti", "placement": ""})
+    assert r == {"index": "ti", "placement": "cold"}
+
+
+def _expect_400(url, body=None, method="POST"):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        http_json(method, url, body)
+    assert ei.value.code == 400, ei.value.code
+    return json.loads(ei.value.read().decode("utf-8"))
+
+
+def test_tier_http_param_coercion_names_the_param(tiered_node):
+    """Malformed /internal/tier/* params -> 400 JSON naming the
+    parameter (the handler coercion satellite)."""
+    c, _cols = tiered_node
+    uri = c[0].node.uri
+    # missing required param
+    err = _expect_400(f"{uri}/internal/tier/demote?field=f&shard=0")
+    assert "index" in err["error"]
+    # non-integer shard
+    err = _expect_400(f"{uri}/internal/tier/demote?index=ti&field=f&shard=abc")
+    assert "shard" in err["error"]
+    # hydrate shares the same coercion
+    err = _expect_400(f"{uri}/internal/tier/hydrate?index=ti&field=f")
+    assert "shard" in err["error"]
+    # placement: bad value, wrong type, non-dict body
+    err = _expect_400(f"{uri}/internal/tier/placement",
+                      {"index": "ti", "placement": "lukewarm"})
+    assert "placement" in err["error"]
+    err = _expect_400(f"{uri}/internal/tier/placement",
+                      {"index": "ti", "placement": 3})
+    assert "placement" in err["error"]
+    err = _expect_400(f"{uri}/internal/tier/placement", ["not", "a", "dict"])
+    assert "body" in err["error"]
+    # sync: non-boolean deep
+    err = _expect_400(f"{uri}/internal/tier/sync?deep=maybe")
+    assert "deep" in err["error"]
+    # unknown index/field -> 404, not 500
+    for bad in (f"{uri}/internal/tier/demote?index=nope&field=f&shard=0",
+                f"{uri}/internal/tier/demote?index=ti&field=nope&shard=0"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_json("POST", bad)
+        assert ei.value.code == 404
+
+
+def test_tier_endpoints_404_when_untiered():
+    """Control endpoints 404 on a node without a store — EXCEPT offer,
+    which answers {"mode": "stream"} so mixed clusters degrade."""
+    with ClusterHarness(1, in_memory=True) as c:
+        uri = c[0].node.uri
+        for path in ("/internal/tier/status",):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_json("GET", f"{uri}{path}")
+            assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_json("POST", f"{uri}/internal/tier/sync")
+        assert ei.value.code == 404
+        r = http_json(
+            "GET",
+            f"{uri}/internal/tier/offer?index=x&field=f&shard=0&tag=t1",
+        )
+        assert r == {"mode": "stream"}
+
+
+# ---------------------------------------------------------------------------
+# index-delete GC (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_index_delete_gc_removes_objects_and_series():
+    store = MemoryStore()
+    with ClusterHarness(1, in_memory=True, tier_store=store,
+                        tier_placement="cold") as c:
+        api = c[0].api
+        api.create_index("gone")
+        api.create_field("gone", "f", {"type": "set"})
+        api.import_bits("gone", "f", [0, 0], [1, SHARD_WIDTH + 1])
+        uri = c[0].node.uri
+        http_json("POST", f"{uri}/internal/tier/demote?"
+                          "index=gone&field=f&shard=0")
+        assert store.list("snap/gone/")
+        c[0].publish_cache_gauges()
+        snap = c[0].stats.registry.snapshot()
+        assert any(k.startswith("tier.cold_fragments") and "gone" in k
+                   for k in snap), sorted(snap)
+
+        api.delete_index("gone")
+        # stored objects swept with the index
+        assert store.list("snap/gone/") == []
+        assert c[0].tier.cold_count() == 0
+        # per-index series GC'd from the registry
+        c[0].publish_cache_gauges()
+        snap = c[0].stats.registry.snapshot()
+        assert not any("gone" in k for k in snap
+                       if k.startswith("tier.")), sorted(snap)
+
+
+# ---------------------------------------------------------------------------
+# snapshot bootstrap (acceptance: fewer peer-streamed bytes)
+# ---------------------------------------------------------------------------
+
+
+def _join_and_measure(tier_store=None):
+    """Grow a 2-node cluster by one joiner; return (joiner peer-streamed
+    bytes, joiner tier bootstrap bytes, per-node row columns)."""
+    kwargs = {}
+    if tier_store is not None:
+        kwargs = {"tier_store": tier_store}
+    with ClusterHarness(2, in_memory=True, **kwargs) as c:
+        api = c[0].api
+        api.create_index("bs")
+        api.create_field("bs", "f", {"type": "set"})
+        cols = [s * SHARD_WIDTH + 11 for s in range(24)]
+        api.import_bits("bs", "f", [0] * len(cols), cols)
+        if tier_store is not None:
+            # the store must mirror local state for offers to say
+            # "snapshot" (the AE sync pass a real deployment runs)
+            for s in c.nodes:
+                s.tier.sync_snapshots()
+        joiner = NodeServer(None, "bs-joiner", **kwargs).start()
+        try:
+            http_json("POST", f"{c[0].node.uri}/cluster/join",
+                      {"id": joiner.node.id, "uri": joiner.node.uri})
+            wait_job(c[0].node.uri, timeout=120)
+            snap = joiner.stats.registry.snapshot()
+            streamed = snap.get("resize.bytes_streamed", 0)
+            boot = (joiner.tier.counters()["bootstrap_bytes"]
+                    if joiner.tier is not None else 0)
+            rows = []
+            for s in [c[0], c[1], joiner]:
+                (cnt,) = s.api.query("bs", "Count(Row(f=0))")
+                rows.append(cnt)
+            return streamed, boot, rows, len(cols)
+        finally:
+            joiner.stop()
+
+
+def test_snapshot_bootstrap_moves_fewer_peer_bytes():
+    """The tentpole acceptance counter-assert, both paths: an untiered
+    join peer-streams every byte (resize.bytes_streamed > 0, no
+    bootstrap); a tiered join with a synced store fetches objects
+    instead (tier.bootstrap_bytes > 0, measurably fewer peer-streamed
+    bytes) — and both converge bit-identically."""
+    streamed_plain, boot_plain, rows, n = _join_and_measure(None)
+    assert rows == [n, n, n]
+    assert streamed_plain > 0
+    assert boot_plain == 0
+
+    streamed_tier, boot_tier, rows, n = _join_and_measure(MemoryStore())
+    assert rows == [n, n, n]
+    assert boot_tier > 0
+    assert streamed_tier < streamed_plain, (streamed_tier, streamed_plain)

@@ -1,0 +1,227 @@
+"""Tier fault injection (ISSUE 18 satellite): deterministic object-store
+chaos rules (error / slow / torn-object / missing-object) and the
+SIGKILL kill matrix at the two protocol windows
+(tier.demote.pre_delete, tier.hydrate.pre_apply).
+
+The matrix follows tests/test_crashkill.py's idiom: a real OS process
+(tests/tier_crash_worker.py) arms a FaultInjector "kill" store rule at
+one exact point and dies there; the parent audits the survivor state —
+bit-identical to the deterministic corpus, every acked write present —
+by reopening the holder + store. The subprocess matrix is @slow (CI
+runs it in the mesh job next to the WAL kill matrix); the in-process
+rule tests ride tier-1."""
+
+import importlib.util
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.server import faults
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.tier import TierManager, TierPolicy
+from pilosa_tpu.tier.store import (
+    LocalDirStore,
+    MemoryStore,
+    ObjectCorrupt,
+    ObjectMissing,
+    StoreError,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_WORKER = os.path.join(_HERE, "tier_crash_worker.py")
+
+_spec = importlib.util.spec_from_file_location("tier_crash_worker", _WORKER)
+tier_crash_worker = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tier_crash_worker)
+
+
+@pytest.fixture()
+def injector():
+    inj = faults.FaultInjector(seed=3)
+    faults.install_injector(inj)
+    try:
+        yield inj
+    finally:
+        faults.uninstall_injector()
+
+
+def _tiered_holder(tmp_path, store=None):
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index_if_not_exists("t")
+    f = idx.create_field_if_not_exists("f", FieldOptions())
+    cols = [s * SHARD_WIDTH + 3 for s in range(2)]
+    f.import_bits(np.array([0] * len(cols), np.uint64),
+                  np.array(cols, np.uint64))
+    store = store if store is not None else MemoryStore()
+    tier = TierManager(store, TierPolicy("cold"), h)
+    return h, f.views["standard"], store, tier
+
+
+# ---------------------------------------------------------------------------
+# in-process store rules (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_error_rule_aborts_demote_then_heals(tmp_path, injector):
+    h, v, _store, tier = _tiered_holder(tmp_path)
+    injector.add_store_rule("error", point="store.put")
+    frag = v.fragments[0]
+    before = frag.to_bytes()
+    assert tier.demote_fragment(v, frag) is False
+    assert tier.counters()["demote_aborts"] == 1
+    assert injector.count("error") == 1
+    # aborted demote leaves the fragment fully live: writes + reads work
+    assert 0 in v.fragments and v.fragments[0].to_bytes() == before
+    injector.heal()
+    assert tier.demote_fragment(v, v.fragments[0]) is True
+    assert tier.hydrate(v, 0).to_bytes() == before
+
+
+def test_missing_object_rule_fails_hydrate_key_stays_cold(tmp_path, injector):
+    h, v, _store, tier = _tiered_holder(tmp_path)
+    before = v.fragments[0].to_bytes()
+    assert tier.demote_fragment(v, v.fragments[0])
+    injector.add_store_rule("missing-object", point="store.get")
+    with pytest.raises(ObjectMissing):
+        tier.hydrate(v, 0)
+    # the key is STILL cold: nothing local was written, so a healed
+    # retry recovers everything
+    assert tier.is_cold(v, 0)
+    injector.heal()
+    assert tier.hydrate(v, 0).to_bytes() == before
+
+
+def test_torn_object_rule_detected_as_corrupt(tmp_path, injector):
+    """A torn GET (prefix of the object) must fail the checksum check
+    loudly — hydrating a prefix would be silent data loss."""
+    h, v, _store, tier = _tiered_holder(tmp_path)
+    before = v.fragments[0].to_bytes()
+    assert tier.demote_fragment(v, v.fragments[0])
+    injector.add_store_rule("torn-object", point="store.get", times=1)
+    with pytest.raises(ObjectCorrupt):
+        tier.hydrate(v, 0)
+    assert tier.is_cold(v, 0)
+    assert tier.hydrate(v, 0).to_bytes() == before  # rule exhausted
+
+
+def test_torn_put_repaired_by_deep_sync(tmp_path, injector):
+    """A torn PUT persists a truncated object; the deep anti-entropy
+    pass detects the checksum mismatch and re-uploads from the live
+    fragment."""
+    h, v, store, tier = _tiered_holder(tmp_path)
+    injector.add_store_rule("torn-object", point="store.put", key="snap/",
+                            times=1)
+    r = tier.sync_snapshots()
+    assert r["uploaded"] == 2
+    injector.heal()
+    # the torn object fails deep verification and is repaired
+    r = tier.sync_snapshots(deep=True)
+    assert r["repaired"] == 1
+    assert tier.counters()["ae_repairs"] == 1
+    r = tier.sync_snapshots(deep=True)
+    assert r["repaired"] == 0
+
+
+def test_slow_rule_delays_store_ops(tmp_path, injector):
+    h, v, _store, tier = _tiered_holder(tmp_path)
+    assert tier.demote_fragment(v, v.fragments[0])
+    injector.add_store_rule("slow", point="store.get", delay=0.25)
+    t0 = time.monotonic()
+    tier.hydrate(v, 0)
+    assert time.monotonic() - t0 >= 0.25
+    assert injector.count("slow") >= 1
+
+
+def test_store_rules_validate_kind():
+    inj = faults.FaultInjector(seed=0)
+    with pytest.raises(ValueError):
+        inj.add_store_rule("explode")
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL kill matrix (slow; CI mesh job)
+# ---------------------------------------------------------------------------
+
+
+def _run_tier_worker(tmp_path, point):
+    data_dir = os.path.join(str(tmp_path), "data")
+    store_dir = os.path.join(str(tmp_path), "store")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, _WORKER, "--point", point,
+         "--data-dir", data_dir, "--store-dir", store_dir],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(_HERE),
+    )
+    # the injector must have SIGKILLed the worker inside the window —
+    # a clean exit means the point never fired and the test is vacuous
+    assert proc.returncode == -signal.SIGKILL, (
+        point, proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:],
+    )
+    assert "COMPLETED" not in proc.stdout, proc.stdout
+    assert "IMPORTED" in proc.stdout, proc.stdout
+    return data_dir, store_dir
+
+
+def _expected_rows():
+    rows, cols = tier_crash_worker.corpus_bits()
+    want = {}
+    for r, c in zip(rows.tolist(), cols.tolist()):
+        want.setdefault(r, set()).add(c)
+    return want
+
+
+def _assert_bit_identical(v):
+    for r, want in _expected_rows().items():
+        got = set(int(c) for c in v.row_positions(r))
+        assert got == want, f"row {r}: {len(got)} vs {len(want)} cols"
+
+
+@pytest.mark.slow
+def test_kill_at_demote_pre_delete_reopens_locally(tmp_path):
+    """SIGKILL after 'object durable + key registered cold' but before
+    the local delete: the restart finds the local copy intact, the cold
+    scan skips it (load_cold_set == 0), and every acked write survives
+    bit-identically. The stale stored object is harmless (the sync
+    pass refreshes it)."""
+    data_dir, store_dir = _run_tier_worker(tmp_path, "tier.demote.pre_delete")
+    # the upload itself completed before the kill
+    store = LocalDirStore(store_dir)
+    assert any(k.endswith("/LATEST") for k in store.list("snap/tc/"))
+
+    h, f, tier = tier_crash_worker.open_tiered(data_dir, store_dir)
+    assert tier.load_cold_set() == 0  # local copy wins over the object
+    v = f.views["standard"]
+    assert sorted(v.fragments) == list(range(tier_crash_worker.N_SHARDS))
+    _assert_bit_identical(v)
+    h.close()
+
+
+@pytest.mark.slow
+def test_kill_at_hydrate_pre_apply_stays_cold_then_converges(tmp_path):
+    """SIGKILL after the object fetch but before anything local exists:
+    the restart finds the key STILL cold, and a fresh hydration
+    converges bit-identically — no acked write lost across
+    demote + kill + restart + hydrate."""
+    data_dir, store_dir = _run_tier_worker(tmp_path, "tier.hydrate.pre_apply")
+
+    h, f, tier = tier_crash_worker.open_tiered(data_dir, store_dir)
+    n_cold = tier.load_cold_set()
+    assert n_cold == tier_crash_worker.N_SHARDS, n_cold
+    v = f.views["standard"]
+    assert v.fragments == {}  # nothing local survived the demotes
+    for shard in range(tier_crash_worker.N_SHARDS):
+        assert tier.is_cold(v, shard)
+    _assert_bit_identical(v)  # row reads hydrate every shard
+    assert tier.cold_count() == 0
+    assert tier.counters()["hydrations"] == tier_crash_worker.N_SHARDS
+    h.close()

@@ -1,0 +1,104 @@
+"""Tier kill-matrix worker: a real OS process SIGKILLed inside the
+demote/hydrate protocol windows.
+
+Launched by tests/test_tier_faults.py (NOT collected by pytest). The
+worker imports a deterministic corpus into an on-disk holder, then
+drives the tier protocol with a FaultInjector "kill" store rule armed
+at one exact protocol point:
+
+  tier.demote.pre_delete — the object is uploaded durably and the key
+      registered cold, but the LOCAL COPY IS STILL ON DISK. A restart
+      must reopen the fragment locally (the cold scan skips keys with
+      local copies) bit-identically — the stale object is harmless.
+
+  tier.hydrate.pre_apply — the object is fetched but NOTHING local
+      exists yet. A restart must find the key still cold and a fresh
+      hydration must converge bit-identically.
+
+All imports are acked (returned) before the kill window opens, so the
+parent's bit-identity assertion doubles as "no acked write lost".
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SHARDS = 2
+
+
+def corpus_bits():
+    """Deterministic corpus the parent regenerates to audit the
+    survivor state."""
+    import numpy as np
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(777)
+    n = 300
+    rows = rng.integers(0, 4, n).astype(np.uint64)
+    cols = rng.integers(0, N_SHARDS * SHARD_WIDTH, n).astype(np.uint64)
+    return rows, cols
+
+
+def open_tiered(data_dir, store_dir):
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.tier import TierManager, TierPolicy
+    from pilosa_tpu.tier.store import LocalDirStore
+
+    h = Holder(data_dir).open()
+    idx = h.create_index_if_not_exists("tc")
+    f = idx.create_field_if_not_exists("f", FieldOptions())
+    tier = TierManager(
+        LocalDirStore(store_dir), TierPolicy("cold"), h
+    )
+    return h, f, tier
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--point", required=True,
+                    choices=["tier.demote.pre_delete",
+                             "tier.hydrate.pre_apply"])
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--store-dir", required=True)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from pilosa_tpu.server import faults
+
+    h, f, tier = open_tiered(args.data_dir, args.store_dir)
+    rows, cols = corpus_bits()
+    f.import_bits(rows, cols)  # fully acked before any kill window
+    v = f.views["standard"]
+    print("IMPORTED", flush=True)
+
+    if args.point == "tier.hydrate.pre_apply":
+        # demote CLEANLY first; the kill targets the hydrate that follows
+        for shard in sorted(v.fragments):
+            assert tier.demote_fragment(v, v.fragments[shard]), shard
+        print("DEMOTED", flush=True)
+
+    inj = faults.FaultInjector(seed=0)
+    inj.add_store_rule("kill", point=args.point)
+    faults.install_injector(inj)
+
+    if args.point == "tier.demote.pre_delete":
+        # dies between "object durable + key cold" and "local delete"
+        shard = sorted(v.fragments)[0]
+        tier.demote_fragment(v, v.fragments[shard])
+    else:
+        # dies between "object fetched" and "anything local written"
+        tier.hydrate(v, 0)
+
+    print("COMPLETED", flush=True)  # the kill point never fired
+    faults.uninstall_injector()
+    h.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

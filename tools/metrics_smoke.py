@@ -153,6 +153,80 @@ def _tenant_overload(errors: list) -> None:
         )
 
 
+def _tier_smoke(errors: list) -> None:
+    """Tiered-storage scenario (ISSUE 18) on its own 1-node harness
+    backed by an in-memory object store: import, demote over HTTP, run
+    a COLD query (first read hydrates single-flight), then assert the
+    tier.* counter families and the per-index cold/local gauges render
+    on a lint-clean /metrics page with the values the protocol implies."""
+    from pilosa_tpu.testing import ClusterHarness
+    from pilosa_tpu.tier.store import MemoryStore
+
+    with ClusterHarness(
+        1, in_memory=True, metric_poll_interval=0.0,
+        telemetry_sample_interval=0.0,
+        tier_store=MemoryStore(), tier_placement="cold",
+    ) as cluster:
+        srv = cluster[0]
+        uri = srv.node.uri
+        srv.api.create_index("smoke_cold")
+        srv.api.create_field("smoke_cold", "f", {"type": "set"})
+        _post(
+            uri, "/index/smoke_cold/field/f/import",
+            {"rows": [1] * 16, "cols": list(range(16))},
+        )
+        resp = _post(uri, "/index/smoke_cold/query",
+                     {"query": "Count(Row(f=1))"})
+        assert resp["results"] == [16], resp
+        r = _post(uri, "/internal/tier/demote"
+                       "?index=smoke_cold&field=f&shard=0", {})
+        if not (r.get("demoted") and r.get("cold")):
+            errors.append(f"tier smoke: HTTP demote did not go cold: {r}")
+        st = json.loads(_get(uri, "/internal/tier/status"))
+        if len(st.get("coldFragments", [])) != 1:
+            errors.append(f"tier smoke: status coldFragments != 1: {st}")
+        # the COLD query: a shape the result cache has NOT seen (the
+        # warm Count above is cache-served after demote precisely
+        # because demotion changes no data), so its first read must
+        # hydrate (exactly one fetch) and still answer exactly
+        resp = _post(uri, "/index/smoke_cold/query",
+                     {"query": "Row(f=1)"})
+        assert resp["results"][0]["columns"] == list(range(16)), resp
+        tc = srv.tier.counters()
+        for name, want in (("demotions", 1), ("hydrations", 1),
+                           ("fetches", 1)):
+            if tc.get(name) != want:
+                errors.append(
+                    f"tier smoke: counter {name} = {tc.get(name)}, "
+                    f"expected {want} after demote + one cold query"
+                )
+        srv.publish_cache_gauges()
+        text = _get(uri, "/metrics")
+    for e in lint_against_registry(text):
+        errors.append(f"tier /metrics: {e}")
+    for fam, want_min in (
+        ("pilosa_tpu_tier_demotions", 1.0),
+        ("pilosa_tpu_tier_demote_bytes", 1.0),
+        ("pilosa_tpu_tier_hydrations", 1.0),
+        ("pilosa_tpu_tier_fetches", 1.0),
+        ("pilosa_tpu_tier_fetch_bytes", 1.0),
+    ):
+        m = re.search(rf"^{fam} ([0-9.e+-]+)", text, re.M)
+        if m is None:
+            errors.append(f"tier /metrics: {fam} missing")
+        elif float(m.group(1)) < want_min:
+            errors.append(
+                f"tier /metrics: {fam} = {m.group(1)}, expected >= "
+                f"{want_min}"
+            )
+    for fam in ("pilosa_tpu_tier_cold_fragments",
+                "pilosa_tpu_tier_local_bytes"):
+        if not re.search(rf'^{fam}\{{index="smoke_cold"\}} ', text, re.M):
+            errors.append(
+                f"tier /metrics: {fam}{{index=smoke_cold}} missing"
+            )
+
+
 def main() -> int:
     errors: list = []
     with ClusterHarness(
@@ -415,8 +489,19 @@ def main() -> int:
             "tenant limits configured"
         )
 
+    # the main harness is also UNTIERED: the tier.* families are
+    # opt-in and must not render at all
+    if re.search(r"^pilosa_tpu_tier_", node_text, re.M):
+        errors.append(
+            "node /metrics: tier.* series rendered without tiered "
+            "storage enabled"
+        )
+
     # multi-tenant QoS enforcement (ISSUE 16), on its own harness
     _tenant_overload(errors)
+
+    # tiered storage (ISSUE 18), on its own harness
+    _tier_smoke(errors)
 
     for e in errors:
         print(f"metrics-smoke: {e}")
