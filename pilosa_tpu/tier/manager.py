@@ -134,6 +134,10 @@ class TierManager:
         self._watches: Dict[Key, Dict[str, object]] = {}
         # keys with a demote in flight (demote is idempotent-per-key)
         self._demoting: Set[Key] = set()
+        # warm-placement keys whose device extents were already shed
+        # this idle episode (touch clears the mark) — the tick must not
+        # re-run the invalidation every interval the key stays idle
+        self._warm_shed: Set[Key] = set()
         # LRU clock: last access per key (hydrate, mutation, stack read);
         # unknown keys default to boot so a freshly started node does not
         # demote everything on its first tick
@@ -216,8 +220,28 @@ class TierManager:
                 manifest_key(*key),
                 json.dumps(meta, sort_keys=True).encode("utf-8"),
             )
-        self._clean[key] = (int(version), digest)
+        # NB: no _clean memo here — the caller must prove the
+        # (version, blob) pairing first (a write racing the serialize
+        # would pair the post-write version with the pre-write digest,
+        # and a poisoned memo makes offer() hand a joiner a snapshot
+        # that silently misses that write)
         return meta
+
+    def _upload_current(self, key: Key, frag) -> Optional[dict]:
+        """Serialize + upload a snapshot whose (version, checksum)
+        pairing is PROVEN: read the version, serialize, re-check. A
+        mismatch means a write — or the serialize's own staged-delta
+        sync — moved the fragment mid-proof; one retry absorbs the
+        staged-sync case, otherwise skip (the next sync pass catches
+        up) rather than memoize a poisoned pairing."""
+        for _ in range(2):
+            v = frag.version
+            blob = frag.to_bytes()
+            if frag.version == v:
+                meta = self._upload(key, blob, v)
+                self._clean[key] = (int(v), meta["checksum"])
+                return meta
+        return None
 
     def _fetch_verified(self, meta: dict) -> bytes:
         """Fetch + verify one snapshot object against the checksum in its
@@ -266,7 +290,13 @@ class TierManager:
             # later drain point
             tag = "tier-demote"
             blob = frag.begin_streaming(tag)
+            cold_registered = False
+            evicted_ok = False
             try:
+                # this read races writers, but the drain-dry check below
+                # proves no write landed since the serialize — which
+                # retroactively validates it; the _clean memo is only
+                # committed after that proof
                 version = frag.version
                 try:
                     meta = self._upload(key, blob, version)
@@ -289,6 +319,10 @@ class TierManager:
                     self._bump("demote_aborts")
                     span.set_tag("aborted", "write-raced-upload")
                     return False
+                # capture ran dry -> no write landed since the
+                # begin_streaming serialize, so `version` IS the
+                # serialize-point version: the memo pairing is proven
+                self._clean[key] = (int(version), meta["checksum"])
                 # 4. flip the key cold BEFORE detaching: a lookup arriving
                 # between detach and here would otherwise create a fresh
                 # EMPTY fragment that shadows the stored snapshot
@@ -296,6 +330,7 @@ class TierManager:
                     self._cold[key] = meta
                     self._cold_by_view.setdefault(key[:3], set()).add(key[3])
                     self._touch.pop(key, None)
+                cold_registered = True
                 view.cold_resolver = self
                 # 5. kill-matrix window: uploaded + registered, local copy
                 # still intact — SIGKILL here must reopen locally (the cold
@@ -309,12 +344,17 @@ class TierManager:
                 if not evicted:
                     # raced a delete_fragment: disarm and undo the cold
                     # registration (the deleted fragment's capture would
-                    # otherwise leak its tracked resource)
+                    # otherwise leak its tracked resource); drop the
+                    # memo too — a re-created fragment restarts its
+                    # version counter, so the pairing could collide with
+                    # a future same-version, different-content state
                     frag.end_capture(tag)
+                    self._clean.pop(key, None)
                     with self._mu:
                         self._cold.pop(key, None)
                         self._cold_by_view.get(key[:3], set()).discard(key[3])
                     return False
+                evicted_ok = True
                 self._bump("demotions")
                 self._bump("demote_bytes", len(blob))
                 span.set_tag("bytes", len(blob))
@@ -326,6 +366,17 @@ class TierManager:
                 # (end_capture is idempotent, so re-disarming after the
                 # evict already released it is harmless)
                 frag.end_capture(tag)
+                if cold_registered and not evicted_ok:
+                    # the key was flipped cold but the live fragment was
+                    # never evicted: left in place, demote_fragment would
+                    # permanently skip it and offer() would serve the
+                    # stale object as mode=cold while the fragment keeps
+                    # taking writes — roll the registration (and the now
+                    # unprovable memo) back before propagating
+                    self._clean.pop(key, None)
+                    with self._mu:
+                        self._cold.pop(key, None)
+                        self._cold_by_view.get(key[:3], set()).discard(key[3])
                 raise
 
     # -- View.cold_resolver protocol --------------------------------------
@@ -343,11 +394,15 @@ class TierManager:
         now = time.monotonic()
         with self._mu:
             for s in shards:
-                self._touch[self._view_key(view, s)] = now
+                key = self._view_key(view, s)
+                self._touch[key] = now
+                self._warm_shed.discard(key)
 
     def touch_fragment(self, frag) -> None:
+        key = self._frag_key(frag)
         with self._mu:
-            self._touch[self._frag_key(frag)] = time.monotonic()
+            self._touch[key] = time.monotonic()
+            self._warm_shed.discard(key)
 
     def resolve(self, view, shard: int):
         """View-side hook: return the hydrated fragment for a cold
@@ -429,6 +484,12 @@ class TierManager:
             self._cold.pop(key, None)
             self._cold_by_view.get(key[:3], set()).discard(key[3])
             self._touch[key] = time.monotonic()
+            self._warm_shed.discard(key)
+        # the adopted fragment's version counter restarted at open, so
+        # the memo taken against the demoted fragment's counter could
+        # collide with a future same-version, different-content state —
+        # drop it; the next sync pass re-proves currency by checksum
+        self._clean.pop(key, None)
         self._bump("hydrations")
         return frag
 
@@ -519,7 +580,7 @@ class TierManager:
             # half the idle threshold to free capacity faster
             self._evict_pressure_mark = evicted
             threshold = self.demote_after / 2.0
-        candidates: List[Tuple[float, str, object, object]] = []
+        candidates: List[Tuple[float, object, object, int]] = []
         local_total = 0
         for view, frag in self._walk_fragments():
             if view.cold_resolver is None:
@@ -535,32 +596,36 @@ class TierManager:
             key = self._frag_key(frag)
             with self._mu:
                 last = self._touch.get(key, self._boot_t)
+                shed_done = key in self._warm_shed
             idle = now - last
             if self.demote_after > 0 and idle >= threshold:
                 if placement == PLACEMENT_COLD:
-                    candidates.append((last, PLACEMENT_COLD, view, frag))
-                else:
+                    candidates.append((last, view, frag, size))
+                elif not shed_done:
                     # warm: host-only — shed the device extents covering
                     # this shard (version-keyed entries would re-stage on
-                    # next read anyway; this frees the HBM now)
+                    # next read anyway; this frees the HBM now). Once per
+                    # idle episode: a touch clears the mark, so the shed
+                    # does not re-fire every tick the fragment stays idle.
                     from pilosa_tpu.core.devcache import DEVICE_CACHE
 
                     DEVICE_CACHE.invalidate_owner_shard(
                         view._stack_token, frag.shard)
                     DEVICE_CACHE.invalidate_owner(frag._token)
+                    with self._mu:
+                        self._warm_shed.add(key)
         candidates.sort(key=lambda c: c[0])
-        for _last, _p, view, frag in candidates:
+        for _last, view, frag, size in candidates:
             if self.demote_fragment(view, frag, reason="idle"):
                 demoted += 1
-                local_total -= self._local_bytes_estimate(frag)
+                # the size measured during collection is what the demote
+                # just freed — subtracting it keeps the running total
+                # honest so budget pressure below does not over-demote
+                # against bytes that are already gone
+                local_total -= size
         if self.host_budget_bytes > 0 and local_total > self.host_budget_bytes:
             demoted += self._budget_pressure(now, local_total)
         return demoted
-
-    def _local_bytes_estimate(self, frag) -> int:
-        # after a demote the files are gone; the caller only needs the
-        # running total to go DOWN, so re-measuring (0) is fine
-        return 0
 
     def _budget_pressure(self, now: float, local_total: int) -> int:
         """Demote LRU until local bytes fit the host budget: cold
@@ -626,10 +691,9 @@ class TierManager:
             try:
                 meta = self._load_manifest(key)
                 if meta is None or self.fragment_is_current(frag, meta) is None:
-                    blob = frag.to_bytes()
-                    self._upload(key, blob, frag.version)
-                    self._bump("sync_uploads")
-                    uploaded += 1
+                    if self._upload_current(key, frag) is not None:
+                        self._bump("sync_uploads")
+                        uploaded += 1
                     continue
                 if deep:
                     try:
@@ -638,10 +702,9 @@ class TierManager:
                         # stored bytes diverged from their own checksum
                         # (torn put, bit rot): the live fragment is the
                         # source of truth — re-upload
-                        blob = frag.to_bytes()
-                        self._upload(key, blob, frag.version)
-                        self._bump("ae_repairs")
-                        repaired += 1
+                        if self._upload_current(key, frag) is not None:
+                            self._bump("ae_repairs")
+                            repaired += 1
             except StoreError as exc:
                 logger.warning("tier: sync failed for %s: %s", key, exc)
         return {"checked": checked, "uploaded": uploaded,
@@ -691,7 +754,13 @@ class TierManager:
         no longer cold — the caller must fall back to peer streaming,
         since writes may already have diverged it from the object."""
         with self._mu:
-            if key not in self._cold:
+            if key not in self._cold or key in self._hydrating:
+                # an in-flight hydration pops its watch dict (on_ready)
+                # and removes the cold entry in two separate critical
+                # sections: a watch registered in that window would
+                # never fire while the offer still said mode=cold — the
+                # joiner would sit on a capture that was never armed.
+                # Refuse; the caller falls back to peer streaming.
                 return False
             self._watches.setdefault(key, {})[tag] = callback
             return True
@@ -727,6 +796,8 @@ class TierManager:
                 self._touch.pop(key, None)
             for key in [k for k in self._watches if k[0] == index]:
                 self._watches.pop(key, None)
+            for key in [k for k in self._warm_shed if k[0] == index]:
+                self._warm_shed.discard(key)
         for key in [k for k in self._clean if k[0] == index]:
             self._clean.pop(key, None)
         self.policy.drop_index(index)

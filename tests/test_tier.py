@@ -239,9 +239,132 @@ def test_deep_sync_detects_and_repairs_corrupt_object(tmp_path):
     assert tier.hydrate(v, 0).to_bytes() == before
 
 
+def test_sync_memo_not_poisoned_by_write_racing_serialize(tmp_path):
+    """A write landing between the serialize and the version read must
+    not memoize (post-write version, pre-write digest): that pairing
+    would make fragment_is_current report the stale object as current —
+    offer() would hand a joiner object+delta that both miss the racing
+    write. The upload path re-proves version stability around the
+    serialize and retries, so the stored object ends up carrying the
+    raced write."""
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+    store, tier = make_tier(h)
+    frag = v.fragments[0]
+    real = frag.to_bytes
+    fired = []
+
+    def racing_to_bytes():
+        blob = real()
+        if not fired:
+            fired.append(1)
+            frag.set_bit(9, 99)  # lands after serialize, before the
+            # manager reads frag.version
+        return blob
+
+    frag.to_bytes = racing_to_bytes
+    try:
+        r = tier.sync_snapshots()
+    finally:
+        frag.to_bytes = real
+    assert fired and r["uploaded"] == 1
+    meta = json.loads(store.get(
+        "snap/t/f/standard/0/LATEST").decode("utf-8"))
+    ver = tier.fragment_is_current(frag, meta)
+    # claiming currency is only legal when the stored bytes truly match
+    # the live fragment (including the raced write)
+    assert ver is not None
+    assert store.get(meta["object"]) == real()
+
+
+def test_watch_hydration_refused_while_hydration_in_flight(tmp_path):
+    """A cold-mode bootstrap watch registered while a hydration is in
+    flight could land after on_ready popped the watch dict but before
+    the cold entry is removed — it would never fire while the offer
+    still said mode=cold. watch_hydration must refuse (the joiner falls
+    back to peer streaming)."""
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+    _store, tier = make_tier(h)
+    assert tier.demote_fragment(v, v.fragments[0])
+    key = ("t", "f", "standard", 0)
+    # cold and quiescent: the watch registers
+    assert tier.watch_hydration(key, "w0", lambda frag: None) is True
+    tier.unwatch("w0")
+    # cold with a hydration in flight: refused
+    with tier._mu:
+        tier._hydrating.add(key)
+    try:
+        assert tier.watch_hydration(key, "w1", lambda frag: None) is False
+    finally:
+        with tier._mu:
+            tier._hydrating.discard(key)
+    # hydrated (no longer cold): refused
+    tier.hydrate(v, 0)
+    assert tier.watch_hydration(key, "w2", lambda frag: None) is False
+
+
 # ---------------------------------------------------------------------------
 # beyond-budget serving (the capacity lever)
 # ---------------------------------------------------------------------------
+
+
+def test_idle_demotion_reduces_budget_total_without_overdemote(tmp_path):
+    """The bytes freed by an idle demotion must come off the running
+    local total BEFORE budget pressure runs — otherwise pressure chases
+    a total it can never reconcile (the demoted fragments are gone from
+    the walk) and demotes extra fragments from the live working set."""
+    import time as _time
+
+    h, f = make_holder(tmp_path)
+    import_shards(f, 3)
+    v = f.views["standard"]
+    for frag in v.fragments.values():
+        frag.snapshot()  # materialize .snap so local bytes are real
+    _store, tier = make_tier(h, demote_after=60.0)
+    tier._boot_t = _time.monotonic() - 3600.0  # shard 0 idle since boot
+    sizes = {s: tier._local_bytes(fr) for s, fr in v.fragments.items()}
+    assert all(sizes.values())
+    tier.touch_many(v, [1, 2])  # shards 1, 2 freshly active
+    # budget exactly fits the post-idle-demotion set: no pressure needed
+    tier.host_budget_bytes = sizes[1] + sizes[2]
+    assert tier.demote_tick() == 1
+    assert tier.cold_count() == 1
+    assert sorted(v.fragments) == [1, 2]
+
+
+def test_warm_shed_fires_once_per_idle_episode(tmp_path, monkeypatch):
+    """The warm-placement device shed must not re-run on every tick the
+    fragment stays idle (invalidation churn): it fires once, and only a
+    fresh touch re-arms it for the next idle episode."""
+    import time as _time
+
+    h, f = make_holder(tmp_path)
+    import_shards(f, 1)
+    v = f.views["standard"]
+    _store, tier = make_tier(h, placement="warm", demote_after=60.0)
+    tier._boot_t = _time.monotonic() - 3600.0  # idle since boot
+    from pilosa_tpu.core.devcache import DEVICE_CACHE
+
+    calls = []
+    monkeypatch.setattr(DEVICE_CACHE, "invalidate_owner_shard",
+                        lambda owner, shard: calls.append("shard"))
+    monkeypatch.setattr(DEVICE_CACHE, "invalidate_owner",
+                        lambda owner: calls.append("owner"))
+    assert tier.demote_tick() == 0  # warm never demotes, only sheds
+    first = len(calls)
+    assert first > 0
+    tier.demote_tick()  # still idle: no re-shed
+    assert len(calls) == first
+    frag = v.fragments[0]
+    tier.touch_fragment(frag)  # activity clears the mark...
+    key = tier._frag_key(frag)
+    with tier._mu:
+        tier._touch[key] = _time.monotonic() - 3600.0  # ...then idle again
+    tier.demote_tick()
+    assert len(calls) == 2 * first
 
 
 def test_budget_pressure_demotes_lru_and_queries_still_answer(tmp_path):

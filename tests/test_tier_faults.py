@@ -84,6 +84,28 @@ def test_error_rule_aborts_demote_then_heals(tmp_path, injector):
     assert tier.hydrate(v, 0).to_bytes() == before
 
 
+def test_error_at_pre_delete_rolls_back_cold_registration(tmp_path, injector):
+    """An error escaping the demote AFTER the key was flipped cold but
+    BEFORE the local fragment was evicted must roll the registration
+    back: left in place, demote_fragment would permanently skip the key
+    and offer() would serve the stale object as mode=cold while the
+    live fragment keeps taking writes."""
+    h, v, _store, tier = _tiered_holder(tmp_path)
+    frag = v.fragments[0]
+    injector.add_store_rule("error", point="tier.demote.pre_delete")
+    with pytest.raises(StoreError):
+        tier.demote_fragment(v, frag)
+    # fully rolled back: not cold, fragment live, writes land
+    assert not tier.is_cold(v, 0)
+    assert 0 in v.fragments
+    assert frag.set_bit(7, 11)
+    injector.heal()
+    # a healed retry demotes (not permanently skipped) and the stored
+    # object carries the post-abort write
+    assert tier.demote_fragment(v, v.fragments[0]) is True
+    assert 11 in tier.hydrate(v, 0).row_positions(7).tolist()
+
+
 def test_missing_object_rule_fails_hydrate_key_stays_cold(tmp_path, injector):
     h, v, _store, tier = _tiered_holder(tmp_path)
     before = v.fragments[0].to_bytes()
