@@ -336,6 +336,161 @@ def tier_bench():
         shutil.rmtree(base, ignore_errors=True)
 
 
+def coherence_bench():
+    """Coherence-plane families (ISSUE 19): the leased fan-out warm hit
+    against the wire-revalidate baseline (version-RTT counter deltas
+    reported for both — the leased number is asserted ZERO), the
+    write-to-delivery latency of subscription pushes, and the in-place
+    monotone tree repair of a cached Intersect — each result asserted
+    equal to a from-scratch recompute."""
+    import numpy as np
+
+    from pilosa_tpu.core.resultcache import RESULT_CACHE
+    from pilosa_tpu.exec import plan as planmod_x
+    from pilosa_tpu.server import wire
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.testing import ClusterHarness
+
+    n_shards = 8
+    reps = 30
+    q = "Count(Row(f=1))"
+
+    def seed(api):
+        api.create_index("cx")
+        api.create_field("cx", "f", {"type": "set"})
+        rng = np.random.default_rng(17)
+        for r in (1, 2):
+            cols = rng.integers(0, n_shards * SHARD_WIDTH, 50_000).astype(
+                np.uint64
+            )
+            api.import_bits(
+                "cx", "f", np.full(len(cols), r, np.uint64), cols
+            )
+
+    out = {}
+    # revalidate baseline: leases off, every warm fan-out hit pays the
+    # /internal/versions round (one wire revalidation per hit)
+    RESULT_CACHE.reset()
+    with ClusterHarness(
+        2, in_memory=True, telemetry_sample_interval=0.0,
+        max_writes_per_request=0,
+    ) as c:
+        api = c[0].api
+        seed(api)
+        for _ in range(3):  # past the candidate gate: stored + hit
+            base = api.query("cx", q)[0]
+        rv0 = RESULT_CACHE.stats_snapshot()["revalidations"]
+        out["fanout_warm_hit_revalidate_ms"] = round(
+            _median_ms(lambda: api.query("cx", q), reps), 3
+        )
+        out["fanout_revalidate_wire_rounds"] = (
+            RESULT_CACHE.stats_snapshot()["revalidations"] - rv0
+        )
+
+    # leased: the mirror assembles the version vector host-side
+    RESULT_CACHE.reset()
+    with ClusterHarness(
+        2,
+        in_memory=True,
+        telemetry_sample_interval=0.0,
+        coherence_lease_duration=30.0,
+        coherence_publish_batch_ms=5.0,
+        coherence_sub_poll_interval=0.2,
+        max_writes_per_request=0,
+    ) as c:
+        api = c[0].api
+        seed(api)
+        got = api.query("cx", q)[0]
+        assert got == base, (got, base)
+        api.query("cx", q)  # mirror armed
+        mgr = c[0].coherence
+        rtt0 = mgr.counters_snapshot()["version_rtts"]
+        out["fanout_warm_hit_leased_ms"] = round(
+            _median_ms(lambda: api.query("cx", q), reps), 3
+        )
+        snap = mgr.counters_snapshot()
+        assert snap["version_rtts"] == rtt0, "leased warm hit paid an RTT"
+        out["fanout_leased_version_rtts"] = snap["version_rtts"] - rtt0
+        assert snap["lease_hits"] > 0
+
+        # subscription push: a remote-node write to a fresh column of a
+        # dedicated row; latency is write-issue -> long-poll delivery,
+        # every pushed result checked against the wire recompute
+        qs = "Count(Row(f=3))"
+        sub = api.subscribe("cx", qs)
+        seq = sub["seq"]
+        lat = []
+        for i in range(20):
+            t0 = time.perf_counter()
+            c[1].api.import_bits(
+                "cx", "f",
+                np.array([3], np.uint64), np.array([i], np.uint64),
+            )
+            snap_s = mgr.poll(sub["id"], after=seq, wait_s=30.0)
+            lat.append((time.perf_counter() - t0) * 1000)
+            assert snap_s is not None and snap_s["seq"] > seq, snap_s
+            seq = snap_s["seq"]
+            want = [
+                wire.result_to_public_json(r)
+                for r in api.query_response("cx", qs).results
+            ]
+            assert snap_s["result"] == want, (snap_s["result"], want)
+        lat.sort()
+        out["subscription_push_p50_ms"] = round(lat[len(lat) // 2], 3)
+        out["subscription_push_p95_ms"] = round(
+            lat[int(len(lat) * 0.95)], 3
+        )
+
+    # monotone tree repair: set-only bursts into a cached Intersect are
+    # patched host-side from the merge barrier's word deltas — zero
+    # compiled dispatches, asserted equal to a cache-dropped recompute
+    RESULT_CACHE.reset()
+    with ClusterHarness(
+        1, in_memory=True, telemetry_sample_interval=0.0,
+        max_writes_per_request=0,
+    ) as c:
+        api = c[0].api
+        api.create_index("rx")
+        api.create_field("rx", "f", {"type": "set"})
+        for r, step in ((1, 2), (2, 3)):
+            cols = np.arange(0, 300_000, step, dtype=np.uint64)
+            api.import_bits(
+                "rx", "f", np.full(len(cols), r, np.uint64), cols
+            )
+        qr = "Count(Intersect(Row(f=1), Row(f=2)))"
+        api.query("rx", qr)
+        api.query("rx", qr)  # stored
+        # keep the bursts STAGED: the op-count snapshot trigger would
+        # merge them inside the import call, leaving the read barrier
+        # nothing to repair from (same idiom as the merge rooflines)
+        fobj = c[0].holder.index("rx").field("f")
+        for fr in fobj.view("standard").fragments.values():
+            fr.max_op_n = max(fr.max_op_n, 1 << 22)
+        tr0 = RESULT_CACHE.stats_snapshot()["tree_repairs"]
+        ev0 = planmod_x.STATS["evals"]
+        lat = []
+        got = None
+        for i in range(10):
+            cols = np.arange(
+                500_000 + i * 2_000, 500_000 + (i + 1) * 2_000,
+                dtype=np.uint64,
+            )
+            api.import_bits(
+                "rx", "f", np.full(len(cols), 1, np.uint64), cols
+            )
+            t0 = time.perf_counter()
+            got = api.query("rx", qr)[0]
+            lat.append((time.perf_counter() - t0) * 1000)
+        assert RESULT_CACHE.stats_snapshot()["tree_repairs"] >= tr0 + 10
+        assert planmod_x.STATS["evals"] == ev0, "tree repair dispatched"
+        RESULT_CACHE.reset()
+        fresh = api.query("rx", qr)[0]
+        assert got == fresh, (got, fresh)
+        lat.sort()
+        out["monotone_repair_ms"] = round(lat[len(lat) // 2], 3)
+    return out
+
+
 def main():
     os.environ.setdefault("PILOSA_TPU_HBM_BUDGET_MB", "16384")
     # bigger tally tiles at bench scale: fewer filtered-TopN chunk dispatches
@@ -1152,6 +1307,15 @@ def main():
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         tier_metrics = {"tier_error": f"{type(e).__name__}: {e}"[:200]}
 
+    # cache coherence (ISSUE 19): leased vs revalidate warm fan-out hits,
+    # subscription push latency, monotone tree repair — its own harnesses
+    try:
+        coherence_metrics = coherence_bench()
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        coherence_metrics = {
+            "coherence_error": f"{type(e).__name__}: {e}"[:200]
+        }
+
     # config 5 stand-in: virtual-mesh scaling curve in a CPU subprocess
     # (hermetic from the TPU tunnel; same env recipe as tests/conftest.py)
     env = dict(os.environ)
@@ -1264,6 +1428,7 @@ def main():
                     "patch_cascade_batches": patch_cascade_batches,
                     **replicated,
                     **tier_metrics,
+                    **coherence_metrics,
                     "timeq_range_ms": round(timeq_range_ms, 3),
                     "topn_n100_954shards_ms": round(topn_ms, 3),
                     "topn_filtered_n100_ms": round(topn_filtered_ms, 3),
@@ -1301,5 +1466,9 @@ if __name__ == "__main__":
     if "--replicated" in sys.argv:
         # the replicated write-path section alone (quick durability runs)
         print(json.dumps(replicated_bench()))
+        sys.exit(0)
+    if "--coherence" in sys.argv:
+        # the coherence-plane section alone (quick lease/push runs)
+        print(json.dumps(coherence_bench()))
         sys.exit(0)
     sys.exit(main())

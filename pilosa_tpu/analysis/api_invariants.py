@@ -77,6 +77,7 @@ _SECTION_CLASSES = {
     "CacheConfig": "cache",
     "ResizeConfig": "resize",
     "TierConfig": "tier",
+    "CoherenceConfig": "coherence",
     "AntiEntropyConfig": "anti_entropy",
     "MetricConfig": "metric",
     "TracingConfig": "tracing",

@@ -146,6 +146,22 @@ class CacheConfig:
 
 
 @dataclass
+class CoherenceConfig:
+    # cache coherence plane (pilosa_tpu/coherence/; docs/configuration.md
+    # "[coherence]"): push invalidation + version leases + query
+    # subscriptions. With leases on, a coordinator holding a lease
+    # serves fan-out warm hits with ZERO per-query version RTTs —
+    # writers push batched version bumps instead; lease expiry degrades
+    # safely to the /internal/versions revalidate path, so a dead or
+    # partitioned publisher causes staleness bounded by lease-duration,
+    # never a wrong answer served as fresh.
+    lease_duration: float = 0.0  # lease lifetime, seconds; 0 = leases off
+    publish_batch_ms: float = 20.0  # bump batching / flush tick, ms
+    max_subscriptions: int = 64  # standing queries per node; 0 = subs off
+    sub_poll_interval: float = 5.0  # unleased-shard refresh floor, seconds
+
+
+@dataclass
 class ResizeConfig:
     # live elastic resize (streaming resharding under traffic;
     # docs/configuration.md "Elastic resize"): moving fragments stream as
@@ -245,6 +261,7 @@ class Config:
     wal: WalConfig = field(default_factory=WalConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
+    coherence: CoherenceConfig = field(default_factory=CoherenceConfig)
     resize: ResizeConfig = field(default_factory=ResizeConfig)
     tier: TierConfig = field(default_factory=TierConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
@@ -328,6 +345,7 @@ class Config:
             ("wal", self.wal),
             ("mesh", self.mesh),
             ("cache", self.cache),
+            ("coherence", self.coherence),
             ("resize", self.resize),
             ("tier", self.tier),
             ("anti-entropy", self.anti_entropy),

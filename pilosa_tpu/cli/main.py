@@ -300,6 +300,30 @@ def _build_parser() -> argparse.ArgumentParser:
         "uploads + hydration fetches share the bound)",
     )
     sp.add_argument(
+        "--coherence-lease-duration", type=float,
+        help="coherence lease bound, seconds: peers holding a lease serve "
+        "fan-out warm hits from pushed version mirrors with zero "
+        "version RTTs; on publisher death/partition staleness is "
+        "bounded by this window before falling back to revalidation "
+        "(0 disables leases)",
+    )
+    sp.add_argument(
+        "--coherence-publish-batch-ms", type=float,
+        help="invalidation publish batching window, milliseconds — "
+        "version-vector bumps funnel through merge-barrier/stage-bulk "
+        "and ship to lease holders at this cadence",
+    )
+    sp.add_argument(
+        "--coherence-max-subscriptions", type=int,
+        help="live query subscriptions per node; registration beyond the "
+        "cap sheds with 429 (0 disables subscriptions)",
+    )
+    sp.add_argument(
+        "--coherence-sub-poll-interval", type=float,
+        help="fallback re-check cadence, seconds, for subscription "
+        "results whose queries fall outside push invalidation coverage",
+    )
+    sp.add_argument(
         "--join",
         help="coordinator URI to join on boot (self-registers and waits for "
         "the resize job; the listenForJoins role, cluster.go:1141)",
@@ -402,6 +426,10 @@ _FLAG_KNOBS = {
     "tier_demote_after": ("tier", "demote_after"),
     "tier_host_budget_bytes": ("tier", "host_budget_bytes"),
     "tier_fetch_concurrency": ("tier", "fetch_concurrency"),
+    "coherence_lease_duration": ("coherence", "lease_duration"),
+    "coherence_publish_batch_ms": ("coherence", "publish_batch_ms"),
+    "coherence_max_subscriptions": ("coherence", "max_subscriptions"),
+    "coherence_sub_poll_interval": ("coherence", "sub_poll_interval"),
     "anti_entropy_interval": ("anti_entropy", "interval"),
     "metric_service": ("metric", "service"),
     "metric_host": ("metric", "host"),
@@ -571,6 +599,10 @@ def cmd_server(cfg: Config, wait: bool = True, join: Optional[str] = None):
         tier_demote_after=cfg.tier.demote_after,
         tier_host_budget_bytes=cfg.tier.host_budget_bytes,
         tier_fetch_concurrency=cfg.tier.fetch_concurrency,
+        coherence_lease_duration=cfg.coherence.lease_duration,
+        coherence_publish_batch_ms=cfg.coherence.publish_batch_ms,
+        coherence_max_subscriptions=cfg.coherence.max_subscriptions,
+        coherence_sub_poll_interval=cfg.coherence.sub_poll_interval,
         stats_service=cfg.metric.service,
         stats_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

@@ -1,0 +1,36 @@
+"""Cache coherence plane: push invalidation, version leases, subscriptions.
+
+Three planes over one wire surface (see docs/development.md "Coherence"):
+
+1. **Push invalidation + version leases** — writers batch per-view
+   version bumps on the merge-barrier/stage-bulk funnels and push them
+   (over the internode client's retry/breaker plane) to peers holding
+   coherence *leases*. A leased coordinator serves fan-out warm hits
+   with ZERO `/internal/versions` RTTs; lease expiry degrades safely to
+   the PR-13 revalidate path, so a dead or partitioned publisher causes
+   bounded staleness, never a wrong answer served as fresh.
+2. **Monotone-tree repair** — lives in core/resultcache.py (repair_spec
+   tree patches + dep_rows structural re-keys); this package only feeds
+   it invalidation traffic.
+3. **Query subscriptions** — a standing PQL program whose result-cache
+   entry is pinned; updates are pushed on invalidation, patched in place
+   where plane 2 applies and recomputed through normal admission (batch
+   WFQ class, tenant-charged) otherwise.
+
+The module split mirrors the write-path constraint: `hub` is the
+dependency-free funnel called UNDER fragment locks (leaf-lock only, no
+core/server imports — core/view.py can import it without a cycle);
+`manager` owns all state, wire verbs, and threads. This ``__init__``
+deliberately imports neither: importing the package from core code must
+not drag in the manager's scheduler/server dependencies.
+"""
+
+__all__ = ["CoherenceManager"]
+
+
+def __getattr__(name):
+    if name == "CoherenceManager":
+        from pilosa_tpu.coherence.manager import CoherenceManager
+
+        return CoherenceManager
+    raise AttributeError(name)

@@ -1,0 +1,77 @@
+"""Write-path funnel from view mutation sites to coherence publishers.
+
+`core/view.py` calls :func:`note_view_mutation` from the same funnels
+that feed RESULT_CACHE invalidation (the per-fragment trailing-clock
+bump and the `stage_bulk` batch path) and :func:`note_view_drop` from
+`View.close`. Both run UNDER a fragment lock on hot paths, so this
+module obeys the strictest locking contract in the tree:
+
+* no imports from core/, server/, sched/ (view.py imports this module —
+  anything heavier would cycle);
+* subscriber dispatch takes NO lock here: the publisher list is an
+  immutable tuple swapped under `_mu` on (un)register, read lock-free on
+  the write path (GIL-atomic tuple load), and each publisher's note
+  method is itself leaf-lock-only (see CoherenceManager._dirty_mu);
+* the empty-registry fast path is one global load + truth test, so
+  processes that never enable coherence pay nothing per mutation.
+
+Registration is process-global (like RESULT_CACHE): in-process
+multi-node tests register every node's manager, and managers filter for
+view ownership at flush time — a view object resolves through the
+publisher's own holder before its versions are read, so node A's
+publisher never publishes node B's views (drop tombstones instead
+disambiguate by owner token, which is process-unique).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple
+
+from pilosa_tpu.utils.locks import TrackedLock
+
+__all__ = [
+    "register",
+    "unregister",
+    "note_view_mutation",
+    "note_view_drop",
+]
+
+_mu = TrackedLock("coherence.hub_mu")
+_PUBLISHERS: Tuple[object, ...] = ()
+
+
+def register(publisher: object) -> None:
+    """Add a publisher (a CoherenceManager). Idempotent."""
+    global _PUBLISHERS
+    with _mu:
+        if publisher not in _PUBLISHERS:
+            _PUBLISHERS = _PUBLISHERS + (publisher,)
+
+
+def unregister(publisher: object) -> None:
+    global _PUBLISHERS
+    with _mu:
+        _PUBLISHERS = tuple(p for p in _PUBLISHERS if p is not publisher)
+
+
+def note_view_mutation(view: object, shards: Iterable[int]) -> None:
+    """A view's fragments changed (stage or merge) on `shards`.
+
+    Called under fragment/view locks: publishers must only note the
+    (view, shards) pair under a leaf lock and return — version reads and
+    wire I/O happen on their flush tickers.
+    """
+    pubs = _PUBLISHERS
+    if not pubs:
+        return
+    for p in pubs:
+        p.note_view_mutation(view, shards)
+
+
+def note_view_drop(view: object) -> None:
+    """A view object is being closed (field/index delete, view drop)."""
+    pubs = _PUBLISHERS
+    if not pubs:
+        return
+    for p in pubs:
+        p.note_view_drop(view)

@@ -1,0 +1,817 @@
+"""CoherenceManager: leases, push invalidation, and query subscriptions.
+
+One manager per NodeServer, playing BOTH wire roles at once:
+
+* **publisher** — other nodes hold leases on this node's indexes
+  (`grant`); the flush tick batches the dirty views the hub funnels in,
+  reads their live fragment versions (lock-free monotonic reads, same
+  contract as `Executor.local_version_vector`) and pushes seq-numbered
+  version bumps over the internode client's retry/breaker plane.
+* **holder** — this node's coordinator keeps *mirrors* of peer version
+  vectors (`acquire`/`apply_publish`); `mirror_elements` assembles the
+  exact vector elements `/internal/versions` would have returned, with
+  zero RTTs, for as long as the lease is live.
+
+Safety argument (the "never wrong, boundedly stale" contract):
+
+* mirror versions only ever come from the publisher's own fragment
+  reads, and merge monotonically (``max``), so a mirror can LAG the
+  publisher but never run ahead — a lagging mirror makes a changed
+  entry validate as fresh only within the publish batching window plus
+  one delivery, and the staleness clock is cut off by lease expiry.
+* every publish carries a per-grant sequence number. A gap means a
+  publish was lost (publisher restart, dropped grant, partition heal):
+  the holder discards the whole mirror rather than trust it, degrading
+  to the PR-13 revalidate RPC. Duplicate delivery (seq == last) is a
+  no-op ack — bump application is idempotent under ``max``.
+* a partitioned or dead publisher simply stops delivering: the mirror
+  expires ``lease_duration`` after the last received publish (holder's
+  clock), after which `mirror_elements` returns None and the
+  coordinator falls back to `/internal/versions`. Staleness is bounded
+  by ``publish_batch_ms + lease_duration``; correctness never depends
+  on the publisher at all.
+* deletes (view close, fragment delete) publish *drop tombstones*
+  keyed by the view's process-unique owner token; the holder discards
+  the whole mirror on a token match, forcing a fresh lease. Tokens
+  disambiguate in-process multi-node registrations — a tombstone for
+  another node's identically-named view never matches.
+
+Locking: ``_dirty_mu`` is a leaf (the hub calls in under fragment
+locks); ``_mu`` guards grants/mirrors/counters and is never held across
+I/O; ``_subs_mu`` guards the subscription registry and worker queue;
+each subscription's condition is a leaf used only for seq publication
+to long-pollers. The flush tick serializes under ``_flush_mu`` so
+manual `tick()` calls in tests cannot interleave sequence numbers with
+the node ticker.
+
+The injectable ``clock`` governs lease/grant/mirror expiry only (tests
+drive expiry deterministically); long-poll waits and heartbeat pacing
+use it too so fault-matrix tests stay clock-controlled, but the worker
+thread's shed backoff uses real time.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.locks import (
+    TrackedCondition,
+    TrackedLock,
+    TrackedRLock,
+)
+from pilosa_tpu.utils.race import race_checked
+
+__all__ = ["CoherenceManager"]
+
+# a grant outlives its holder's mirror by this factor: the holder
+# re-acquires on mirror expiry, so publishes for an index the holder
+# stopped querying stop after GRANT_TTL_FACTOR lease periods.
+GRANT_TTL_FACTOR = 10.0
+# failed lease acquisition (peer without coherence, refused, timeout)
+# backs off this many lease periods before retrying that (peer, index).
+ACQUIRE_BACKOFF_FACTOR = 5.0
+# long-poll wait ceiling (seconds); handler threads are daemonic but
+# unbounded waits would pile up on misbehaving clients.
+MAX_POLL_WAIT = 60.0
+
+
+class _Grant:
+    """Publisher-side lease record: one holder node x one index."""
+
+    __slots__ = ("uri", "expires", "seq", "last_sent")
+
+    def __init__(self, uri: str, expires: float, now: float):
+        self.uri = uri
+        self.expires = expires
+        self.seq = 0
+        self.last_sent = now
+
+
+class _Mirror:
+    """Holder-side copy of one publisher's per-index version vectors.
+
+    views: (field, view_name) -> (owner_token, {shard: version})
+    """
+
+    __slots__ = ("boot", "seq", "expires", "views")
+
+    def __init__(self, boot: str, seq: int, expires: float,
+                 views: Dict[Tuple[str, str], Tuple[int, Dict[int, int]]]):
+        self.boot = boot
+        self.seq = seq
+        self.expires = expires
+        self.views = views
+
+
+class _Subscription:
+    """A standing PQL program; seq/result/closed are guarded by `cond`."""
+
+    __slots__ = ("id", "index", "query", "seq", "result", "result_repr",
+                 "closed", "error", "cond", "last_exec", "pins")
+
+    def __init__(self, sub_id: str, index: str, query: str):
+        self.id = sub_id
+        self.index = index
+        self.query = query
+        self.seq = 0
+        self.result: Any = None
+        self.result_repr = ""
+        self.closed = False
+        self.error = ""
+        self.cond = TrackedCondition(name="coherence.sub_cv")
+        self.last_exec = 0.0
+        self.pins: Tuple[Tuple[Any, str], ...] = ()
+
+    def snapshot(self, after: int = -1) -> Dict[str, Any]:
+        out = {"id": self.id, "index": self.index, "seq": self.seq,
+               "closed": self.closed}
+        if self.error:
+            out["error"] = self.error
+        if self.seq > after:
+            out["result"] = self.result
+        return out
+
+
+@race_checked(exclude=(
+    # flipped once (under _mu) on first grant/mirror/subscription and
+    # read lock-free by active()/gauge publication; a stale False only
+    # delays the first gauge render by one tick.
+    "_ever_active",
+))
+class CoherenceManager:
+    def __init__(
+        self,
+        *,
+        node_id: str,
+        boot_id: str,
+        holder,
+        client,
+        logger=None,
+        lease_duration: float = 0.0,
+        publish_batch_ms: float = 20.0,
+        max_subscriptions: int = 64,
+        sub_poll_interval: float = 5.0,
+        clock=None,
+    ):
+        self.node_id = node_id
+        self.boot_id = boot_id
+        self._holder = holder
+        self._client = client
+        self._logger = logger
+        self.lease_duration = float(lease_duration)
+        self.publish_batch_ms = float(publish_batch_ms)
+        self.max_subscriptions = int(max_subscriptions)
+        self.sub_poll_interval = float(sub_poll_interval)
+        self._clock = clock if clock is not None else time.monotonic
+
+        # write-path funnel (leaf lock: the hub calls in under fragment
+        # locks). view object -> set of dirty shards; None = dropped.
+        self._dirty_mu = TrackedLock("coherence.dirty_mu")
+        self._dirty_views: Dict[object, Optional[Set[int]]] = {}
+        self._dirty_indexes: Set[str] = set()
+
+        # grants/mirrors/counters
+        self._mu = TrackedLock("coherence.mu")
+        self._grants: Dict[Tuple[str, str], _Grant] = {}
+        self._mirrors: Dict[Tuple[str, str], _Mirror] = {}
+        self._acquire_backoff: Dict[Tuple[str, str], float] = {}
+        self._counters: Dict[str, int] = {
+            "version_rtts": 0,
+            "lease_hits": 0,
+            "grants_issued": 0,
+            "publishes": 0,
+            "publish_errors": 0,
+            "invalidations": 0,
+            "sub_pushes": 0,
+        }
+        self._ever_active = False
+
+        # subscriptions
+        self._subs_mu = TrackedRLock("coherence.subs_mu")
+        self._work_cv = TrackedCondition(self._subs_mu)
+        self._subs: Dict[str, _Subscription] = {}
+        self._subs_by_index: Dict[str, Set[str]] = {}
+        self._dirty_subs: Set[str] = set()
+
+        self._flush_mu = TrackedLock("coherence.flush_mu")
+        self._stopped = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._exec_fn = None
+        self._uri_fn = None
+        self.tracer = None
+
+    # -- configuration predicates -----------------------------------------
+
+    @property
+    def leases_enabled(self) -> bool:
+        return self.lease_duration > 0
+
+    @property
+    def subs_enabled(self) -> bool:
+        return self.max_subscriptions > 0
+
+    def active(self) -> bool:
+        """Gates gauge publication: an idle manager (subscriptions
+        allowed but none ever created, leases off) renders no
+        `coherence.*` families — the unleased-harness contract in
+        tools/metrics_smoke.py."""
+        return self.leases_enabled or self._ever_active
+
+    def start_span(self, name: str):
+        """Same factory shape as tier.Manager.start_span: background
+        work roots spans on the node tracer when injected."""
+        if self.tracer is not None:
+            return self.tracer.start_span(name)
+        return tracing.start_span(name)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, exec_fn, uri_fn, tracer=None) -> None:
+        """`exec_fn(index, query) -> wire-JSON result list` must route
+        through normal admission (the node binds it to
+        api.query_response with the batch WFQ class); `uri_fn()` is
+        this node's advertised URI for receiving publishes."""
+        self._exec_fn = exec_fn
+        self._uri_fn = uri_fn
+        self.tracer = tracer
+        self._stopped.clear()
+        if self.subs_enabled:
+            t = threading.Thread(
+                target=self._worker_loop,
+                name=f"coherence-sub-worker-{self.node_id}",
+                daemon=True,
+            )
+            self._worker = t
+            t.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._subs_mu:
+            subs = list(self._subs.values())
+            self._subs.clear()
+            self._subs_by_index.clear()
+            self._dirty_subs.clear()
+            self._work_cv.notify_all()
+        for sub in subs:
+            self._close_sub(sub)
+        w = self._worker
+        if w is not None:
+            w.join(timeout=5.0)
+            self._worker = None
+        with self._mu:
+            self._grants.clear()
+            self._mirrors.clear()
+            self._acquire_backoff.clear()
+
+    def drop_index(self, index: str) -> None:
+        """Index-delete GC (local delete AND the cluster broadcast,
+        both via NodeServer.drop_index_telemetry): close this index's
+        subscriptions, revoke grants we issued over it, and discard
+        mirrors we hold for it on any publisher."""
+        with self._subs_mu:
+            ids = list(self._subs_by_index.get(index, ()))
+            subs = [self._subs.pop(i) for i in ids if i in self._subs]
+            self._subs_by_index.pop(index, None)
+            self._dirty_subs.difference_update(ids)
+        for sub in subs:
+            self._unpin(sub)
+            self._close_sub(sub)
+        with self._mu:
+            for key in [k for k in self._grants if k[1] == index]:
+                del self._grants[key]
+            for key in [k for k in self._mirrors if k[1] == index]:
+                del self._mirrors[key]
+            for key in [k for k in self._acquire_backoff if k[1] == index]:
+                del self._acquire_backoff[key]
+        with self._dirty_mu:
+            self._dirty_indexes.discard(index)
+
+    # -- hub callbacks (leaf-lock only: called under fragment locks) -------
+
+    def note_view_mutation(self, view, shards: Iterable[int]) -> None:
+        # racy emptiness probe: worst case we note a mutation nobody
+        # consumes (no grants, no subs) — the tick discards it.
+        if not self._grants and not self._subs:
+            return
+        with self._dirty_mu:
+            cur = self._dirty_views.get(view, ())
+            if cur is not None:  # None = already dropped; drop wins
+                s = cur if isinstance(cur, set) else set()
+                s.update(shards)
+                self._dirty_views[view] = s
+            self._dirty_indexes.add(view.index)
+
+    def note_view_drop(self, view) -> None:
+        if not self._grants and not self._subs:
+            return
+        with self._dirty_mu:
+            self._dirty_views[view] = None
+            self._dirty_indexes.add(view.index)
+
+    # -- publisher side ----------------------------------------------------
+
+    def grant(self, holder_id: str, holder_uri: str,
+              index: str) -> Optional[Dict[str, Any]]:
+        """Issue (or refresh) a lease: the reply IS a whole-index
+        version snapshot, so a fresh lease retro-covers every entry the
+        holder already stored for this index — the PR-13 candidate gate
+        is bypassed entirely on the leased path."""
+        if not self.leases_enabled:
+            return None
+        idx = self._holder.index(index)
+        if idx is None:
+            return None
+        views = []
+        for f in idx.fields(include_hidden=True):
+            for v in list(f.views.values()):
+                frags = v.fragments
+                entries = [[s, fr.version] for s, fr in list(frags.items())]
+                views.append([f.name, v.name, v._stack_token, entries])
+        now = self._clock()
+        with self._mu:
+            self._grants[(holder_id, index)] = _Grant(
+                holder_uri, now + GRANT_TTL_FACTOR * self.lease_duration, now
+            )
+            self._counters["grants_issued"] += 1
+            self._ever_active = True
+        return {
+            "node": self.node_id,
+            "boot": self.boot_id,
+            "duration": self.lease_duration,
+            "seq": 0,
+            "views": views,
+        }
+
+    def tick(self) -> None:
+        """Flush dirty views to lease holders, expire state, and feed
+        the subscription planes. Called from the node ticker every
+        `publish_batch_ms`; serialized so manual test calls cannot
+        interleave grant sequence numbers with the ticker."""
+        with self._flush_mu:
+            self._flush()
+            self._expire_mirrors()
+            self._poke_subscriptions()
+
+    def _flush(self) -> None:
+        with self._dirty_mu:
+            dirty, self._dirty_views = self._dirty_views, {}
+        now = self._clock()
+        with self._mu:
+            expired = [k for k, g in self._grants.items() if g.expires <= now]
+            for k in expired:
+                del self._grants[k]
+            grants = list(self._grants.items())
+        if not grants:
+            return
+        # version reads happen OUTSIDE every coherence lock: fragment
+        # versions are monotonic and the seq channel orders delivery.
+        bumps: Dict[str, List[list]] = {}
+        drops: Dict[str, List[list]] = {}
+        for view, shards in dirty.items():
+            iname = view.index
+            if shards is None:
+                # drop tombstone: token match on the holder does the
+                # ownership disambiguation (tokens are process-unique)
+                drops.setdefault(iname, []).append(
+                    [view.field, view.name, view._stack_token])
+                continue
+            if not self._owns_view(view):
+                continue
+            frags = view.fragments
+            entries = []
+            demoted = False
+            for s in shards:
+                fr = frags.get(s)
+                if fr is None:
+                    # fragment deleted since the note: conservative
+                    # tombstone — the holder re-leases for a fresh
+                    # snapshot rather than trust a partial mirror.
+                    drops.setdefault(iname, []).append(
+                        [view.field, view.name, view._stack_token])
+                    demoted = True
+                    break
+                entries.append([s, fr.version])
+            if not demoted and entries:
+                bumps.setdefault(iname, []).append(
+                    [view.field, view.name, view._stack_token, entries])
+        heartbeat = self.lease_duration / 3.0 if self.leases_enabled else 0.0
+        for (holder_id, index), g in grants:
+            b = bumps.get(index)
+            d = drops.get(index)
+            if b is None and d is None:
+                if heartbeat <= 0 or now - g.last_sent < heartbeat:
+                    continue
+            payload = {
+                "node": self.node_id,
+                "boot": self.boot_id,
+                "index": index,
+                "seq": g.seq + 1,
+                "bumps": b or [],
+                "drops": d or [],
+            }
+            ok = False
+            with self.start_span("coherence.publish") as sp:
+                sp.set_tag("index", index)
+                sp.set_tag("holder", holder_id)
+                sp.set_tag("bumps", len(b or ()))
+                try:
+                    resp = self._client.coherence_publish(g.uri, payload)
+                    ok = bool(resp and resp.get("ok"))
+                except Exception as e:  # noqa: BLE001 - peer/transport fault
+                    if self._logger is not None:
+                        self._logger(
+                            f"coherence publish to {holder_id} failed: {e}")
+            with self._mu:
+                cur = self._grants.get((holder_id, index))
+                if cur is not g:
+                    continue  # re-granted mid-flight; new seq channel
+                if ok:
+                    g.seq += 1
+                    g.last_sent = now
+                    self._counters["publishes"] += 1
+                else:
+                    # delivery failed or holder lost the mirror: the
+                    # holder's lease expires within the bound and it
+                    # re-acquires; keeping a broken seq channel open
+                    # risks exactly the gap the seq exists to catch.
+                    del self._grants[(holder_id, index)]
+                    self._counters["publish_errors"] += 1
+
+    def _owns_view(self, view) -> bool:
+        """In-process multi-node guard: the hub is process-global, so
+        every manager sees every node's mutations; only the manager
+        whose holder resolves to this very object publishes it."""
+        idx = self._holder.index(view.index)
+        if idx is None:
+            return False
+        f = idx.field(view.field)
+        if f is None:
+            return False
+        return f.view(view.name) is view
+
+    # -- holder side -------------------------------------------------------
+
+    def acquire(self, nid: str, uri: str, index: str) -> bool:
+        """Take (or refresh) a lease on `nid`'s view of `index`. One
+        RTT; the snapshot in the grant reply becomes the mirror. A
+        refused/failed acquisition backs off so leaseless peers cost
+        one probe per backoff window, not one per query."""
+        if not self.leases_enabled:
+            return False
+        now = self._clock()
+        with self._mu:
+            if self._acquire_backoff.get((nid, index), 0.0) > now:
+                return False
+        resp = None
+        try:
+            resp = self._client.coherence_lease(
+                uri, node=self.node_id, node_uri=self._uri() or "",
+                index=index)
+        except Exception as e:  # noqa: BLE001 - peer without coherence, fault
+            if self._logger is not None:
+                self._logger(f"coherence lease from {nid} failed: {e}")
+        if not resp or resp.get("views") is None:
+            with self._mu:
+                self._acquire_backoff[(nid, index)] = now + (
+                    ACQUIRE_BACKOFF_FACTOR * max(self.lease_duration, 1.0))
+            return False
+        views: Dict[Tuple[str, str], Tuple[int, Dict[int, int]]] = {}
+        for fname, vname, token, entries in resp.get("views", ()):
+            views[(str(fname), str(vname))] = (
+                int(token), {int(s): int(ver) for s, ver in entries})
+        # staleness bound = the STRICTER of the two nodes' configured
+        # lease durations: the holder never trusts a mirror longer than
+        # its own knob says, whatever the publisher advertises.
+        duration = float(resp.get("duration") or self.lease_duration)
+        duration = min(d for d in (duration, self.lease_duration) if d > 0)
+        mirror = _Mirror(str(resp.get("boot") or ""),
+                         int(resp.get("seq") or 0),
+                         now + duration, views)
+        with self._mu:
+            self._mirrors[(nid, index)] = mirror
+            self._acquire_backoff.pop((nid, index), None)
+            self._ever_active = True
+        return True
+
+    def _uri(self) -> Optional[str]:
+        fn = self._uri_fn
+        try:
+            return fn() if fn is not None else None
+        except Exception:  # noqa: BLE001 - node not fully started yet
+            return None
+
+    def mirror_elements(self, nid: str, index: str, views,
+                        node_shards) -> Optional[tuple]:
+        """Assemble the version-vector elements `/internal/versions`
+        would return for `views` x `node_shards` on peer `nid`, from
+        the live mirror — or None when no live lease covers it. The
+        element shapes match `_fetch_remote_versions` exactly, so
+        entries stored on either path validate against the other
+        (which is what retro-covers pre-lease entries)."""
+        now = self._clock()
+        shard_t = tuple(node_shards)
+        with self._mu:
+            m = self._mirrors.get((nid, index))
+            if m is None:
+                return None
+            if m.expires <= now:
+                del self._mirrors[(nid, index)]
+                return None
+            elems = []
+            for fname, vname in views:
+                ent = m.views.get((fname, vname))
+                if ent is None:
+                    elems.append(("m", nid, fname, vname))
+                else:
+                    token, vers = ent
+                    elems.append((
+                        "v", nid, fname, vname, (m.boot, token), shard_t,
+                        tuple(vers.get(s, -1) for s in shard_t)))
+            self._counters["lease_hits"] += 1
+            return tuple(elems)
+
+    def count_version_rtt(self, n: int = 1) -> None:
+        with self._mu:
+            self._counters["version_rtts"] += n
+
+    def apply_publish(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Holder side of POST /internal/coherence/publish."""
+        nid = str(payload.get("node") or "")
+        index = str(payload.get("index") or "")
+        boot = str(payload.get("boot") or "")
+        seq = int(payload.get("seq") or 0)
+        applied = 0
+        with self._mu:
+            m = self._mirrors.get((nid, index))
+            if m is None or m.boot != boot:
+                return {"ok": False}
+            if seq == m.seq:
+                return {"ok": True}  # duplicate delivery: idempotent
+            if seq != m.seq + 1:
+                # gap: a publish was lost — the mirror can no longer be
+                # trusted to lag-but-never-lie. Fall back to revalidate.
+                del self._mirrors[(nid, index)]
+                return {"ok": False}
+            m.seq = seq
+            m.expires = self._clock() + self.lease_duration
+            for fname, vname, token, entries in payload.get("bumps") or ():
+                key = (str(fname), str(vname))
+                token = int(token)
+                ent = m.views.get(key)
+                if ent is None or ent[0] != token:
+                    m.views[key] = (token,
+                                    {int(s): int(ver) for s, ver in entries})
+                else:
+                    vers = ent[1]
+                    for s, ver in entries:
+                        s, ver = int(s), int(ver)
+                        # monotone merge: versions only grow, so any
+                        # interleaving of grant snapshot vs publish
+                        # converges on the newest state
+                        if vers.get(s, -1) < ver:
+                            vers[s] = ver
+                applied += len(entries)
+            for fname, vname, token in payload.get("drops") or ():
+                ent = m.views.get((str(fname), str(vname)))
+                if ent is not None and ent[0] == int(token):
+                    # a delete invalidates the whole mirror: re-lease
+                    # for a coherent snapshot instead of patching holes
+                    del self._mirrors[(nid, index)]
+                    break
+            self._counters["invalidations"] += applied
+        if applied or payload.get("drops"):
+            with self._dirty_mu:
+                self._dirty_indexes.add(index)
+        return {"ok": True}
+
+    def _expire_mirrors(self) -> None:
+        now = self._clock()
+        with self._mu:
+            for key in [k for k, m in self._mirrors.items()
+                        if m.expires <= now]:
+                del self._mirrors[key]
+
+    # -- subscriptions -----------------------------------------------------
+
+    def subscribe(self, index: str, query: str) -> Dict[str, Any]:
+        """Register a standing query. Raises ShedError over the cap
+        (handler maps it to 429 like any admission shed); initial
+        compute errors (parse, missing index) propagate to the caller
+        unchanged. The result-cache entries the program lands on are
+        pinned so eviction cannot silently turn pushes into full
+        recomputes."""
+        from pilosa_tpu.sched.admission import ShedError
+
+        if self._exec_fn is None:
+            raise RuntimeError("coherence manager not started")
+        with self._subs_mu:
+            if len(self._subs) >= self.max_subscriptions:
+                raise ShedError(
+                    f"subscription cap reached ({self.max_subscriptions})")
+        result = self._exec_fn(index, query)
+        sub = _Subscription(uuid.uuid4().hex[:16], index, query)
+        sub.result = result
+        sub.result_repr = _canon(result)
+        sub.seq = 1
+        sub.last_exec = time.monotonic()
+        sub.pins = self._pin(index, query)
+        with self._subs_mu:
+            if len(self._subs) >= self.max_subscriptions:
+                self._unpin(sub)
+                raise ShedError(
+                    f"subscription cap reached ({self.max_subscriptions})")
+            self._subs[sub.id] = sub
+            self._subs_by_index.setdefault(index, set()).add(sub.id)
+        with self._mu:
+            self._ever_active = True
+        return sub.snapshot()
+
+    def _pin(self, index: str, query: str) -> Tuple[Tuple[Any, str], ...]:
+        """Best-effort: pin the (scope, canonical-text) pairs this
+        program's read calls cache under. A probe that cannot resolve
+        (unkeyed field mid-create, write call) just isn't pinned — the
+        subscription still works, it only loses eviction immunity."""
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+        from pilosa_tpu.pql import parse
+        from pilosa_tpu.sched.cost import _probe_text
+
+        idx = self._holder.index(index)
+        scope = getattr(idx, "_cache_scope", None)
+        if idx is None or scope is None:
+            return ()
+        pins = []
+        try:
+            q = parse(query)
+            for c in q.calls:
+                t = _probe_text(idx, c)
+                if t is not None:
+                    RESULT_CACHE.pin_text(scope, t)
+                    pins.append((scope, t))
+        except Exception:  # noqa: BLE001 - pinning is advisory
+            pass
+        return tuple(pins)
+
+    def _unpin(self, sub: _Subscription) -> None:
+        from pilosa_tpu.core.resultcache import RESULT_CACHE
+
+        for scope, text in sub.pins:
+            RESULT_CACHE.unpin_text(scope, text)
+        sub.pins = ()
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self._subs_mu:
+            sub = self._subs.pop(sub_id, None)
+            if sub is not None:
+                ids = self._subs_by_index.get(sub.index)
+                if ids is not None:
+                    ids.discard(sub_id)
+                    if not ids:
+                        del self._subs_by_index[sub.index]
+                self._dirty_subs.discard(sub_id)
+        if sub is None:
+            return False
+        self._unpin(sub)
+        self._close_sub(sub)
+        return True
+
+    def _close_sub(self, sub: _Subscription, error: str = "") -> None:
+        with sub.cond:
+            sub.closed = True
+            if error and not sub.error:
+                sub.error = error
+            sub.cond.notify_all()
+
+    def poll(self, sub_id: str, after: int,
+             wait_s: float) -> Optional[Dict[str, Any]]:
+        """Long-poll until seq > after, close, or timeout. Returns the
+        sub snapshot (result included only when there is news) or None
+        for an unknown id."""
+        with self._subs_mu:
+            sub = self._subs.get(sub_id)
+        if sub is None:
+            return None
+        deadline = time.monotonic() + max(0.0, min(wait_s, MAX_POLL_WAIT))
+        with sub.cond:
+            while not sub.closed and sub.seq <= after:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                sub.cond.wait(remaining)
+            return sub.snapshot(after)
+
+    def list_subscriptions(self) -> List[Dict[str, Any]]:
+        with self._subs_mu:
+            subs = list(self._subs.values())
+        out = []
+        for sub in subs:
+            with sub.cond:
+                out.append({"id": sub.id, "index": sub.index,
+                            "seq": sub.seq, "closed": sub.closed})
+        return out
+
+    def _poke_subscriptions(self) -> None:
+        """Convert index-level dirt (local hub events + incoming
+        publishes) into worker wakeups, plus the poll-interval fallback
+        for shards no lease covers."""
+        with self._dirty_mu:
+            dirty_idx, self._dirty_indexes = self._dirty_indexes, set()
+        if not self.subs_enabled:
+            return
+        now = time.monotonic()
+        woke = False
+        with self._subs_mu:
+            for iname in dirty_idx:
+                for sid in self._subs_by_index.get(iname, ()):
+                    self._dirty_subs.add(sid)
+                    woke = True
+            if self.sub_poll_interval > 0:
+                for sub in self._subs.values():
+                    if now - sub.last_exec >= self.sub_poll_interval:
+                        self._dirty_subs.add(sub.id)
+                        woke = True
+            if woke:
+                self._work_cv.notify_all()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._subs_mu:
+                while not self._dirty_subs and not self._stopped.is_set():
+                    self._work_cv.wait(0.5)
+                if self._stopped.is_set():
+                    return
+                sid = self._dirty_subs.pop()
+                sub = self._subs.get(sid)
+            if sub is None or sub.closed:
+                continue
+            try:
+                self._push(sub)
+            except Exception as e:  # noqa: BLE001 - worker must survive
+                if self._logger is not None:
+                    self._logger(f"subscription push failed: {e}")
+
+    def _push(self, sub: _Subscription) -> None:
+        """Recompute (through normal admission — the exec_fn carries
+        the batch WFQ class) and publish iff the wire result changed.
+        Where plane-2 repair or a lease-valid entry applies, the
+        recompute is a cache hit or in-place patch, so the push costs
+        host microseconds, not a device dispatch."""
+        from pilosa_tpu.sched.admission import ShedError
+
+        with self.start_span("sub.push") as sp:
+            sp.set_tag("index", sub.index)
+            sp.set_tag("sub", sub.id)
+            try:
+                result = self._exec_fn(sub.index, sub.query)
+            except ShedError:
+                # overload: leave it dirty for the next tick rather
+                # than spin on a shedding scheduler
+                time.sleep(0.05)
+                with self._subs_mu:
+                    if sub.id in self._subs:
+                        self._dirty_subs.add(sub.id)
+                sp.set_tag("shed", True)
+                return
+            except Exception as e:  # noqa: BLE001 - index deleted, etc.
+                self._close_sub(sub, error=str(e))
+                sp.set_tag("error", str(e))
+                return
+            with self._subs_mu:
+                sub.last_exec = time.monotonic()
+            repr_ = _canon(result)
+            pushed = False
+            with sub.cond:
+                if not sub.closed and repr_ != sub.result_repr:
+                    sub.result = result
+                    sub.result_repr = repr_
+                    sub.seq += 1
+                    sub.cond.notify_all()
+                    pushed = True
+            sp.set_tag("pushed", pushed)
+        if pushed:
+            with self._mu:
+                self._counters["sub_pushes"] += 1
+
+    # -- telemetry ---------------------------------------------------------
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._counters)
+
+    def gauges(self) -> Dict[str, int]:
+        with self._mu:
+            return {"leases": len(self._mirrors), "grants": len(self._grants)}
+
+    def subscriptions_by_index(self) -> Dict[str, int]:
+        with self._subs_mu:
+            return {k: len(v) for k, v in self._subs_by_index.items()}
+
+
+def _canon(result: Any) -> str:
+    """Canonical wire representation for change detection: pushes fire
+    on WIRE-visible change, matching exactly what a poller would see."""
+    return json.dumps(result, sort_keys=True, default=str)

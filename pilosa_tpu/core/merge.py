@@ -319,14 +319,17 @@ def merge_barrier(frags) -> List[FragMerge]:
         # watching, read the row's host words at base_version NOW —
         # after the apply below the fragment's content has moved past
         # the base and popcount(delta & ~old) is no longer computable.
+        # EVERY interest row is captured, not just the burst's: a
+        # repair-spec tree patch (core/resultcache.py) needs the
+        # UNTOUCHED leaves' words from the same consistent base
+        # snapshot to evaluate op(old)/op(new) — an untouched row's
+        # capture equals its merged content, so it serves both sides.
         # A concurrent _sync_locked between this read and the apply
         # bumps the generation, the apply returns None, and the capture
         # is discarded with the failed FragMerge — never applied stale.
         want = _repair_interest(f)
-        if want:
-            for rid in rows_i:
-                if rid in want:
-                    fm.old_words[rid] = f.premerge_row_words(rid)
+        for rid in want:
+            fm.old_words[rid] = f.premerge_row_words(rid)
         # the layer is COPIED out of the shared burst buffer: a view
         # would pin the whole round's merged array until the last
         # fragment's host read materializes it

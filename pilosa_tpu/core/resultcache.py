@@ -14,14 +14,29 @@ two freshness paths:
   `Fragment.version`); an unchanged vector means the stored result is
   bit-identical to what a recompute would produce, so it is served from
   host memory with zero compiled dispatches and zero device reads.
-- **incremental repair** (Counts over a single row): the merge
+- **incremental repair** (Counts over monotone row trees): the merge
   barrier's `FragMerge.word_delta` is exactly the information needed to
-  patch a cached popcount without re-staging any operand —
-  `count(new) = count(old) + popcount(delta & ~old_words)` for a
-  set-only staged burst, where `old_words` is the row's host words at
+  patch a cached popcount without re-staging any operand. The single
+  plain Row case is `count(new) = count(old) + popcount(delta & ~old)`
+  for a set-only staged burst, where `old` is the row's host words at
   the burst's base version (captured by the barrier BEFORE the delta
-  layer parks, core/merge.py). Clears, mutex writes and version gaps
-  make the delta non-monotone; those entries fall back to recompute.
+  layer parks, core/merge.py). Pure Intersect/Union trees of plain
+  Rows (`repair_spec`) generalize it: per merged shard the patch is
+  `popcount(op(new leaf words)) - popcount(op(old leaf words))` over
+  the changed word indexes, with same-view leaf words coming from the
+  barrier's capture (one consistent snapshot) and other-view leaf
+  words read from the live fragments at staged-base
+  (`premerge_row_words`) OUTSIDE the cache lock — a deferred patch
+  job that re-validates the entry's whole vector before committing
+  and drops the entry on any doubt. Clears, mutex writes and version
+  gaps make the delta non-monotone; those entries fall back to
+  recompute.
+- **structural re-key** (TopN/GroupBy, and Counts the patch formula
+  cannot cover): entries carry `dep_rows` — per (field, view), the
+  exact row set the result depends on, or None for "any row" (a
+  TopN's tallied field, a GroupBy's Rows fields). A merge whose burst
+  provably touched no dependent row re-keys the entry to the merged
+  versions without recompute; anything else drops.
 
 Scoping: one process-global RESULT_CACHE serves every in-process node
 (the multi-node test harnesses run several NodeServers in one process).
@@ -43,8 +58,9 @@ grow), it just waits for LRU.
 from __future__ import annotations
 
 import copy
+import weakref
 from collections import OrderedDict
-from typing import Any, Dict, Hashable, Iterable, Optional, Set, Tuple
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -71,6 +87,28 @@ def _popcount(words: np.ndarray) -> int:
     return int(
         np.unpackbits(np.ascontiguousarray(words).view(np.uint8)).sum()
     )
+
+
+def _op_popcount(op: str, arrays: list) -> int:
+    acc = arrays[0]
+    fn = np.bitwise_and if op == "and" else np.bitwise_or
+    for a in arrays[1:]:
+        acc = fn(acc, a)
+    return _popcount(acc)
+
+
+def _tree_delta(op: str, changed, same, other) -> int:
+    """popcount(op(new leaves)) - popcount(op(old leaves)) over one
+    shard's changed word selection. `changed` holds (old, new) word
+    pairs for the merging view's touched leaves; `same` (untouched
+    same-view leaves, from the barrier capture) and `other` (other-view
+    operands, read at their pinned versions) are identical at both
+    evaluations — which is exactly why the difference telescopes to
+    the true count delta across sequential per-view merges."""
+    fixed = list(same) + list(other)
+    old_arrays = [o for o, _ in changed] + fixed
+    new_arrays = [n for _, n in changed] + fixed
+    return _op_popcount(op, new_arrays) - _op_popcount(op, old_arrays)
 
 
 def _result_nbytes(kind: str, result: Any) -> int:
@@ -111,13 +149,20 @@ class _Entry:
           the field/view did not exist ("" view = field missing); its
           materialization changes the element shape, forcing a miss.
 
-    `repair_row` is set only for Count over a single plain Row (the
-    vector then has exactly one "v" element): the row id whose merged
-    word delta can patch the cached scalar in place."""
+    `repair_spec` is set only for Counts over pure monotone trees —
+    ("and"|"or", ((field, view, row), ...)) for Count(Intersect/Union
+    of plain Rows); the single plain Row case is a one-leaf "and".
+    The leaves' merged word deltas can patch the cached scalar in
+    place (note_merges).
+
+    `dep_rows` maps (field, view) -> frozenset(rows) | None: the exact
+    rows the result depends on per referenced view (None / missing =
+    depends on every row). A merge whose burst is disjoint from an
+    exact dep set re-keys the entry without recompute."""
 
     __slots__ = (
-        "key", "kind", "index", "text", "result", "vector", "repair_row",
-        "clocks", "maybe_stale", "nbytes",
+        "key", "kind", "index", "text", "result", "vector", "repair_spec",
+        "dep_rows", "clocks", "maybe_stale", "nbytes",
     )
 
     def __init__(
@@ -128,8 +173,9 @@ class _Entry:
         text: str,
         result: Any,
         vector: tuple,
-        repair_row: Optional[int],
+        repair_spec: Optional[tuple],
         clocks: Optional[tuple] = None,
+        dep_rows: Optional[dict] = None,
     ) -> None:
         self.key = key
         self.kind = kind
@@ -137,7 +183,8 @@ class _Entry:
         self.text = text
         self.result = result
         self.vector = vector
-        self.repair_row = repair_row
+        self.repair_spec = repair_spec
+        self.dep_rows = dep_rows
         # per-view mutation-clock vector (View.mutation_clock) read
         # BEFORE the version vector: clock-equal implies version-equal,
         # so warm repeats revalidate on one integer per view instead of
@@ -149,10 +196,27 @@ class _Entry:
         # recompute byte-free (sched/cost.py); serving correctness
         # never reads it.
         self.maybe_stale = False
+        extra = 0
+        if repair_spec is not None:
+            extra += 48 * len(repair_spec[1])
+        if dep_rows:
+            extra += sum(
+                32 + 8 * (len(rows) if rows is not None else 0)
+                for rows in dep_rows.values()
+            )
         self.nbytes = (
             len(text)
             + _result_nbytes(kind, result)
             + _vector_nbytes(vector)
+            + extra
+        )
+
+    def spec_rows(self, field: str, view: str) -> frozenset:
+        """Leaf rows of `repair_spec` living in (field, view)."""
+        if self.repair_spec is None:
+            return frozenset()
+        return frozenset(
+            r for f, v, r in self.repair_spec[1] if f == field and v == view
         )
 
 
@@ -195,11 +259,24 @@ class ResultCache:
         self._tenant_quota_default = 0
         self._tenant_quota: Dict[str, int] = {}
         self._quota_evictions_index: Dict[str, int] = {}
+        # (scope, text) -> pin refcount: subscription-pinned programs.
+        # Pins are keyed on the TEXT, not the entry, so a store after a
+        # recompute is born pinned; eviction skips pinned entries (a
+        # pinned push program evicted under pressure would silently
+        # turn every push into a full recompute).
+        self._pins: Dict[tuple, int] = {}
+        # view token -> weakref(View): the deferred tree-patch jobs read
+        # other operands' premerge words OUTSIDE this cache's lock, and
+        # resolve the owning View here (registered at View.open, dropped
+        # with drop_view).
+        self._views: Dict[int, Any] = {}
         self._counters: Dict[str, int] = {
             "hits": 0,
             "misses": 0,
             "revalidations": 0,
             "repairs": 0,
+            "tree_repairs": 0,
+            "rekeys": 0,
             "evictions": 0,
             "stores": 0,
             "quota_evictions": 0,
@@ -319,14 +396,16 @@ class ResultCache:
 
     def repairable(self, key: tuple) -> bool:
         """Whether a miss on `key` is worth a repair attempt: a live
-        Count entry with a repair row, and repair enabled. The caller
-        then runs the read barrier (which fires note_merges) and
-        re-gets."""
+        entry with a repair spec or exact dep rows (re-keyable), and
+        repair enabled. The caller then runs the read barrier (which
+        fires note_merges) and re-gets."""
         if not self._repair_enabled:
             return False
         with self._mu:
             e = self._entries.get(key)
-            return e is not None and e.repair_row is not None
+            return e is not None and (
+                e.repair_spec is not None or e.dep_rows is not None
+            )
 
     def note_candidate(self, key: tuple) -> bool:
         """Record a sighting of an RPC-vector key; True when the key was
@@ -352,18 +431,29 @@ class ResultCache:
         vector: tuple,
         repair_row: Optional[int] = None,
         clocks: Optional[tuple] = None,
+        repair_spec: Optional[tuple] = None,
+        dep_rows: Optional[dict] = None,
     ) -> None:
         if vector is None or self._budget <= 0:
             return
         if kind != "count":
             result = copy.deepcopy(result)
-        if repair_row is not None and (
-            kind != "count"
-            or not self._repair_enabled
-            or sum(1 for el in vector if el[0] == "v") != 1
+        if repair_spec is None and repair_row is not None:
+            # legacy single-row sugar (PR-13 call sites / tests): a
+            # one-leaf "and" tree over the vector's only "v" element
+            if (
+                kind == "count"
+                and self._repair_enabled
+                and sum(1 for el in vector if el[0] == "v") == 1
+            ):
+                el = next(el for el in vector if el[0] == "v")
+                repair_spec = ("and", ((el[2], el[3], repair_row),))
+        if repair_spec is not None and not self._spec_admissible(
+            kind, vector, repair_spec
         ):
-            repair_row = None
-        e = _Entry(key, kind, index, text, result, vector, repair_row, clocks)
+            repair_spec = None
+        e = _Entry(key, kind, index, text, result, vector, repair_spec,
+                   clocks, dep_rows)
         if e.nbytes > self._budget:
             return  # a single over-budget entry would evict everything
         with self._mu:
@@ -382,6 +472,26 @@ class ResultCache:
             self._candidates.pop(key, None)
             self._evict_over_budget_locked()
 
+    def _spec_admissible(
+        self, kind: str, vector: tuple, repair_spec: tuple
+    ) -> bool:
+        """A repair spec is only usable when every leaf's (field, view)
+        is represented by at least one local (int-token) "v" element:
+        the patch reads host words through the view registry, which
+        only local views live in. Purely-remote coordinator entries
+        stay revalidate-only."""
+        if kind != "count" or not self._repair_enabled:
+            return False
+        op, leaves = repair_spec
+        if op not in ("and", "or") or not leaves:
+            return False
+        local = {
+            (el[2], el[3])
+            for el in vector
+            if el[0] == "v" and isinstance(el[4], int)
+        }
+        return all((f, v) in local for f, v, _ in leaves)
+
     # -- internal indexing (all under self._mu) -----------------------------
 
     def _index_locked(self, e: _Entry) -> None:
@@ -394,11 +504,10 @@ class ResultCache:
             ident = elem[4]
             if isinstance(ident, int):  # local/in-process view token
                 self._by_token.setdefault(ident, set()).add(e.key)
-        if e.repair_row is not None:
-            elem = next(el for el in e.vector if el[0] == "v")
-            ikey = (e.index, elem[2], elem[3])
-            rows = self._interest.setdefault(ikey, {})
-            rows[e.repair_row] = rows.get(e.repair_row, 0) + 1
+        if e.repair_spec is not None:
+            for f, v, row in e.repair_spec[1]:
+                rows = self._interest.setdefault((e.index, f, v), {})
+                rows[row] = rows.get(row, 0) + 1
 
     def _unindex_locked(self, e: _Entry) -> None:
         self._bytes -= e.nbytes
@@ -423,18 +532,18 @@ class ResultCache:
                     keys.discard(e.key)
                     if not keys:
                         self._by_token.pop(ident, None)
-        if e.repair_row is not None:
-            elem = next(el for el in e.vector if el[0] == "v")
-            ikey = (e.index, elem[2], elem[3])
-            rows = self._interest.get(ikey)
-            if rows is not None:
-                n = rows.get(e.repair_row, 0) - 1
-                if n > 0:
-                    rows[e.repair_row] = n
-                else:
-                    rows.pop(e.repair_row, None)
-                    if not rows:
-                        self._interest.pop(ikey, None)
+        if e.repair_spec is not None:
+            for f, v, row in e.repair_spec[1]:
+                ikey = (e.index, f, v)
+                rows = self._interest.get(ikey)
+                if rows is not None:
+                    n = rows.get(row, 0) - 1
+                    if n > 0:
+                        rows[row] = n
+                    else:
+                        rows.pop(row, None)
+                        if not rows:
+                            self._interest.pop(ikey, None)
 
     def _drop_locked(self, key: tuple, evict: bool = False) -> None:
         e = self._entries.pop(key, None)
@@ -447,6 +556,9 @@ class ResultCache:
         q = self._tenant_quota.get(index)
         return q if q is not None else self._tenant_quota_default
 
+    def _pinned_locked(self, e: _Entry) -> bool:
+        return bool(self._pins) and (e.key[0], e.text) in self._pins
+
     def _evict_over_budget_locked(self) -> None:
         if self._tenant_quota or self._tenant_quota_default > 0:
             # tenant quotas first: over-quota owners shed their own LRU
@@ -454,7 +566,18 @@ class ResultCache:
             # index is held to its quota even with global budget free
             self._evict_over_quota_locked()
         while self._bytes > self._budget and self._entries:
-            key = next(iter(self._entries))
+            # pinned (subscription) entries are skipped: evicting a
+            # standing program's entry silently converts every push
+            # into a full recompute. When ONLY pinned bytes remain the
+            # loop stops over-budget rather than starve — the
+            # subscription cap bounds how much can be pinned.
+            key = next(
+                (k for k, e in self._entries.items()
+                 if not self._pinned_locked(e)),
+                None,
+            )
+            if key is None:
+                break
             self._drop_locked(key, evict=True)
 
     def _evict_over_quota_locked(self) -> None:
@@ -463,6 +586,8 @@ class ResultCache:
             if quota <= 0:
                 continue
             if self._by_index.get(e.index, 0) <= quota:
+                continue
+            if self._pinned_locked(e):
                 continue
             self._drop_locked(key, evict=True)
             self._counters["quota_evictions"] += 1
@@ -498,25 +623,35 @@ class ResultCache:
                 )
                 if not covered:
                     continue
-                if e.repair_row is None:
+                if e.repair_spec is None and e.dep_rows is None:
                     self._drop_locked(key)
                 else:
-                    # kept for the repair window, but no longer
+                    # kept for the repair/re-key window, but no longer
                     # hit-likely: the admission discount must charge a
                     # possible recompute its full device bytes
                     e.maybe_stale = True
 
     def note_merges(self, token: int, merges: Iterable[Any]) -> None:
         """The merge barrier just applied staged deltas for fragments of
-        the view owning `token` (View.sync_pending). Patch every covered
-        repairable Count entry in place — count(new) = count(old) +
-        popcount(delta & ~old_words) when the burst touched its row,
-        version re-key alone when it did not — and drop everything else
-        covering a merged shard (their results are stale and
-        unrepairable)."""
+        the view owning `token` (View.sync_pending). Per covered entry:
+
+        - repair-spec Counts whose touched leaves all live in the
+          merging view patch in place under the lock (every leaf's
+          base words come from the barrier's consistent capture);
+        - repair-spec Counts with leaves in OTHER views become a
+          deferred patch job: the other operands' premerge words are
+          read outside this lock (fragment locks order below it — see
+          Fragment.on_mutate) and the job re-validates the entry's
+          whole vector before committing, dropping it on any doubt;
+        - entries whose exact `dep_rows` are disjoint from the burst
+          re-key forward without recompute (structural revalidation);
+        - everything else covering a merged shard drops (stale and
+          unrepairable).
+        """
         if not merges:
             return
         by_shard = {m.shard: m for m in merges}
+        jobs: List[dict] = []
         with self._mu:
             keys = self._by_token.get(token)
             if not keys:
@@ -525,60 +660,248 @@ class ResultCache:
                 e = self._entries.get(key)
                 if e is None:
                     continue
-                self._apply_merges_locked(e, token, by_shard)
+                job = self._apply_merges_locked(e, token, by_shard)
+                if job is not None:
+                    jobs.append(job)
+        for job in jobs:
+            self._run_patch_job(job)
 
     def _apply_merges_locked(
         self, e: _Entry, token: int, by_shard: Dict[int, Any]
-    ) -> None:
+    ) -> Optional[dict]:
+        """In-lock half of merge application. Returns None when fully
+        handled (patched, re-keyed, or dropped) or a deferred patch job
+        when other-view operand words must be read outside the lock.
+        Deferred entries keep their OLD vector until the job commits,
+        so they cannot serve a half-patched result — an exact-vector
+        hit in the window simply misses."""
         new_vector = list(e.vector)
         changed = False
         count = e.result if e.kind == "count" else None
+        units: List[dict] = []
+        dep_rekeyed = False
         for i, elem in enumerate(e.vector):
             if elem[0] != "v" or elem[4] != token:
                 continue
+            field, view = elem[2], elem[3]
             shards, versions = elem[5], list(elem[6])
+            spec_here = e.spec_rows(field, view)
             touched = False
             for pos, s in enumerate(shards):
                 m = by_shard.get(s)
                 if m is None:
                     continue
                 if (
-                    e.repair_row is None
-                    or not self._repair_enabled
+                    not self._repair_enabled
                     or not m.applied
                     or not m.clean
                     or versions[pos] != m.base_version
                 ):
                     self._drop_locked(e.key)
-                    return
-                if e.repair_row in m.rows:
-                    old = m.old_words.get(e.repair_row)
-                    if old is None:
-                        # the barrier had no interest registered when it
-                        # captured (entry raced in): unrepairable
+                    return None
+                burst = set(m.rows)
+                hit_leaves = spec_here & burst
+                if hit_leaves:
+                    unit = self._patch_unit_locked(
+                        e, elem, s, m, hit_leaves)
+                    if unit is None:
                         self._drop_locked(e.key)
-                        return
-                    widx, wvals = m.word_delta(e.repair_row)
-                    count += _popcount(
-                        np.bitwise_and(wvals, np.bitwise_not(old[widx]))
-                    )
-                    self._counters["repairs"] += 1
-                # row untouched by the burst: the count is unchanged and
-                # the entry just re-keys forward to the merged version
+                        return None
+                    if unit["reads"]:
+                        units.append(unit)
+                    else:
+                        count += unit["delta"]
+                        self._counters["repairs"] += 1
+                        if len(e.repair_spec[1]) > 1:
+                            self._counters["tree_repairs"] += 1
+                elif spec_here:
+                    # no leaf of the merging view touched: the count
+                    # is unchanged and the entry re-keys forward
+                    pass
+                else:
+                    dep = (e.dep_rows or {}).get((field, view))
+                    if dep is None or dep & burst:
+                        # unknown/total dependence, or a dependent row
+                        # changed: the stored result may differ
+                        self._drop_locked(e.key)
+                        return None
+                    dep_rekeyed = True
                 versions[pos] = m.new_version
                 touched = True
             if touched:
                 new_vector[i] = elem[:6] + (tuple(versions),)
                 changed = True
-        if changed:
-            e.vector = tuple(new_vector)
-            # the clock moved with the burst: disarm the fast path until
-            # the next exact-vector revalidation re-reads live clocks
+        if not changed:
+            return None
+        if units:
+            # defer: commit vector + count together once the operand
+            # reads land (outside this lock)
+            return {
+                "key": e.key,
+                "expect": e.vector,
+                "vector": tuple(new_vector),
+                "base": count,
+                "units": units,
+                "leaves": len(e.repair_spec[1]),
+            }
+        e.vector = tuple(new_vector)
+        # the clock moved with the burst: disarm the fast path until
+        # the next exact-vector revalidation re-reads live clocks
+        e.clocks = None
+        # patched/re-keyed to the merged versions: hit-likely again
+        e.maybe_stale = False
+        if e.kind == "count":
+            e.result = count
+        if dep_rekeyed:
+            self._counters["rekeys"] += 1
+        return None
+
+    def _patch_unit_locked(
+        self, e: _Entry, elem: tuple, shard: int, m: Any, hit_leaves: set
+    ) -> Optional[dict]:
+        """Build one shard's patch: old/new word arrays for every leaf
+        in the merging view (from the barrier's capture — one
+        consistent snapshot at base version), plus read descriptors
+        for leaves in OTHER views (resolved outside the lock). Returns
+        None when the capture is missing (entry raced in after the
+        barrier read interest)."""
+        op, leaves = e.repair_spec
+        field, view = elem[2], elem[3]
+        widx: Set[int] = set()
+        changed_pairs = []  # (old, new) full-row arrays, merging view
+        same_view = []      # old full-row arrays, untouched leaves
+        reads = []          # (field, view, row, expect_version)
+        for f, v, row in leaves:
+            if f == field and v == view:
+                old = m.old_words.get(row)
+                if old is None:
+                    return None
+                if row in hit_leaves:
+                    wi, wv = m.word_delta(row)
+                    new = old.copy()
+                    new[wi] |= wv
+                    widx.update(int(x) for x in wi)
+                    changed_pairs.append((old, new))
+                else:
+                    same_view.append(old)
+            else:
+                ver = self._elem_version(e.vector, f, v, shard)
+                if ver is None:
+                    return None
+                reads.append((f, v, row, ver))
+        if not widx:
+            return {"delta": 0, "reads": [], "shard": shard, "op": op,
+                    "widx": (), "changed": (), "same": (), "index": e.index}
+        wsel = np.array(sorted(widx), dtype=np.int64)
+        changed = tuple((o[wsel], n[wsel]) for o, n in changed_pairs)
+        same = tuple(o[wsel] for o in same_view)
+        if reads:
+            return {"delta": 0, "reads": reads, "shard": shard, "op": op,
+                    "widx": wsel, "changed": changed, "same": same,
+                    "index": e.index}
+        delta = _tree_delta(op, changed, same, ())
+        return {"delta": delta, "reads": [], "shard": shard, "op": op,
+                "widx": wsel, "changed": changed, "same": same,
+                "index": e.index}
+
+    @staticmethod
+    def _elem_version(
+        vector: tuple, field: str, view: str, shard: int
+    ) -> Optional[int]:
+        """The version `vector` pins for (field, view, shard) on a
+        LOCAL element, or None when no int-token element covers it."""
+        for el in vector:
+            if (
+                el[0] == "v"
+                and el[2] == field
+                and el[3] == view
+                and isinstance(el[4], int)
+                and shard in el[5]
+            ):
+                return el[6][el[5].index(shard)]
+        return None
+
+    def _run_patch_job(self, job: dict) -> None:
+        """Deferred half of a multi-view tree patch: read the other
+        operands' premerge words (fragment locks only — the cache lock
+        is NOT held), then commit count + vector iff the entry's vector
+        is still exactly what the in-lock half saw. Any surprise —
+        operand view gone, fragment version moved past the entry's
+        element, vector changed underneath — drops the entry instead:
+        revalidation semantics make dropping always safe."""
+        total = 0
+        ok = True
+        for unit in job["units"]:
+            other = []
+            for f, v, row, expect_ver in unit["reads"]:
+                words = self._read_operand(
+                    job["key"], f, v, row, unit["shard"], expect_ver)
+                if words is None:
+                    ok = False
+                    break
+                other.append(words[unit["widx"]])
+            if not ok:
+                break
+            total += _tree_delta(
+                unit["op"], unit["changed"], unit["same"], tuple(other))
+        with self._mu:
+            e = self._entries.get(job["key"])
+            if e is None:
+                return
+            if e.vector != job["expect"]:
+                # a concurrent barrier moved the entry while the reads
+                # were in flight: the reads may mix states — drop
+                self._drop_locked(job["key"])
+                return
+            if not ok:
+                self._drop_locked(job["key"])
+                return
+            e.vector = job["vector"]
             e.clocks = None
-            # patched to the merged versions: hit-likely again
             e.maybe_stale = False
-            if e.kind == "count":
-                e.result = count
+            e.result = job["base"] + total
+            self._counters["repairs"] += len(job["units"])
+            if job["leaves"] > 1:
+                self._counters["tree_repairs"] += len(job["units"])
+
+    def _read_operand(
+        self, key: tuple, field: str, view: str, row: int, shard: int,
+        expect_version: int,
+    ) -> Optional[np.ndarray]:
+        """Premerge words of one other-view operand, with a version
+        double-read bracketing the word read: the words are usable only
+        if the fragment provably sat at the entry's pinned version the
+        whole time (a stage bumps the version BEFORE any content can
+        move, so version-stable implies content-stable)."""
+        with self._mu:
+            ref = self._views.get(self._token_for(key, field, view))
+        v = ref() if ref is not None else None
+        if v is None:
+            return None
+        frag = v.fragments.get(shard)
+        if frag is None:
+            return None
+        v0 = frag.version
+        if v0 != expect_version:
+            return None
+        words = frag.premerge_row_words(row)
+        if frag.version != v0:
+            return None
+        return words
+
+    def _token_for(self, key: tuple, field: str, view: str) -> int:
+        e = self._entries.get(key)
+        if e is None:
+            return -1
+        for el in e.vector:
+            if (
+                el[0] == "v"
+                and el[2] == field
+                and el[3] == view
+                and isinstance(el[4], int)
+            ):
+                return el[4]
+        return -1
 
     def interest_rows(self, index: str, field: str, view: str) -> Set[int]:
         """Rows of (index, field, view) that repairable Count entries
@@ -589,6 +912,51 @@ class ResultCache:
             rows = self._interest.get((index, field, view))
             return set(rows) if rows else set()
 
+    # -- pins / view registry (coherence plane) ------------------------------
+
+    def pin_text(self, scope: Hashable, text: str) -> None:
+        """Pin every entry (current and future) stored for
+        (scope, text): eviction skips it. Refcounted — subscriptions
+        over the same program share the pin."""
+        with self._mu:
+            k = (scope, text)
+            self._pins[k] = self._pins.get(k, 0) + 1
+
+    def unpin_text(self, scope: Hashable, text: str) -> None:
+        with self._mu:
+            k = (scope, text)
+            n = self._pins.get(k, 0) - 1
+            if n > 0:
+                self._pins[k] = n
+            else:
+                self._pins.pop(k, None)
+
+    def register_view(self, view: Any) -> None:
+        """Make `view` resolvable by its `_stack_token` for deferred
+        tree-patch operand reads (View.open calls this; drop_view
+        removes the registration with the token's entries)."""
+        with self._mu:
+            self._views[view._stack_token] = weakref.ref(view)
+
+    def repair_likely(self, scope: Optional[Hashable], text: str) -> bool:
+        """Whether a maybe-stale entry for (scope, text) is expected to
+        come back via repair or re-key rather than recompute — the
+        admission estimator's middle tier (sched/cost.py): such a
+        repeat costs host microseconds, not device bytes, but charging
+        it fully-free would let a recompute bypass the byte budget when
+        the repair window closes unluckily."""
+        if scope is None:
+            return False
+        with self._mu:
+            keys = self._by_text.get((scope, text))
+            if not keys:
+                return False
+            return any(
+                e.repair_spec is not None or e.dep_rows is not None
+                for k in keys
+                if (e := self._entries.get(k)) is not None
+            )
+
     # -- GC ----------------------------------------------------------------
 
     def drop_view(self, token: int) -> None:
@@ -597,6 +965,7 @@ class ResultCache:
         with self._mu:
             for key in list(self._by_token.get(token, ())):
                 self._drop_locked(key)
+            self._views.pop(token, None)
 
     def drop_index(self, index: str) -> None:
         """Label GC on index delete (NodeServer.drop_index_telemetry):
@@ -642,6 +1011,8 @@ class ResultCache:
             self._tenant_quota_default = 0
             self._tenant_quota = {}
             self._quota_evictions_index = {}
+            self._pins = {}
+            self._views = {}
 
     # -- introspection ------------------------------------------------------
 

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from pilosa_tpu.utils.locks import TrackedLock, TrackedRLock
+from pilosa_tpu.coherence import hub as coherence_hub
 from pilosa_tpu.core import wal as walmod
 from pilosa_tpu.core.devcache import DEVICE_CACHE, new_owner_token
 from pilosa_tpu.core.fragment import Fragment
@@ -95,6 +96,10 @@ class View:
                         shard_s = fn.rsplit(".", 1)[0]
                         if shard_s.isdigit():
                             self.fragment(int(shard_s))
+        # coherence plane: register for deferred tree-repair operand reads
+        # (core/resultcache.py resolves tokens back to live views through
+        # this weak registry; a no-op when repair never defers)
+        RESULT_CACHE.register_view(self)
         return self
 
     def close(self) -> None:
@@ -109,6 +114,10 @@ class View:
             DEVICE_CACHE.invalidate_owner(self._stack_token)
             RESULT_CACHE.drop_view(self._stack_token)
             self._dirty_staged.clear()
+        # outside the view lock: publishers ship drop tombstones so leased
+        # mirrors forget this view instead of holding its last versions
+        # forever (monotone merge would otherwise mask the deletion)
+        coherence_hub.note_view_drop(self)
 
     def _fragment_path(self, shard: int) -> Optional[str]:
         if self.path is None:
@@ -164,6 +173,7 @@ class View:
             self.mutation_clock += 1
         DEVICE_CACHE.invalidate_owner_shard(self._stack_token, shard)
         RESULT_CACHE.note_mutation(self._stack_token, shard)
+        coherence_hub.note_view_mutation(self, (shard,))
         res = self.cold_resolver
         if res is not None:
             # writes count as activity for the tier's LRU demote clock —
@@ -199,7 +209,11 @@ class View:
                         pass
             DEVICE_CACHE.invalidate_owner(self._stack_token)
             RESULT_CACHE.drop_view(self._stack_token)
-            return True
+        # fragment gone: the publisher's flush finds no fragment for this
+        # shard and demotes the bump to a drop tombstone, so leased mirrors
+        # never pin the deleted shard's last version as live
+        coherence_hub.note_view_mutation(self, (shard,))
+        return True
 
     def available_shards(self) -> List[int]:
         with self._mu:
@@ -638,6 +652,7 @@ class View:
         # for the barrier's repair (stage_positions ran notify=False, so
         # the per-fragment on_mutate funnel did not fire)
         RESULT_CACHE.note_mutations(self._stack_token, dirty)
+        coherence_hub.note_view_mutation(self, dirty)
         with self._mu:
             self._dirty_staged.update(dirty)
 

@@ -92,6 +92,11 @@ class DistributedExecutor(Executor):
         self.mesh_min_nodes = mesh_min_nodes
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_mu = TrackedLock("distributed.pool_mu")
+        # coherence plane (pilosa_tpu/coherence/): set by NodeServer when
+        # [coherence] is enabled. A live lease mirror answers remote
+        # version vectors with zero wire round-trips; None = every remote
+        # repeat pays the /internal/versions RPC as before.
+        self.coherence = None
 
     def _fanout_pool(self) -> ThreadPoolExecutor:
         """Lazy shared pool for concurrent per-node requests (the role of
@@ -501,11 +506,15 @@ class DistributedExecutor(Executor):
                 parts, expect, len(ctx.views)
             ):
                 return None
-            # remote versions cost one RTT per peer: only repeat keys
-            # pay it (a one-off query would be taxed for nothing)
-            if not rcache.RESULT_CACHE.note_candidate(ctx.key):
-                return None
-            fetched = self._fetch_remote_versions(idx, ctx, rpc)
+            mgr = self.coherence
+            if mgr is not None and mgr.leases_enabled:
+                fetched = self._leased_remote_versions(idx, ctx, rpc, mgr)
+            else:
+                # remote versions cost one RTT per peer: only repeat keys
+                # pay it (a one-off query would be taxed for nothing)
+                if not rcache.RESULT_CACHE.note_candidate(ctx.key):
+                    return None
+                fetched = self._fetch_remote_versions(idx, ctx, rpc)
             if fetched is None:
                 return None
             it = iter(fetched)
@@ -537,6 +546,50 @@ class DistributedExecutor(Executor):
                 return False
             o += views_per_node
         return True
+
+    def _leased_remote_versions(self, idx: Index, ctx, rpc, mgr):
+        """Lease-plane replacement for the per-peer version round: a
+        live mirror answers a peer's element slice with ZERO wire RTTs;
+        uncovered peers try one lease acquire (which replaces this
+        round's version RPC and every later one — the mirror then
+        serves ALL keys over this (peer, index)) before degrading to
+        the plain fetch. Deliberately NO note_candidate gate: the lease
+        is per-(peer, index) and amortizes across every key, so even a
+        first-sighted key rides it — and because mirror elements are
+        bit-identical to /internal/versions elements, a fresh grant
+        retro-covers entries stored from earlier RPC vectors (the
+        second hit after lease grant is already RTT-free, not the
+        third). coherence.version_rtts counts only the rounds that
+        still paid a wire fetch."""
+        need: List[tuple] = []
+        slots: Dict[int, tuple] = {}
+        for pos, (nid, node_shards) in enumerate(rpc):
+            # the peer extends the shard list it receives by the call's
+            # Shift count before reading versions (versions_payload);
+            # mirror reads must cover the same extended axis to stay
+            # element-identical with fetched vectors
+            ext = tuple(
+                Executor._shards_for(self, idx, sorted(node_shards), ctx.call)
+            )
+            elems = mgr.mirror_elements(nid, idx.name, ctx.views, ext)
+            if elems is None and mgr.acquire(
+                nid, self._uri_of(nid), idx.name
+            ):
+                elems = mgr.mirror_elements(nid, idx.name, ctx.views, ext)
+            if elems is None:
+                need.append((nid, node_shards))
+            else:
+                slots[pos] = elems
+        if need:
+            mgr.count_version_rtt(len(need))
+            fetched = self._fetch_remote_versions(idx, ctx, need)
+            if fetched is None:
+                return None
+            it = iter(fetched)
+            for pos in range(len(rpc)):
+                if pos not in slots:
+                    slots[pos] = next(it)
+        return [slots[pos] for pos in range(len(rpc))]
 
     def _fetch_remote_versions(self, idx: Index, ctx, rpc):
         """One parallel /internal/versions round; None when any peer is

@@ -577,20 +577,21 @@ class _CacheCtx:
     first sighting of an RPC-vector key or an unreachable peer)."""
 
     __slots__ = (
-        "key", "kind", "views", "shard_list", "vector", "repair_row",
-        "text", "index_name", "opt_remote", "call", "clocks", "hit",
-        "hit_result",
+        "key", "kind", "views", "shard_list", "vector", "repair_spec",
+        "dep_rows", "text", "index_name", "opt_remote", "call", "clocks",
+        "hit", "hit_result",
     )
 
     def __init__(self, key, kind, views, shard_list, text, index_name,
-                 repair_row, opt_remote, call):
+                 repair_spec, dep_rows, opt_remote, call):
         self.key = key
         self.kind = kind
         self.views = views  # canonical sorted ((field, view), ...)
         self.shard_list = shard_list
         self.text = text
         self.index_name = index_name
-        self.repair_row = repair_row
+        self.repair_spec = repair_spec
+        self.dep_rows = dep_rows
         self.opt_remote = opt_remote
         self.call = call  # for per-node Shift shard-extension (distributed)
         self.vector = None
@@ -795,14 +796,14 @@ class Executor:
         if scope is None:
             return None
         views: List[Tuple[str, str]] = []
-        repair_row = None
+        repair_spec = None
         try:
             if kind == "count":
                 if len(c.children) != 1 or c.args:
                     return None
                 if not self._cache_views(idx, c.children[0], views):
                     return None
-                repair_row = self._cache_repair_row(c.children[0])
+                repair_spec = self._cache_repair_spec(c.children[0])
             elif kind == "topn":
                 if not set(c.args) <= _CACHE_TOPN_ARGS or len(c.children) > 1:
                     return None
@@ -844,13 +845,12 @@ class Executor:
         uniq = tuple(sorted(set(views)))
         if not uniq:
             return None
-        if repair_row is not None and len(uniq) != 1:
-            repair_row = None
+        dep_rows = self._cache_dep_rows(idx, c, kind)
         text = str(c)
         key = (scope, text, shard_list, bool(opt.remote))
         return _CacheCtx(
-            key, kind, uniq, shard_list, text, idx.name, repair_row,
-            bool(opt.remote), c,
+            key, kind, uniq, shard_list, text, idx.name, repair_spec,
+            dep_rows, bool(opt.remote), c,
         )
 
     def _cache_views(self, idx: Index, c: Call, out: list) -> bool:
@@ -901,12 +901,16 @@ class Executor:
                 return False
         return True
 
+    # monotone-tree repair leaf cap: op_popcount over the patch words is
+    # O(leaves × changed words) host work per merged shard — past a few
+    # operands a recompute through the normal dispatch path wins anyway
+    _REPAIR_MAX_LEAVES = 8
+
     @staticmethod
-    def _cache_repair_row(c: Call) -> Optional[int]:
-        """Count over a single plain Row is incrementally repairable:
-        the merge barrier's word delta patches the cached popcount in
-        place. Anything else (algebra, BSI, Not) falls back to
-        revalidate-or-recompute."""
+    def _repair_leaf(c: Call) -> Optional[Tuple[str, str, int]]:
+        """A plain translated Row(field=rid) — the only repairable leaf
+        shape (BSI conditions and keyed rows read state the word delta
+        does not carry)."""
         if c.name != "Row" or c.children or c.condition_args():
             return None
         args = [k for k in c.args if not k.startswith("_")]
@@ -915,7 +919,96 @@ class Executor:
         rid = c.args[args[0]]
         if isinstance(rid, bool) or not isinstance(rid, int):
             return None
-        return rid
+        return (args[0], VIEW_STANDARD, rid)
+
+    @classmethod
+    def _cache_repair_spec(cls, c: Call):
+        """Count over a pure Intersect/Union tree of plain Rows (or one
+        Row) is monotone-repairable: for set-only bursts the merge
+        barrier's word deltas recompute `popcount(op(leaves))` over just
+        the changed word indexes, and the telescoped per-shard delta
+        patches the cached total in place (core/resultcache.py). Mixed
+        nesting, Difference/Xor, BSI and Not fall back to
+        revalidate-or-recompute. Returns ("and"|"or", (leaf, ...))."""
+        lf = cls._repair_leaf(c)
+        if lf is not None:
+            return ("and", (lf,))
+        if c.name not in ("Intersect", "Union") or c.args:
+            return None
+        if not 2 <= len(c.children) <= cls._REPAIR_MAX_LEAVES:
+            return None
+        leaves = []
+        for ch in c.children:
+            lf = cls._repair_leaf(ch)
+            if lf is None:
+                return None
+            leaves.append(lf)
+        return ("and" if c.name == "Intersect" else "or", tuple(leaves))
+
+    def _cache_dep_rows(self, idx: Index, c: Call, kind: str):
+        """Row-level dependency map for structural re-key:
+        {(field, view): frozenset(row_ids) | None}, where None means the
+        entry depends on ALL rows of that view (existence walks, BSI
+        planes, TopN/GroupBy tally scans). A merge burst that provably
+        touched no depended-on row of its view re-keys the entry to the
+        merged versions without recompute (core/resultcache.py). Missing
+        views behave as None on the cache side, so a partial map is
+        safe — but the walk mirrors _cache_views, which already gated
+        every shape that can reach here."""
+        deps: Dict[Tuple[str, str], Optional[set]] = {}
+
+        def dep_all(fname: str, vname: str) -> None:
+            deps[(fname, vname)] = None
+
+        def dep_row(fname: str, vname: str, rid: int) -> None:
+            cur = deps.get((fname, vname), set())
+            if cur is not None:
+                cur.add(rid)
+                deps[(fname, vname)] = cur
+
+        def walk(call: Call) -> None:
+            lf = self._repair_leaf(call)
+            if lf is not None:
+                dep_row(*lf)
+                return
+            if call.name in ("Row", "Range"):
+                conds = call.condition_args()
+                fname = next(iter(conds)) if conds else None
+                f = idx.field(fname) if fname else None
+                dep_all(fname, f.bsi_view_name() if f is not None else "")
+                return
+            if call.name in ("Not", "All"):
+                ef = idx.existence_field()
+                dep_all(ef.name if ef is not None else "", VIEW_STANDARD)
+            for child in call.children:
+                walk(child)
+            for v in call.args.values():
+                if isinstance(v, Call):
+                    walk(v)
+
+        try:
+            if kind == "count":
+                walk(c.children[0])
+            elif kind == "topn":
+                # the tally scan reads every row of the main field
+                dep_all(c.args["_field"], VIEW_STANDARD)
+                for child in c.children:
+                    walk(child)
+            else:  # groupby: each Rows() enumerates all rows of its field
+                for child in c.children:
+                    fname = child.args.get("field") or child.args.get("_field")
+                    dep_all(fname, VIEW_STANDARD)
+                filt = c.args.get("filter")
+                if isinstance(filt, Call):
+                    walk(filt)
+        except Exception:  # noqa: BLE001 - dep map is an optimization only
+            return None
+        if not deps:
+            return None
+        return {
+            k: (frozenset(v) if v is not None else None)
+            for k, v in deps.items()
+        }
 
     def local_version_vector(
         self, idx: Index, views, shard_list, node: str = ""
@@ -1014,7 +1107,9 @@ class Executor:
         found, res = RC.get(ctx.key, ctx.vector, recount=False)
         if found:
             RC.refresh_clocks(ctx.key, clocks)
-        elif ctx.repair_row is not None and RC.repairable(ctx.key):
+        elif (
+            ctx.repair_spec is not None or ctx.dep_rows is not None
+        ) and RC.repairable(ctx.key):
             # cheap repair: collect the current versions UNDER the read
             # barrier — sync_pending runs the merge barrier, which fires
             # note_merges and patches the cached Count from the burst's
@@ -1065,7 +1160,8 @@ class Executor:
             return
         rcache.RESULT_CACHE.put(
             ctx.key, ctx.kind, ctx.index_name, ctx.text, result, ctx.vector,
-            repair_row=ctx.repair_row, clocks=ctx.clocks,
+            repair_spec=ctx.repair_spec, dep_rows=ctx.dep_rows,
+            clocks=ctx.clocks,
         )
 
     # ------------------------------------------------------------------

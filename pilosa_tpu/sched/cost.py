@@ -411,16 +411,29 @@ def estimate(
 
             scope = getattr(idx, "_cache_scope", None)
             read_calls = [c for c in calls if c.name not in _WRITE_CALLS]
-            if (
-                scope is not None
-                and read_calls
-                and all(
-                    (t := _probe_text(idx, c)) is not None
-                    and RESULT_CACHE.has_text(scope, t)
-                    for c in read_calls
-                )
-            ):
-                peak = 0
+            if scope is not None and read_calls:
+                texts = [_probe_text(idx, c) for c in read_calls]
+                if all(
+                    t is not None and RESULT_CACHE.has_text(scope, t)
+                    for t in texts
+                ):
+                    peak = 0
+                elif all(
+                    t is not None
+                    and (
+                        RESULT_CACHE.has_text(scope, t)
+                        or RESULT_CACHE.repair_likely(scope, t)
+                    )
+                    for t in texts
+                ):
+                    # middle tier: every read call is either hit-likely
+                    # or maybe-stale-but-repairable (monotone-tree patch
+                    # / re-key from merge word deltas) — the repeat
+                    # costs host microseconds, so charge one row-stack
+                    # as a floor instead of the full device walk; the
+                    # floor keeps a recompute from riding byte-free if
+                    # the repair window closes unluckily
+                    peak = min(peak, stack_bytes)
         if peak and idx is not None:
             # cached-resident discount: operands already in HBM stage for
             # free, so don't charge the byte account for them twice —

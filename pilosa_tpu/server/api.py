@@ -454,6 +454,22 @@ class API:
         )
         return QueryResponse(results=results), q
 
+    # -- query subscriptions (pilosa_tpu/coherence/) -----------------------
+
+    def subscribe(self, index: str, query: str) -> dict:
+        """Register a standing PQL program against `index`: the
+        coherence manager executes it once, pins its result-cache
+        entries, and pushes updates on invalidation (long-polled by the
+        handler). Raises NotFoundError when subscriptions are disabled
+        or the index does not exist; ShedError over the cap."""
+        self._validate("subscribe")
+        mgr = self.server.coherence
+        if mgr is None or not mgr.subs_enabled:
+            raise NotFoundError("subscriptions disabled")
+        if self.holder.index(index) is None:
+            raise NotFoundError(f"index not found: {index}")
+        return mgr.subscribe(index, query)
+
     # -- schema DDL (api.go:206-368) ---------------------------------------
 
     def create_index(

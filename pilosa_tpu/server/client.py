@@ -394,6 +394,42 @@ class InternalClient:
             timeout=timeout,
         ) or {}
 
+    # -- cache coherence plane (pilosa_tpu/coherence/) ---------------------
+
+    def coherence_lease(
+        self,
+        uri: str,
+        *,
+        node: str,
+        node_uri: str,
+        index: str,
+        timeout: float = 5.0,
+    ) -> dict:
+        """Acquire a coherence lease on a publisher (POST
+        /internal/coherence/lease): the reply is a whole-index version
+        snapshot the holder mirrors, after which pushed bumps keep it
+        current with zero per-query version RTTs. Short timeout like
+        fragment_versions — an unreachable publisher degrades the
+        caller to the plain revalidate path, never blocks a query."""
+        body = {"node": node, "node_uri": node_uri, "index": index}
+        return self._json(
+            "POST", uri, "/internal/coherence/lease",
+            json.dumps(body).encode(), timeout=timeout,
+        ) or {}
+
+    def coherence_publish(
+        self, uri: str, payload: dict, timeout: float = 5.0
+    ) -> dict:
+        """Push one batched version-bump payload to a lease holder
+        (POST /internal/coherence/publish). Rides the same retry/breaker
+        plane as every internode verb; a failed push drops the grant on
+        the publisher side (the holder's mirror then expires and
+        degrades to revalidate within the lease bound)."""
+        return self._json(
+            "POST", uri, "/internal/coherence/publish",
+            json.dumps(payload).encode(), timeout=timeout,
+        ) or {}
+
     def node_stats(self, uri: str, timeout: float = 5.0) -> dict:
         """One peer's mergeable registry export (GET /internal/stats) —
         the federated rollup's pull path. Short default timeout: a dead

@@ -667,6 +667,88 @@ class Handler(BaseHTTPRequestHandler):
             {"boot": self.node.boot_id, "shards": shard_list, "views": views}
         )
 
+    # -- cache coherence plane (pilosa_tpu/coherence/) ---------------------
+
+    @route("POST", "/internal/coherence/lease")
+    def post_coherence_lease(self):
+        """Grant a coherence lease: the reply is a whole-index version
+        snapshot the caller mirrors; pushed bumps keep it current. 404
+        when leases are disabled here — the caller backs off to the
+        plain /internal/versions revalidate path."""
+        d = self._json_body_dict()
+        mgr = self.node.coherence
+        if mgr is None or not mgr.leases_enabled:
+            raise NotFoundError("coherence leases disabled")
+        g = mgr.grant(
+            self._body_str(d, "node"),
+            self._body_str(d, "node_uri"),
+            self._body_str(d, "index"),
+        )
+        if g is None:
+            raise NotFoundError(f"index not found: {d.get('index')}")
+        self._reply(g)
+
+    @route("POST", "/internal/coherence/publish")
+    def post_coherence_publish(self):
+        """Apply one batched version-bump payload to this node's lease
+        mirror. `ok: false` (seq gap, boot mismatch, unknown grant)
+        tells the publisher to drop the grant — the next query here
+        re-leases from a fresh snapshot."""
+        mgr = self.node.coherence
+        if mgr is None:
+            raise NotFoundError("coherence disabled")
+        self._reply(mgr.apply_publish(self._json_body_dict()))
+
+    @route("POST", "/subscriptions")
+    def post_subscription(self):
+        """Register a standing PQL program: the node pins its result
+        entries and pushes updates on invalidation (long-polled via GET
+        /subscriptions/<id>). Over-cap registration sheds 429 through
+        the standard admission mapping."""
+        d = self._json_body_dict()
+        self._reply(
+            self.api.subscribe(
+                self._body_str(d, "index"), self._body_str(d, "query")
+            )
+        )
+
+    @route("GET", "/subscriptions")
+    def get_subscriptions(self):
+        mgr = self.node.coherence
+        if mgr is None or not mgr.subs_enabled:
+            raise NotFoundError("subscriptions disabled")
+        self._reply({"subscriptions": mgr.list_subscriptions()})
+
+    @route("GET", "/subscriptions/(?P<sub_id>[^/]+)")
+    def get_subscription(self, sub_id: str):
+        """Long-poll one subscription: blocks until seq > `after`, the
+        subscription closes, or `wait` seconds pass (capped server-side;
+        a timeout returns the current seq with no result payload)."""
+        mgr = self.node.coherence
+        if mgr is None or not mgr.subs_enabled:
+            raise NotFoundError("subscriptions disabled")
+        after = self._int_param("after", -1)
+        raw_wait = self.query.get("wait", "0")
+        try:
+            wait = float(raw_wait or 0)
+        except ValueError:
+            raise BadParam(
+                f"query parameter 'wait' must be a number, got {raw_wait!r}"
+            ) from None
+        snap = mgr.poll(sub_id, after, wait)
+        if snap is None:
+            raise NotFoundError(f"subscription not found: {sub_id}")
+        self._reply(snap)
+
+    @route("DELETE", "/subscriptions/(?P<sub_id>[^/]+)")
+    def delete_subscription(self, sub_id: str):
+        mgr = self.node.coherence
+        if mgr is None or not mgr.subs_enabled:
+            raise NotFoundError("subscriptions disabled")
+        if not mgr.unsubscribe(sub_id):
+            raise NotFoundError(f"subscription not found: {sub_id}")
+        self._reply({"success": True})
+
     @route("POST", "/internal/cluster/message")
     def post_cluster_message(self):
         self._reply(self.api.receive_message(self._json_body()))

@@ -125,6 +125,10 @@ class NodeServer:
         tier_demote_after: float = 300.0,  # idle seconds before demotion; 0 off
         tier_host_budget_bytes: int = 0,  # local snap+wal byte cap; 0 = no cap
         tier_fetch_concurrency: int = 4,  # parallel object-store transfers
+        coherence_lease_duration: float = 0.0,  # s; 0 disables version leases
+        coherence_publish_batch_ms: float = 20.0,  # bump batch/flush tick, ms
+        coherence_max_subscriptions: int = 64,  # per-node cap; 0 disables subs
+        coherence_sub_poll_interval: float = 5.0,  # unleased refresh floor, s
     ):
         self.data_dir = data_dir
         # durable node identity: a data dir that already carries a .id keeps
@@ -294,6 +298,34 @@ class NodeServer:
         DEVICE_CACHE.configure_quotas(
             default_bytes=hbm_default, overrides=hbm_over
         )
+        # cache coherence plane (pilosa_tpu/coherence/): push invalidation
+        # + version leases + query subscriptions. Per-NODE manager (like
+        # the tracer): in-process harness nodes each publish their own
+        # views and hold their own mirrors. None = both planes disabled —
+        # the hub's empty-registry fast path keeps mutation cost at zero.
+        self.coherence = None
+        self.coherence_tick_interval = 0.0
+        if coherence_lease_duration > 0 or coherence_max_subscriptions > 0:
+            from pilosa_tpu.coherence.manager import CoherenceManager
+
+            self.coherence = CoherenceManager(
+                node_id=node_id,
+                boot_id=self.boot_id,
+                holder=self.holder,
+                client=self.client,
+                logger=self.logger,
+                lease_duration=coherence_lease_duration,
+                publish_batch_ms=coherence_publish_batch_ms,
+                max_subscriptions=coherence_max_subscriptions,
+                sub_poll_interval=coherence_sub_poll_interval,
+            )
+            self.coherence_tick_interval = max(
+                0.005, float(coherence_publish_batch_ms) / 1000.0
+            )
+        # the executor consults the mirror plane before paying remote
+        # version RPCs (exec/distributed.py _leased_remote_versions)
+        self.executor.coherence = self.coherence
+        self._coherence_thread = None
         self.prefetcher = None
         if hbm_prefetch_depth > 0 and self.scheduler is not None:
             self.prefetcher = hbmmod.Prefetcher(
@@ -663,7 +695,51 @@ class NodeServer:
                 daemon=True,
             )
             self._tier_thread.start()
+        if self.coherence is not None:
+            from pilosa_tpu.coherence import hub as coherence_hub
+
+            self.coherence.start(
+                exec_fn=self._coherence_exec,
+                uri_fn=lambda: self.node.uri,
+                tracer=self.tracer,
+            )
+            # registered AFTER start: the hub funnels mutation notes in
+            # under fragment locks, and the manager must be fully wired
+            # before the first note arrives
+            coherence_hub.register(self.coherence)
+            self._coherence_thread = threading.Thread(
+                target=self._coherence_loop,
+                name=f"coherence-{self.node.id}",
+                daemon=True,
+            )
+            self._coherence_thread.start()
         return self
+
+    def _coherence_loop(self) -> None:
+        """Coherence flush ticker: batch dirty-view bumps into pushed
+        publishes (one wire payload per grant per tick), expire dead
+        mirrors, and wake subscription refreshes."""
+        while not self._closing.wait(self.coherence_tick_interval):
+            try:
+                self.coherence.tick()
+            except Exception as e:  # noqa: BLE001 - keep the ticker alive
+                self._ticker_error("coherence", e)
+
+    def _coherence_exec(self, index: str, query: str):
+        """Subscription (re)compute: through normal admission in the
+        batch WFQ class — a standing query is background work charged to
+        its tenant's buckets, never allowed to starve interactive
+        traffic. Returns the PUBLIC wire encoding so pushed results are
+        bit-identical to what a poller of POST /index/{i}/query sees."""
+        from pilosa_tpu.sched import admission as _admission
+
+        resp = self.api.query_response(
+            index, query,
+            headers={_admission.PRIORITY_HEADER: _admission.CLASS_BATCH},
+        )
+        from pilosa_tpu.server import wire
+
+        return [wire.result_to_public_json(r) for r in resp.results]
 
     def _tier_demote_loop(self) -> None:
         """Tier demotion ticker: idle cold-placement fragments demote to
@@ -876,6 +952,42 @@ class NodeServer:
                 self.stats.with_tags(f"index:{idx}").gauge(
                     "tier.local_bytes", 0
                 )
+        # monotone-tree repair / structural re-key counters ride the
+        # cache.* family (they are result-cache behavior and exist with
+        # coherence disabled — PR 13's repair generalized)
+        self.stats.gauge("cache.tree_repairs", csnap["tree_repairs"])
+        self.stats.gauge("cache.rekeys", csnap["rekeys"])
+        # cache coherence plane (pilosa_tpu/coherence/): lease/publish/
+        # subscription counters and gauges, plus the per-index
+        # subscription gauge with the same stale-zero pattern as
+        # hbm.resident_bytes. Gated on active(): a node that never
+        # leased, granted, or subscribed renders NO coherence.* series
+        # (the unleased-harness contract in tools/metrics_smoke.py).
+        mgr = self.coherence
+        if mgr is not None and mgr.active():
+            ccnt = mgr.counters_snapshot()
+            self.stats.gauge("coherence.version_rtts", ccnt["version_rtts"])
+            self.stats.gauge("coherence.lease_hits", ccnt["lease_hits"])
+            self.stats.gauge("coherence.grants_issued", ccnt["grants_issued"])
+            self.stats.gauge("coherence.publishes", ccnt["publishes"])
+            self.stats.gauge("coherence.publish_errors",
+                             ccnt["publish_errors"])
+            self.stats.gauge("coherence.invalidations", ccnt["invalidations"])
+            self.stats.gauge("coherence.sub_pushes", ccnt["sub_pushes"])
+            cg = mgr.gauges()
+            self.stats.gauge("coherence.leases", cg["leases"])
+            self.stats.gauge("coherence.grants", cg["grants"])
+            subs = mgr.subscriptions_by_index()
+            sstale = getattr(self, "_coh_idx_published", set()) - set(subs)
+            self._coh_idx_published = set(subs)
+            for idx, n in subs.items():
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "coherence.subscriptions", n
+                )
+            for idx in sstale:
+                self.stats.with_tags(f"index:{idx}").gauge(
+                    "coherence.subscriptions", 0
+                )
 
     def drop_index_telemetry(self, index: str) -> None:
         """Label GC for a deleted index: remove every per-index metric
@@ -893,6 +1005,15 @@ class NodeServer:
         from pilosa_tpu.exec import meshgroup
 
         meshgroup.drop_index(index)
+        # coherence GC: the index's subscriptions close (unpinning their
+        # cache entries and releasing blocked long-polls), its grants
+        # and lease mirrors drop, and the coherence.subscriptions series
+        # must not be resurrected by a stale-zero publish
+        if self.coherence is not None:
+            self.coherence.drop_index(index)
+        coh_published = getattr(self, "_coh_idx_published", None)
+        if coh_published is not None:
+            coh_published.discard(index)
         # result-cache entries and their per-index byte attribution must
         # not outlive the index (cache.resident_bytes{index} label GC)
         from pilosa_tpu.core.resultcache import RESULT_CACHE
@@ -1011,6 +1132,18 @@ class NodeServer:
 
             unregister_group_member(self.mesh_group_name, self.node.id)
         self.profiler.close()  # unblock any open /debug/pprof window
+        if self.coherence is not None:
+            from pilosa_tpu.coherence import hub as coherence_hub
+
+            # unregister BEFORE stop: notes must not land on a manager
+            # that is tearing down; stop() then closes every
+            # subscription (releasing blocked long-polls) and joins the
+            # push worker
+            coherence_hub.unregister(self.coherence)
+            self.coherence.stop()
+        if self._coherence_thread is not None:
+            self._coherence_thread.join(timeout=5.0)
+            self._coherence_thread = None
         with self._import_pool_mu:
             pool, self._import_pool = self._import_pool, None
             rpool, self._route_pool = self._route_pool, None

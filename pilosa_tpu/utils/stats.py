@@ -190,6 +190,30 @@ STAT_NAMES = frozenset(
         "tier.sync_uploads",
         "tier.cold_fragments",
         "tier.local_bytes",
+        # result-cache monotone-tree maintenance (core/resultcache.py
+        # counters surfaced by publish_cache_gauges): in-place tree
+        # patches from merge word-deltas and structural re-keys of
+        # entries whose burst provably touched no depended-on row
+        "cache.tree_repairs",
+        "cache.rekeys",
+        # cache coherence plane (pilosa_tpu/coherence/): push
+        # invalidation + version leases + live query subscriptions.
+        # version_rtts counts peers that still paid a wire
+        # /internal/versions fetch during fan-out revalidation (a
+        # leased warm hit leaves it flat); lease_hits counts mirrors
+        # served without that RTT; publishes/publish_errors/
+        # invalidations track the batched push path; sub_pushes counts
+        # delivered subscription updates
+        "coherence.version_rtts",
+        "coherence.lease_hits",
+        "coherence.leases",
+        "coherence.grants",
+        "coherence.grants_issued",
+        "coherence.publishes",
+        "coherence.publish_errors",
+        "coherence.invalidations",
+        "coherence.sub_pushes",
+        "coherence.subscriptions",
     }
 )
 
@@ -234,6 +258,7 @@ STAT_LABELS: Dict[str, Tuple[str, ...]] = {
     "tenant.quota_evictions": ("cache", "index"),
     "tier.cold_fragments": ("index",),
     "tier.local_bytes": ("index",),
+    "coherence.subscriptions": ("index",),
     "mesh.fallback": ("reason",),
     # federation meta-gauges (server/telemetry.py writes these into the
     # merged registry directly; the "cluster." prefix covers the names)

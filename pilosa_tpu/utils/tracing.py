@@ -92,6 +92,14 @@ SPAN_NAMES = frozenset(
         # one single-flight cold-fragment hydration — object fetch,
         # checksum verify, adopt; tags: index / shard / bytes
         "tier.hydrate",
+        # cache coherence plane (pilosa_tpu/coherence/manager.py): one
+        # batched version-vector publish flush to lease holders; tags:
+        # grants / errors
+        "coherence.publish",
+        # one subscription update delivery attempt — incremental repair
+        # or batch-class recompute, then long-poll wakeup; tags:
+        # index / sub / pushed / shed / error
+        "sub.push",
     }
 )
 

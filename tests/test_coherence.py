@@ -1,0 +1,582 @@
+"""Cache coherence plane (pilosa_tpu/coherence/): version leases with
+push invalidation (leased fan-out warm hits counter-asserted at zero
+version RTTs and zero compiled dispatches, retro-cover of pre-lease
+entries, deterministic lease-expiry/partition matrix on an injected
+clock), monotone-tree repair and structural re-key of cached results,
+and live query subscriptions (push == poll bit-for-bit, cap shedding,
+index-delete GC, the @slow staged-ingest soak)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.naive import NaiveBitmap
+from pilosa_tpu.core.resultcache import RESULT_CACHE
+from pilosa_tpu.exec import plan as planmod
+from pilosa_tpu.sched.admission import ShedError
+from pilosa_tpu.server import wire
+from pilosa_tpu.server.faults import FaultInjector
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import ClusterHarness
+
+
+def _harness(n=1, **kw):
+    kw.setdefault("in_memory", True)
+    kw.setdefault("telemetry_sample_interval", 0.0)
+    return ClusterHarness(n, **kw)
+
+
+def _seed(api, index="i", rows=(1, 2, 3), n=200, shards=2, seed=7):
+    rng = np.random.default_rng(seed)
+    api.create_index(index)
+    api.create_field(index, "f")
+    for r in rows:
+        cols = rng.integers(0, shards * SHARD_WIDTH, n).astype(np.uint64)
+        api.import_bits(index, "f", np.full(len(cols), r, np.uint64), cols)
+
+
+def _import_row(api, index, field, row, cols):
+    cols = np.asarray(sorted(cols), dtype=np.uint64)
+    api.import_bits(index, field, np.full(len(cols), row, np.uint64), cols)
+
+
+def _public(api, index, q):
+    """What a poller would read off the wire — the bit-identity oracle
+    for pushed subscription results."""
+    resp = api.query_response(index, q)
+    return [wire.result_to_public_json(r) for r in resp.results]
+
+
+def _remote_shard(c, index="i", shards=4):
+    """A shard NOT owned by the coordinator (node0)."""
+    for s in range(shards):
+        if c[0].cluster.shard_nodes(index, s)[0].id != c[0].node.id:
+            return s
+    raise AssertionError("no remote shard in the harness placement")
+
+
+def _snap():
+    return RESULT_CACHE.stats_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# version leases: zero-RTT fan-out warm hits
+# ---------------------------------------------------------------------------
+
+
+class TestLeases:
+    def test_leased_warm_hit_zero_rtts_zero_dispatches(self):
+        with _harness(2, coherence_lease_duration=30.0) as c:
+            api = c[0].api
+            _seed(api, shards=4)
+            q = "Count(Row(f=1))"
+            cold = api.query("i", q)[0]
+            mgr = c[0].coherence
+            s0 = mgr.counters_snapshot()
+            e0, r0 = planmod.STATS["evals"], planmod.STATS["host_reads"]
+            warm = api.query("i", q)[0]
+            s1 = mgr.counters_snapshot()
+            assert warm == cold
+            # the acceptance counters: the leased warm hit paid NO
+            # /internal/versions round and NO compiled dispatch
+            assert s1["version_rtts"] == s0["version_rtts"]
+            assert s1["lease_hits"] > s0["lease_hits"]
+            assert planmod.STATS["evals"] == e0
+            assert planmod.STATS["host_reads"] == r0
+            # and the publisher actually granted
+            assert any(
+                s.coherence.counters_snapshot()["grants_issued"] >= 1
+                for s in c.nodes
+            )
+
+    def test_lease_retro_covers_pre_lease_entries(self):
+        """Regression for the PR-13 candidate-gating gap: entries stored
+        from fetched vectors BEFORE any lease existed must validate
+        against mirror-assembled vectors the moment a lease lands — the
+        first leased repeat is already RTT-free, not the second."""
+        with _harness(2) as c:  # leases OFF at boot (managers still live)
+            api = c[0].api
+            _seed(api, shards=4)
+            q = "Count(Row(f=1))"
+            # candidate-gated path: sighting 1 uncached, 2 stores, 3 hits
+            vals = [api.query("i", q)[0] for _ in range(3)]
+            assert len(set(vals)) == 1
+            for s in c.nodes:
+                s.coherence.lease_duration = 30.0
+            mgr = c[0].coherence
+            rt0 = mgr.counters_snapshot()["version_rtts"]
+            e0 = planmod.STATS["evals"]
+            # FIRST leased repeat: the acquire replaces the version RPC
+            # and the grant snapshot revalidates the pre-lease entry
+            assert api.query("i", q)[0] == vals[0]
+            assert mgr.counters_snapshot()["version_rtts"] == rt0
+            assert planmod.STATS["evals"] == e0
+
+    def test_expiry_degrades_to_revalidate_within_bound(self):
+        """Partitioned/dead publisher: staleness is bounded by the lease
+        duration (injected clock), after which the coordinator falls
+        back to the wire revalidate and serves the fresh answer."""
+        with _harness(2, coherence_lease_duration=5.0) as c:
+            api = c[0].api
+            mgr = c[0].coherence
+            t = [1000.0]
+            mgr._clock = lambda: t[0]  # holder-side expiry only
+            _seed(api, shards=4)
+            s_remote = _remote_shard(c)
+            col = s_remote * SHARD_WIDTH + 13
+            api.import_bits(  # known-clear target column
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64), clear=True,
+            )
+            q = "Count(Row(f=1))"
+            base = api.query("i", q)[0]
+            assert api.query("i", q)[0] == base  # leased mirror armed
+            # full publisher partition: no publishes, no re-grants
+            inj = FaultInjector()
+            inj.add_rule("refuse", path="/internal/coherence")
+            for s in c.nodes:
+                s.client.fault_injector = inj
+            c[1].api.import_bits(  # write the holder cannot hear about
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64),
+            )
+            c[1].coherence.tick()  # publish attempt fails, grant dropped
+            assert (
+                c[1].coherence.counters_snapshot()["publish_errors"] >= 1
+            )
+            # within the lease bound the serve may be stale — but only
+            # by this one unheard write, never arbitrarily wrong
+            assert api.query("i", q)[0] in (base, base + 1)
+            rt0 = mgr.counters_snapshot()["version_rtts"]
+            t[0] += 6.0  # past the lease bound: mirror expires
+            assert api.query("i", q)[0] == base + 1
+            assert mgr.counters_snapshot()["version_rtts"] > rt0
+
+    @pytest.mark.parametrize("kind", ["refuse", "timeout", "http500"])
+    def test_publish_fault_matrix_never_serves_past_bound(self, kind):
+        with _harness(2, coherence_lease_duration=5.0) as c:
+            api = c[0].api
+            mgr = c[0].coherence
+            t = [500.0]
+            mgr._clock = lambda: t[0]
+            _seed(api, shards=4)
+            s_remote = _remote_shard(c)
+            col = s_remote * SHARD_WIDTH + 21
+            api.import_bits(
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64), clear=True,
+            )
+            q = "Count(Row(f=1))"
+            base = api.query("i", q)[0]
+            assert api.query("i", q)[0] == base
+            inj = FaultInjector()
+            inj.add_rule(kind, path="/internal/coherence/publish")
+            c[1].client.fault_injector = inj
+            c[1].api.import_bits(
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64),
+            )
+            c[1].coherence.tick()
+            assert (
+                c[1].coherence.counters_snapshot()["publish_errors"] >= 1
+            )
+            t[0] += 6.0
+            # expiry + healthy re-acquire (lease path is NOT faulted):
+            # the fresh grant snapshot carries the new version
+            assert api.query("i", q)[0] == base + 1
+
+    def test_lease_acquire_fault_falls_back_to_fetch(self):
+        with _harness(2, coherence_lease_duration=5.0) as c:
+            inj = FaultInjector()
+            inj.add_rule("refuse", path="/internal/coherence/lease")
+            c[0].client.fault_injector = inj
+            api = c[0].api
+            _seed(api, shards=4)
+            q = "Count(Row(f=1))"
+            base = api.query("i", q)[0]
+            mgr = c[0].coherence
+            rt0 = mgr.counters_snapshot()["version_rtts"]
+            assert api.query("i", q)[0] == base  # correct, just not free
+            snap = mgr.counters_snapshot()
+            assert snap["version_rtts"] > rt0  # paid the wire round
+            assert snap["lease_hits"] == 0
+            assert mgr.gauges()["leases"] == 0
+
+    def test_seq_gap_drops_the_mirror(self):
+        """A lost publish (sequence gap) must invalidate the whole
+        mirror — a mirror that silently skipped a bump could validate a
+        stale entry as fresh forever."""
+        with _harness(2, coherence_lease_duration=30.0) as c:
+            api = c[0].api
+            _seed(api, shards=4)
+            q = "Count(Row(f=1))"
+            api.query("i", q)
+            api.query("i", q)
+            mgr = c[0].coherence
+            assert mgr.gauges()["leases"] >= 1
+            (key,) = [k for k in mgr._mirrors]
+            nid, index = key
+            m = mgr._mirrors[key]
+            resp = mgr.apply_publish({
+                "node": nid, "index": index, "boot": m.boot,
+                "seq": m.seq + 2, "bumps": [], "drops": [],
+            })
+            assert resp == {"ok": False}
+            assert mgr.gauges()["leases"] == 0
+
+    def test_index_delete_gc_revokes_everything(self):
+        with _harness(2, coherence_lease_duration=30.0) as c:
+            api = c[0].api
+            _seed(api, shards=4)
+            q = "Count(Row(f=1))"
+            api.query("i", q)
+            api.query("i", q)
+            sub = api.subscribe("i", q)
+            assert c[0].coherence.gauges()["leases"] >= 1
+            assert any(
+                s.coherence.gauges()["grants"] >= 1 for s in c.nodes
+            )
+            api.delete_index("i")
+            assert c[0].coherence.list_subscriptions() == []
+            assert c[0].coherence.poll(sub["id"], -1, 0.0) is None
+            for s in c.nodes:
+                g = s.coherence.gauges()
+                assert g == {"leases": 0, "grants": 0}
+
+
+# ---------------------------------------------------------------------------
+# monotone-tree repair and structural re-key
+# ---------------------------------------------------------------------------
+
+
+class TestTreeRepair:
+    def _tree_env(self, c):
+        api = c[0].api
+        api.create_index("i")
+        api.create_field("i", "f")
+        r1 = set(range(0, 300, 2))
+        r2 = set(range(0, 300, 3))
+        _import_row(api, "i", "f", 1, r1)
+        _import_row(api, "i", "f", 2, r2)
+        return api, r1, r2
+
+    def test_intersect_tree_repairs_in_place(self):
+        with _harness(1) as c:
+            api, r1, r2 = self._tree_env(c)
+            q = "Count(Intersect(Row(f=1), Row(f=2)))"
+            want = len(r1 & r2)
+            assert api.query("i", q)[0] == want
+            assert api.query("i", q)[0] == want  # cached
+            burst = set(range(100, 500, 5))
+            _import_row(api, "i", "f", 1, burst)
+            r1 |= burst
+            tr0, e0 = _snap()["tree_repairs"], planmod.STATS["evals"]
+            got = api.query("i", q)[0]
+            assert got == len(r1 & r2)
+            assert _snap()["tree_repairs"] > tr0
+            assert planmod.STATS["evals"] == e0  # host patch, no device
+            # oracle: naive model and a cache-dropped recompute agree
+            assert got == NaiveBitmap(r1).intersect(NaiveBitmap(r2)).count()
+            RESULT_CACHE.reset()
+            assert api.query("i", q)[0] == got
+
+    def test_union_tree_repairs_in_place(self):
+        with _harness(1) as c:
+            api, r1, r2 = self._tree_env(c)
+            q = "Count(Union(Row(f=1), Row(f=2)))"
+            want = len(r1 | r2)
+            assert api.query("i", q)[0] == want
+            assert api.query("i", q)[0] == want
+            burst = set(range(50, 450, 7))
+            _import_row(api, "i", "f", 2, burst)
+            r2 |= burst
+            tr0, e0 = _snap()["tree_repairs"], planmod.STATS["evals"]
+            got = api.query("i", q)[0]
+            assert got == len(r1 | r2)
+            assert _snap()["tree_repairs"] > tr0
+            assert planmod.STATS["evals"] == e0
+            RESULT_CACHE.reset()
+            assert api.query("i", q)[0] == got
+
+    def test_multi_view_tree_repair_reads_other_operand(self):
+        """A burst in ONE view of a two-field tree rides the deferred
+        patch job: the other operand's premerge words are read outside
+        the cache lock and the commit re-validates the whole vector."""
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "f")
+            api.create_field("i", "g")
+            rf = set(range(0, 400, 2))
+            rg = set(range(0, 400, 5))
+            _import_row(api, "i", "f", 1, rf)
+            _import_row(api, "i", "g", 1, rg)
+            for q, op in (
+                ("Count(Intersect(Row(f=1), Row(g=1)))", "and"),
+                ("Count(Union(Row(f=1), Row(g=1)))", "or"),
+            ):
+                want = (
+                    len(rf & rg) if op == "and" else len(rf | rg)
+                )
+                assert api.query("i", q)[0] == want
+                assert api.query("i", q)[0] == want
+            burst = set(range(101, 401, 4))
+            _import_row(api, "i", "f", 1, burst)
+            rf |= burst
+            tr0, e0 = _snap()["tree_repairs"], planmod.STATS["evals"]
+            got_and = api.query(
+                "i", "Count(Intersect(Row(f=1), Row(g=1)))")[0]
+            got_or = api.query("i", "Count(Union(Row(f=1), Row(g=1)))")[0]
+            assert got_and == len(rf & rg)
+            assert got_or == len(rf | rg)
+            assert _snap()["tree_repairs"] >= tr0 + 2
+            assert planmod.STATS["evals"] == e0
+
+    def test_repeated_bursts_chain_tree_repairs(self):
+        with _harness(1) as c:
+            api, r1, r2 = self._tree_env(c)
+            q = "Count(Union(Row(f=1), Row(f=2)))"
+            api.query("i", q)
+            api.query("i", q)
+            rng = np.random.default_rng(3)
+            for _ in range(5):
+                row = int(rng.integers(1, 3))
+                cols = set(
+                    int(x) for x in rng.integers(0, SHARD_WIDTH, 200)
+                )
+                _import_row(api, "i", "f", row, cols)
+                (r1 if row == 1 else r2).update(cols)
+                assert api.query("i", q)[0] == len(r1 | r2)
+            assert _snap()["tree_repairs"] >= 3
+
+    def test_clear_burst_falls_back_to_recompute(self):
+        with _harness(1) as c:
+            api, r1, r2 = self._tree_env(c)
+            q = "Count(Intersect(Row(f=1), Row(f=2)))"
+            api.query("i", q)
+            api.query("i", q)
+            gone = set(range(0, 120, 6))
+            cols = np.asarray(sorted(gone), dtype=np.uint64)
+            api.import_bits(
+                "i", "f", np.full(len(cols), 1, np.uint64), cols,
+                clear=True,
+            )
+            r1 -= gone
+            tr0 = _snap()["tree_repairs"]
+            assert api.query("i", q)[0] == len(r1 & r2)
+            assert _snap()["tree_repairs"] == tr0  # non-monotone: no patch
+
+
+class TestStructuralRekey:
+    def test_topn_rekeys_when_filter_row_untouched(self):
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "f")
+            api.create_field("i", "g")
+            for r, step in ((1, 2), (2, 3), (3, 5)):
+                _import_row(api, "i", "f", r, set(range(0, 600, step)))
+            _import_row(api, "i", "g", 1, set(range(0, 600, 4)))
+            q = "TopN(f, Row(g=1), n=3)"
+            cold = api.query("i", q)
+            assert api.query("i", q) == cold  # cached
+            # burst to an UNTALLIED row of the filter field: provably
+            # disjoint from the dependency set -> re-key, no recompute
+            _import_row(api, "i", "g", 2, set(range(1, 300, 8)))
+            rk0, e0 = _snap()["rekeys"], planmod.STATS["evals"]
+            assert api.query("i", q) == cold
+            assert _snap()["rekeys"] > rk0
+            assert planmod.STATS["evals"] == e0
+            # burst to the DEPENDED filter row: entry drops, recompute
+            _import_row(api, "i", "g", 1, set(range(1, 600, 2)))
+            got = api.query("i", q)
+            RESULT_CACHE.reset()
+            assert api.query("i", q) == got
+
+    def test_groupby_rekeys_when_filter_row_untouched(self):
+        with _harness(1) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "f")
+            api.create_field("i", "g")
+            api.create_field("i", "h")
+            _import_row(api, "i", "f", 1, set(range(0, 400, 2)))
+            _import_row(api, "i", "g", 1, set(range(0, 400, 3)))
+            _import_row(api, "i", "h", 1, set(range(0, 400, 5)))
+            q = "GroupBy(Rows(f), Rows(g), filter=Row(h=1))"
+            cold = api.query("i", q)
+            assert api.query("i", q) == cold
+            _import_row(api, "i", "h", 2, set(range(1, 200, 6)))
+            rk0, e0 = _snap()["rekeys"], planmod.STATS["evals"]
+            assert api.query("i", q) == cold
+            assert _snap()["rekeys"] > rk0
+            assert planmod.STATS["evals"] == e0
+            # a burst into a TALLIED field can change any cell: drop
+            _import_row(api, "i", "f", 1, set(range(1, 400, 2)))
+            got = api.query("i", q)
+            RESULT_CACHE.reset()
+            assert api.query("i", q) == got
+
+
+# ---------------------------------------------------------------------------
+# live query subscriptions
+# ---------------------------------------------------------------------------
+
+
+def _sub_harness(n=1, **kw):
+    kw.setdefault("coherence_publish_batch_ms", 10.0)
+    kw.setdefault("coherence_sub_poll_interval", 0.2)
+    return _harness(n, **kw)
+
+
+class TestSubscriptions:
+    def test_push_on_local_write_bit_identical_to_poll(self):
+        with _sub_harness(1) as c:
+            api = c[0].api
+            _seed(api, shards=1)
+            q = "Count(Row(f=1))"
+            sub = api.subscribe("i", q)
+            assert sub["seq"] == 1
+            assert sub["result"] == _public(api, "i", q)
+            api.query("i", f"Set({SHARD_WIDTH - 7}, f=1)")
+            mgr = c[0].coherence
+            snap = mgr.poll(sub["id"], after=1, wait_s=10.0)
+            assert snap is not None and snap["seq"] >= 2
+            assert snap["result"] == _public(api, "i", q)
+            assert mgr.counters_snapshot()["sub_pushes"] >= 1
+
+    def test_push_on_remote_write(self):
+        with _sub_harness(2, coherence_lease_duration=30.0) as c:
+            api = c[0].api
+            _seed(api, shards=4)
+            q = "Count(Row(f=1))"
+            sub = api.subscribe("i", q)
+            s_remote = _remote_shard(c)
+            col = s_remote * SHARD_WIDTH + 33
+            c[1].api.import_bits(
+                "i", "f", np.array([1], np.uint64),
+                np.array([col], np.uint64),
+            )
+            mgr = c[0].coherence
+            snap = mgr.poll(sub["id"], after=1, wait_s=10.0)
+            assert snap is not None and snap["seq"] >= 2
+            assert snap["result"] == _public(api, "i", q)
+
+    def test_cap_sheds_with_429_semantics(self):
+        with _sub_harness(1, coherence_max_subscriptions=1) as c:
+            api = c[0].api
+            _seed(api, shards=1)
+            api.subscribe("i", "Count(Row(f=1))")
+            with pytest.raises(ShedError):
+                api.subscribe("i", "Count(Row(f=2))")
+
+    def test_unsubscribe_stops_pushes(self):
+        with _sub_harness(1) as c:
+            api = c[0].api
+            _seed(api, shards=1)
+            mgr = c[0].coherence
+            sub = api.subscribe("i", "Count(Row(f=1))")
+            assert mgr.unsubscribe(sub["id"]) is True
+            assert mgr.unsubscribe(sub["id"]) is False
+            p0 = mgr.counters_snapshot()["sub_pushes"]
+            api.query("i", f"Set({SHARD_WIDTH - 9}, f=1)")
+            time.sleep(0.3)  # ticks run; nothing may fire
+            assert mgr.counters_snapshot()["sub_pushes"] == p0
+            assert mgr.poll(sub["id"], -1, 0.0) is None
+
+    def test_no_change_means_no_push(self):
+        with _sub_harness(1) as c:
+            api = c[0].api
+            _seed(api, shards=1)
+            sub = api.subscribe("i", "Count(Row(f=1))")
+            mgr = c[0].coherence
+            # a write to an unrelated row re-checks but must not bump
+            # the seq: pushes fire on WIRE-visible change only
+            api.query("i", f"Set({SHARD_WIDTH - 11}, f=3)")
+            snap = mgr.poll(sub["id"], after=1, wait_s=0.6)
+            assert snap["seq"] == 1
+
+    def test_missing_index_subscription_rejected(self):
+        from pilosa_tpu.exec.executor import NotFoundError
+
+        with _sub_harness(1) as c:
+            with pytest.raises(NotFoundError):
+                c[0].api.subscribe("nope", "Count(Row(f=1))")
+
+
+# ---------------------------------------------------------------------------
+# the staged-ingest soak: push == poll bit-for-bit, >=1 repair-ridden
+# update, silence after unsubscribe
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSubscriptionSoak:
+    def test_soak_pushes_bit_identical_to_polled_recomputes(self):
+        with _harness(
+            2,
+            coherence_lease_duration=30.0,
+            coherence_publish_batch_ms=5.0,
+            coherence_sub_poll_interval=0.1,
+        ) as c:
+            api = c[0].api
+            api.create_index("i")
+            api.create_field("i", "f")
+            shards = 4
+            local = [
+                s for s in range(shards)
+                if c[0].cluster.shard_nodes("i", s)[0].id == c[0].node.id
+            ]
+            assert local, "coordinator owns no shard"
+            rng = np.random.default_rng(5)
+            model = {1: set(), 2: set()}
+
+            def ingest(row, shard):
+                cols = set(
+                    int(shard * SHARD_WIDTH + x)
+                    for x in rng.integers(0, SHARD_WIDTH, 150)
+                )
+                _import_row(api, "i", "f", row, cols)
+                model[row].update(cols)
+
+            for r in (1, 2):
+                for s in range(shards):
+                    ingest(r, s)
+            q = "Count(Union(Row(f=1), Row(f=2)))"
+            assert api.query("i", q)[0] == len(model[1] | model[2])
+            assert api.query("i", q)[0] == len(model[1] | model[2])
+            sub = api.subscribe("i", q)
+            assert sub["result"] == _public(api, "i", q)
+            mgr = c[0].coherence
+            tr0 = _snap()["tree_repairs"]
+            seq = sub["seq"]
+            pushes = 0
+            for step in range(14):
+                row = 1 + step % 2
+                # alternate coordinator-local bursts (ride the monotone
+                # tree repair) with any-shard bursts (recompute path)
+                shard = (
+                    local[step % len(local)] if step % 3 != 2
+                    else int(rng.integers(0, shards))
+                )
+                ingest(row, shard)
+                snap = mgr.poll(sub["id"], after=seq, wait_s=10.0)
+                assert snap is not None and not snap.get("error")
+                if snap["seq"] > seq:
+                    seq = snap["seq"]
+                    pushes += 1
+                    # the pushed result IS what a poller recomputes
+                    assert snap["result"] == _public(api, "i", q)
+                    assert snap["result"][0] == len(model[1] | model[2])
+            assert pushes >= 5
+            # at least one update rode the in-place monotone repair
+            assert _snap()["tree_repairs"] > tr0
+            # silence after unsubscribe
+            assert mgr.unsubscribe(sub["id"])
+            p0 = mgr.counters_snapshot()["sub_pushes"]
+            ingest(1, local[0])
+            time.sleep(0.5)
+            assert mgr.counters_snapshot()["sub_pushes"] == p0

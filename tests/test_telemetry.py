@@ -525,9 +525,13 @@ class TestLabelGC:
                 # attribution and its cache.resident_bytes{index} series
                 srv.api.query(idx, "Count(Row(f=0))")
                 srv.api.query(idx, "Count(Row(f=0))")
+                # a live subscription per tenant: the delete must close
+                # it and GC its coherence.subscriptions{index} series
+                sub = srv.api.subscribe(idx, "Count(Row(f=0))")
                 srv.publish_cache_gauges()
                 assert RESULT_CACHE.stats_snapshot()["by_index"].get(idx, 0) > 0
                 srv.api.delete_index(idx)
+                assert srv.coherence.poll(sub["id"], -1, 0.0) is None
                 srv.publish_cache_gauges()
 
             # warm-up round creates every GLOBAL series (sched gauges,
@@ -554,6 +558,9 @@ class TestLabelGC:
                 k.startswith("tenant_")
                 for k in csnap["quota_evictions_by_index"]
             )
+            # every churned subscription is gone from the coherence plane
+            assert srv.coherence.list_subscriptions() == []
+            assert srv.coherence.gauges() == {"leases": 0, "grants": 0}
 
     def test_release_after_drop_cannot_resurrect_the_series(self):
         """Delete an index while its query is in flight: the release's
@@ -579,11 +586,20 @@ class TestLabelGC:
 
     def test_delete_broadcast_gcs_labels_on_peers(self):
         """The delete-index broadcast must GC per-index series on every
-        member, not just the coordinator."""
-        with ClusterHarness(3, replica_n=1, in_memory=True) as c:
+        member, not just the coordinator — including the coherence
+        plane: leases revoked, grants dropped, subscriptions closed."""
+        with ClusterHarness(
+            3, replica_n=1, in_memory=True, coherence_lease_duration=30.0
+        ) as c:
             _seed(c[0].api, "bye", n_shards=6)
             for _ in range(2):
                 c[0].api.query("bye", "Count(Row(f=0))")
+            sub = c[0].api.subscribe("bye", "Count(Row(f=0))")
+            # the leased fan-out armed mirrors/grants across the cluster
+            assert c[0].coherence.gauges()["leases"] >= 1
+            assert any(
+                s.coherence.gauges()["grants"] >= 1 for s in c.nodes
+            )
             # fan-out legs created per-index series on the peers
             assert any(
                 "index:bye" in k
@@ -591,7 +607,11 @@ class TestLabelGC:
                 for k in s.stats.registry.snapshot()
             )
             c[0].api.delete_index("bye")
+            assert c[0].coherence.poll(sub["id"], -1, 0.0) is None
             for s in c.nodes:
+                assert s.coherence.gauges() == {"leases": 0, "grants": 0}
+                assert s.coherence.list_subscriptions() == []
+                s.publish_cache_gauges()
                 held = [
                     k
                     for k in s.stats.registry.snapshot()

@@ -227,6 +227,131 @@ def _tier_smoke(errors: list) -> None:
             )
 
 
+def _coherence_smoke(errors: list) -> None:
+    """Cache-coherence scenario (ISSUE 19) on its own 2-node leased
+    harness, driven entirely over HTTP: a warm fan-out hit that pays
+    ZERO version RTTs (counter delta asserted), one subscription
+    receiving a pushed update after a write issued at the REMOTE node,
+    and the coherence.* families rendering on a lint-clean /metrics
+    page on both the holder and the publisher."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.testing import ClusterHarness
+
+    with ClusterHarness(
+        2, in_memory=True, metric_poll_interval=0.0,
+        telemetry_sample_interval=0.0,
+        coherence_lease_duration=30.0,
+        coherence_publish_batch_ms=10.0,
+        coherence_sub_poll_interval=0.2,
+    ) as cluster:
+        srv = cluster[0]
+        uri = srv.node.uri
+        srv.api.create_index("smoke_coh")
+        srv.api.create_field("smoke_coh", "f", {"type": "set"})
+        cols = [s * SHARD_WIDTH + k for s in range(4) for k in range(10)]
+        _post(
+            uri, "/index/smoke_coh/field/f/import",
+            {"rows": [1] * len(cols), "cols": cols},
+        )
+        q = {"query": "Count(Row(f=1))"}
+        for _ in range(2):  # cold + mirror-armed repeat
+            resp = _post(uri, "/index/smoke_coh/query", q)
+            assert resp["results"] == [len(cols)], resp
+        mgr = srv.coherence
+        rtts0 = mgr.counters_snapshot()["version_rtts"]
+        hits0 = mgr.counters_snapshot()["lease_hits"]
+        resp = _post(uri, "/index/smoke_coh/query", q)
+        assert resp["results"] == [len(cols)], resp
+        csnap = mgr.counters_snapshot()
+        if csnap["version_rtts"] != rtts0:
+            errors.append(
+                "coherence smoke: leased warm hit paid "
+                f"{csnap['version_rtts'] - rtts0} version RTT(s); "
+                "expected 0"
+            )
+        if csnap["lease_hits"] <= hits0:
+            errors.append(
+                "coherence smoke: lease_hits did not move on a warm hit"
+            )
+        # subscription: registered over the wire, updated by a write
+        # POSTed at the REMOTE node, delivered via the long-poll GET
+        sub = _post(
+            uri, "/subscriptions",
+            {"index": "smoke_coh", "query": "Count(Row(f=5))"},
+        )
+        assert sub["seq"] == 1 and sub["result"] == [0], sub
+        # the write must land on a REMOTE-owned shard so the update
+        # travels the publish plane (publisher bump -> holder mirror ->
+        # push), not a purely local invalidation
+        remote_shard = next(
+            s for s in range(4)
+            if cluster[0].cluster.shard_nodes("smoke_coh", s)[0].id
+            != srv.node.id
+        )
+        _post(
+            cluster[1].node.uri, "/index/smoke_coh/field/f/import",
+            {"rows": [5], "cols": [remote_shard * SHARD_WIDTH + 3]},
+        )
+        snap = json.loads(
+            _get(uri, f"/subscriptions/{sub['id']}?after=1&wait=15")
+        )
+        if snap.get("seq", 1) < 2 or snap.get("result") != [1]:
+            errors.append(
+                f"coherence smoke: no pushed update after a remote "
+                f"write: {snap}"
+            )
+        for s in cluster.nodes:
+            s.publish_cache_gauges()
+        holder_text = _get(uri, "/metrics")
+        publisher_text = _get(cluster[1].node.uri, "/metrics")
+    for label, text in (
+        ("holder", holder_text), ("publisher", publisher_text),
+    ):
+        for e in lint_against_registry(text):
+            errors.append(f"coherence {label} /metrics: {e}")
+    for fam, want_min in (
+        ("pilosa_tpu_coherence_lease_hits", 1.0),
+        ("pilosa_tpu_coherence_leases", 1.0),
+        ("pilosa_tpu_coherence_sub_pushes", 1.0),
+    ):
+        m = re.search(rf"^{fam} ([0-9.e+-]+)", holder_text, re.M)
+        if m is None:
+            errors.append(f"coherence holder /metrics: {fam} missing")
+        elif float(m.group(1)) < want_min:
+            errors.append(
+                f"coherence holder /metrics: {fam} = {m.group(1)}, "
+                f"expected >= {want_min}"
+            )
+    # the version-RTT counter renders (at 0: every hit was leased)
+    if not re.search(
+        r"^pilosa_tpu_coherence_version_rtts ", holder_text, re.M
+    ):
+        errors.append(
+            "coherence holder /metrics: coherence.version_rtts missing"
+        )
+    if not re.search(
+        r'^pilosa_tpu_coherence_subscriptions\{index="smoke_coh"\} 1',
+        holder_text, re.M,
+    ):
+        errors.append(
+            "coherence holder /metrics: "
+            "coherence.subscriptions{index=smoke_coh} != 1"
+        )
+    for fam in (
+        "pilosa_tpu_coherence_grants",
+        "pilosa_tpu_coherence_grants_issued",
+        "pilosa_tpu_coherence_publishes",
+    ):
+        m = re.search(rf"^{fam} ([0-9.e+-]+)", publisher_text, re.M)
+        if m is None:
+            errors.append(f"coherence publisher /metrics: {fam} missing")
+        elif float(m.group(1)) < 1.0:
+            errors.append(
+                f"coherence publisher /metrics: {fam} = {m.group(1)}, "
+                "expected >= 1"
+            )
+
+
 def main() -> int:
     errors: list = []
     with ClusterHarness(
@@ -497,11 +622,22 @@ def main() -> int:
             "storage enabled"
         )
 
+    # the main harness never leased, never subscribed: the coherence.*
+    # families are opt-in and must not render at all
+    if re.search(r"^pilosa_tpu_coherence_", node_text, re.M):
+        errors.append(
+            "node /metrics: coherence.* series rendered without any "
+            "lease or subscription activity"
+        )
+
     # multi-tenant QoS enforcement (ISSUE 16), on its own harness
     _tenant_overload(errors)
 
     # tiered storage (ISSUE 18), on its own harness
     _tier_smoke(errors)
+
+    # cache coherence (ISSUE 19), on its own 2-node leased harness
+    _coherence_smoke(errors)
 
     for e in errors:
         print(f"metrics-smoke: {e}")
